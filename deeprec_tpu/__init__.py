@@ -1,0 +1,27 @@
+"""deeprec_tpu — a TPU-native sparse-recommendation training framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability set of DeepRec
+(Alibaba's TF-1.15 recommendation engine, studied read-only at
+/root/reference/): dynamic hash-table embeddings with admission filters and
+eviction, frequency-aware sparse optimizers, pod-sharded tables over ICI
+collectives, staged input pipelines, full+incremental checkpointing, a
+modelzoo and a serving path. See SURVEY.md for the blueprint.
+"""
+
+from deeprec_tpu.config import (
+    CBFFilter,
+    CheckpointConfig,
+    CounterFilter,
+    EmbeddingVariableOption,
+    GlobalStepEvict,
+    InitializerOption,
+    L2WeightEvict,
+    MeshConfig,
+    StorageOption,
+    StorageType,
+    TableConfig,
+)
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup
+from deeprec_tpu.embedding.combiners import combine
+
+__version__ = "0.1.0"

@@ -1,0 +1,197 @@
+"""Unified typed configuration tree.
+
+DeepRec spreads configuration over three mechanisms — ConfigProto extensions
+(/root/reference/tensorflow/core/protobuf/config.proto), dozens of env vars,
+and per-EV option objects (tensorflow/python/ops/variables.py:180-300:
+EmbeddingVariableOption / InitializerOption / GlobalStepEvict / L2WeightEvict /
+StorageOption / CounterFilter / CBFFilter / CheckpointOption). Here everything
+is one tree of frozen dataclasses, hashable so they can be passed as jit
+static arguments.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Optional, Tuple
+
+
+class StorageType(enum.Enum):
+    """Where a table's payload lives.
+
+    Parity with the storage enum in
+    /root/reference/tensorflow/core/framework/embedding/config.proto:10-25.
+    On TPU the tiers collapse to: HBM (device arrays), DRAM (host store via
+    the native KV lib), and HBM_DRAM (HBM working set + host overflow, the
+    analog of DeepRec's HbmDramStorage). PMEM/SSD/LevelDB map onto the host
+    tier's file-backed mode.
+    """
+
+    HBM = "hbm"
+    DRAM = "dram"
+    HBM_DRAM = "hbm_dram"
+
+
+@dataclasses.dataclass(frozen=True)
+class InitializerOption:
+    """EV initializer semantics.
+
+    DeepRec (docs/docs_en/Embedding-Variable.md "EV Initializer"): an
+    initializer generates a [default_value_dim, dim] matrix; a new key k is
+    assigned row (k % default_value_dim). `kind="stateless_normal"` is the
+    TPU-native improvement: a per-key deterministic normal computed from the
+    key hash — same statistical effect with no stored matrix and bitwise
+    reproducibility across shards/restarts/growth.
+    """
+
+    kind: str = "stateless_normal"  # stateless_normal | matrix_normal | constant
+    stddev: float = 0.05
+    mean: float = 0.0
+    constant: float = 0.0
+    default_value_dim: int = 4096
+    # Value served for keys blocked by an admission filter
+    # (EmbeddingVariableOption.init.default_value_no_permission).
+    default_value_no_permission: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CounterFilter:
+    """Admit a feature only after it has been seen `filter_freq` times.
+
+    Parity: tf.CounterFilter (variables.py:279) /
+    counter_filter_policy.h. Until admission a key is tracked (frequency
+    counter) but serves `default_value_no_permission` and receives no
+    gradient updates.
+    """
+
+    filter_freq: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CBFFilter:
+    """Counting-Bloom-filter admission: like CounterFilter but the counter
+    lives in a compact sketch, and keys below threshold never occupy a table
+    slot at all.
+
+    Parity: tf.CBFFilter (variables.py:284) / bloom_filter_policy.h.
+    """
+
+    filter_freq: int = 0
+    max_element_size: int = 1 << 20
+    false_positive_probability: float = 0.01
+    counter_bits: int = 16  # sketch counters saturate at 2^bits - 1
+
+    def num_cells(self) -> int:
+        # Standard Bloom sizing: m = -n ln p / (ln 2)^2, rounded up to pow2.
+        m = -self.max_element_size * math.log(self.false_positive_probability) / (
+            math.log(2.0) ** 2
+        )
+        return max(1024, 1 << int(math.ceil(math.log2(max(m, 1.0)))))
+
+    def num_hashes(self) -> int:
+        k = (self.num_cells() / max(self.max_element_size, 1)) * math.log(2.0)
+        return max(1, min(8, int(round(k))))
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalStepEvict:
+    """TTL eviction: drop keys not updated in the last `steps_to_live` steps.
+
+    Parity: tf.GlobalStepEvict (variables.py:204) /
+    globalstep_shrink_policy.h; spec docs/docs_en/Feature-Eviction.md.
+    Runs at checkpoint/eviction time, not on the lookup hot path.
+    """
+
+    steps_to_live: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class L2WeightEvict:
+    """Drop keys whose embedding L2 norm is below threshold.
+
+    Parity: tf.L2WeightEvict (variables.py:210) / l2weight_shrink_policy.h.
+    """
+
+    l2_weight_threshold: float = -1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageOption:
+    """Multi-tier storage placement for one table.
+
+    Parity: tf.StorageOption (variables.py:230). `capacity` bounds the HBM
+    tier (slots); overflow keys spill to the host store when
+    storage_type=HBM_DRAM (eviction by LFU/LRU on (freq, version)).
+    """
+
+    storage_type: StorageType = StorageType.HBM
+    storage_path: Optional[str] = None
+    cache_strategy: str = "lfu"  # lfu | lru
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingVariableOption:
+    """Per-table feature bundle — parity with tf.EmbeddingVariableOption
+    (variables.py:261)."""
+
+    init: InitializerOption = InitializerOption()
+    counter_filter: Optional[CounterFilter] = None
+    cbf_filter: Optional[CBFFilter] = None
+    global_step_evict: Optional[GlobalStepEvict] = None
+    l2_weight_evict: Optional[L2WeightEvict] = None
+    storage: StorageOption = StorageOption()
+
+    def __post_init__(self):
+        if self.counter_filter is not None and self.cbf_filter is not None:
+            raise ValueError("at most one admission filter per table")
+
+
+@dataclasses.dataclass(frozen=True)
+class TableConfig:
+    """Static configuration of one hash-embedding table.
+
+    The analog of creating an EmbeddingVariable via tf.get_embedding_variable
+    (variable_scope.py:2146): `dim` is the embedding width, `capacity` the
+    fixed HBM slot count (power of two; DeepRec's tables grow dynamically —
+    here growth is host-orchestrated rehash to a larger capacity, see
+    table.grow()).
+    """
+
+    name: str
+    dim: int
+    capacity: int = 1 << 16
+    key_dtype: str = "int32"  # int32 | int64 (int64 requires jax x64)
+    value_dtype: str = "float32"  # float32 | bfloat16
+    combiner: str = "mean"  # mean | sum | sqrtn
+    max_probes: int = 64
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        if self.capacity & (self.capacity - 1):
+            raise ValueError(f"capacity must be a power of two, got {self.capacity}")
+        if self.dim <= 0:
+            raise ValueError("dim must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout: `dp` replicates the dense model / splits the batch,
+    `mp` shards embedding tables (DeepRec CollectiveStrategy.embedding_scope
+    analog, group_embedding_collective_strategy.py:68-86)."""
+
+    dp: int = 1
+    mp: int = 1
+    axis_dp: str = "dp"
+    axis_mp: str = "mp"
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Full + incremental checkpoint cadence — parity with
+    MonitoredTrainingSession(save_checkpoint_secs=, save_incremental_checkpoint_secs=)
+    (docs/docs_en/Incremental-Checkpoint.md)."""
+
+    directory: str = "ckpt"
+    save_steps: int = 1000
+    incremental_save_steps: int = 0  # 0 disables incremental saves
+    keep: int = 3

@@ -1,0 +1,2 @@
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup
+from deeprec_tpu.embedding.combiners import combine

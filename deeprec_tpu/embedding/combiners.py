@@ -1,0 +1,36 @@
+"""Segment combiners for ragged sparse features.
+
+DeepRec combines per-sample bags of embeddings with sum/mean/sqrtn inside
+embedding_lookup_sparse (/root/reference/tensorflow/python/ops/
+embedding_ops.py:484) and its fused kernels. On TPU the ragged bag is a dense
+[B, L] padded id matrix; the combine is a masked reduction the compiler fuses
+straight into the downstream matmul.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def combine(
+    emb_u: jnp.ndarray,  # [U, D] unique embeddings
+    inverse: jnp.ndarray,  # [B, L] position -> unique index
+    mask: jnp.ndarray,  # [B, L] bool, True for real (non-pad) ids
+    combiner: str = "mean",
+) -> jnp.ndarray:
+    """Gather per-position embeddings from the unique set and reduce each bag.
+
+    Differentiable w.r.t. emb_u: the backward pass is exactly the
+    scatter-of-gradients DeepRec's _GatherGrad + sparse-apply pipeline
+    produces (kv_variable_ops.py:1092), computed by autodiff.
+    """
+    e = emb_u[inverse]  # [B, L, D]
+    m = mask[..., None].astype(e.dtype)
+    s = jnp.sum(e * m, axis=1)  # [B, D]
+    n = jnp.sum(m, axis=1)  # [B, 1]
+    if combiner == "sum":
+        return s
+    if combiner == "mean":
+        return s / jnp.maximum(n, 1.0)
+    if combiner == "sqrtn":
+        return s / jnp.sqrt(jnp.maximum(n, 1.0))
+    raise ValueError(f"unknown combiner: {combiner}")

@@ -1,0 +1,53 @@
+"""Admission filters — vectorized counterparts of DeepRec's filter policies.
+
+Reference: /root/reference/tensorflow/core/framework/embedding/
+{filter_policy.h, counter_filter_policy.h, bloom_filter_policy.h}; behavior
+spec docs/docs_en/Embedding-Variable.md (Feature Filter section).
+
+The counter filter needs no code here — it gates on the per-slot `freq` array
+directly (see table._lookup_resolved). The counting-Bloom filter (CBF) keeps a
+compact int sketch so that below-threshold keys never consume a table slot.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from deeprec_tpu.config import CBFFilter
+from deeprec_tpu.utils import hashing
+
+
+def cbf_add(
+    cbf: CBFFilter, bloom: jnp.ndarray, uids: jnp.ndarray, counts: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Add `counts` occurrences of each id to the sketch; return the updated
+    sketch and the post-update min-estimate per id.
+
+    K hash functions index K cells per key; the estimate is the min over
+    cells (conservative, counting-Bloom standard). All K updates are batched
+    scatter-adds — no per-key loop.
+    """
+    M = bloom.shape[0]
+    K = cbf.num_hashes()
+    cap = jnp.int32((1 << cbf.counter_bits) - 1)
+    cells = []
+    for k in range(K):
+        cells.append(hashing.hash_to_bucket(uids, M, salt=0xB100_0001 + k))
+    cell_ix = jnp.stack(cells, axis=0)  # [K, U]
+    add = jnp.broadcast_to(counts[None, :], cell_ix.shape)
+    bloom = bloom.at[cell_ix.reshape(-1)].add(add.reshape(-1))
+    bloom = jnp.minimum(bloom, cap)
+    est = jnp.min(bloom[cell_ix], axis=0)  # [U]
+    return bloom, est
+
+
+def cbf_estimate(cbf: CBFFilter, bloom: jnp.ndarray, uids: jnp.ndarray) -> jnp.ndarray:
+    """Read-only min-estimate of each id's count."""
+    M = bloom.shape[0]
+    K = cbf.num_hashes()
+    cell_ix = jnp.stack(
+        [hashing.hash_to_bucket(uids, M, salt=0xB100_0001 + k) for k in range(K)],
+        axis=0,
+    )
+    return jnp.min(bloom[cell_ix], axis=0)

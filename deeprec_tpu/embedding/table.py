@@ -1,0 +1,522 @@
+"""Hash-embedding table: the TPU-native EmbeddingVariable.
+
+DeepRec's EmbeddingVariable (/root/reference/tensorflow/core/framework/embedding/
+embedding_var.h:53) is a C++ resource wrapping a lockless hash map, a filter
+policy and tiered storage; its hot loop is per-key pointer chasing
+(kv_variable_lookup_ops.cc:255-306). That design cannot map onto XLA's
+static-shape, functional world — so this is a redesign, not a port:
+
+  * The table IS a pytree of dense arrays living in HBM: `keys [C]`,
+    `values [C, D]`, `freq [C]`, `version [C]`, plus optimizer slot arrays.
+    C is a fixed power-of-two capacity; growth is a host-orchestrated rehash
+    into a larger table (recompiles once per capacity).
+  * Lookup-or-create is a *vectorized* open-addressing probe: every pending id
+    gathers its candidate slot, matches or claims empty slots via batched
+    scatter, losers of a claim race advance to the next probe offset. The loop
+    is a `lax.while_loop` of pure gathers/scatters — no per-key host loop,
+    everything lands on the VPU.
+  * Admission filters, frequency/version tracking and initialization are
+    masked vector updates on the same arrays.
+  * Eviction rebuilds the table (rare, checkpoint-time), which also heals
+    probe chains — no tombstones on the hot path.
+
+All ops are pure: they take a TableState and return a new one; XLA's buffer
+donation makes the updates in-place in practice.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deeprec_tpu.config import TableConfig
+from deeprec_tpu.utils import hashing
+
+
+def _key_dtype(cfg: TableConfig):
+    return jnp.dtype(cfg.key_dtype)
+
+
+def empty_key(cfg: TableConfig) -> int:
+    """Reserved sentinel marking a free slot (min value of the key dtype)."""
+    return int(jnp.iinfo(_key_dtype(cfg)).min)
+
+
+@struct.dataclass
+class TableState:
+    """Device-resident state of one table (a pytree; donate it through jit)."""
+
+    keys: jnp.ndarray  # [C] key_dtype, empty slots hold the sentinel
+    values: jnp.ndarray  # [C, D] value_dtype
+    freq: jnp.ndarray  # [C] int32 — lookup counter (admission + LFU tiering)
+    version: jnp.ndarray  # [C] int32 — global step of last touch (TTL evict)
+    slots: Dict[str, jnp.ndarray]  # optimizer slot arrays, [C, D] or [C, 1]
+    bloom: Optional[jnp.ndarray]  # [M] int32 counting-Bloom sketch (CBF filter)
+    dirty: jnp.ndarray  # [C] bool — touched since last incremental save
+    insert_fails: jnp.ndarray  # [] int32 — ids that found no slot (grow signal)
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.values.shape[1]
+
+
+@struct.dataclass
+class UniqueLookup:
+    """Result of a deduplicated lookup, the unit the grad path works on."""
+
+    uids: jnp.ndarray  # [U] unique ids (sentinel-padded)
+    slot_ix: jnp.ndarray  # [U] int32 slot index, -1 when absent/blocked
+    inverse: jnp.ndarray  # [N] position -> index into uids
+    counts: jnp.ndarray  # [U] int32 occurrences in this batch
+    valid: jnp.ndarray  # [U] bool — real id (not padding)
+    admitted: jnp.ndarray  # [U] bool — passes the admission filter
+    embeddings: jnp.ndarray  # [U, D] gathered values (default where blocked)
+
+
+class EmbeddingTable:
+    """Pure-function API around TableState for one TableConfig.
+
+    The public surface mirrors what tf.get_embedding_variable +
+    tf.nn.embedding_lookup deliver in DeepRec (variable_scope.py:2146,
+    embedding_ops.py:365), re-cut for functional SPMD training.
+    """
+
+    def __init__(self, cfg: TableConfig):
+        self.cfg = cfg
+
+    # Hashable-by-config so EmbeddingTable can ride through jit as a static
+    # argument (the jitted public methods below rely on this).
+    def __hash__(self):
+        return hash(self.cfg)
+
+    def __eq__(self, other):
+        return isinstance(other, EmbeddingTable) and self.cfg == other.cfg
+
+    # ------------------------------------------------------------------ state
+
+    def create(self) -> TableState:
+        cfg = self.cfg
+        C, D = cfg.capacity, cfg.dim
+        kdt = _key_dtype(cfg)
+        vdt = jnp.dtype(cfg.value_dtype)
+        bloom = None
+        if cfg.ev.cbf_filter is not None:
+            bloom = jnp.zeros((cfg.ev.cbf_filter.num_cells(),), jnp.int32)
+        return TableState(
+            keys=jnp.full((C,), empty_key(cfg), kdt),
+            values=jnp.zeros((C, D), vdt),
+            freq=jnp.zeros((C,), jnp.int32),
+            version=jnp.full((C,), -1, jnp.int32),
+            slots={},
+            bloom=bloom,
+            dirty=jnp.zeros((C,), bool),
+            insert_fails=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------- initializer
+
+    def default_salt(self) -> int:
+        return hashing.name_salt(self.cfg.name)
+
+    def _init_rows(self, uids: jnp.ndarray, salt=None) -> jnp.ndarray:
+        """Initializer values for newly created keys — a pure function of
+        (key, table salt), so creation is reproducible anywhere (EV
+        Initializer semantics, docs/docs_en/Embedding-Variable.md). Grouped
+        tables pass a traced per-table salt through vmap."""
+        cfg = self.cfg
+        init = cfg.ev.init
+        D = cfg.dim
+        vdt = jnp.dtype(cfg.value_dtype)
+        if salt is None:
+            salt = self.default_salt()
+        if init.kind == "constant":
+            return jnp.full((uids.shape[0], D), init.constant, vdt)
+        if init.kind == "matrix_normal":
+            # DeepRec: row (key % default_value_dim) of a fixed normal matrix.
+            # The matrix itself is regenerated from the salt, not stored.
+            dvd = init.default_value_dim
+            rows = (uids.astype(jnp.uint32) % jnp.uint32(dvd)).astype(jnp.int32)
+            u = hashing.stateless_uniform_from_ids(
+                rows[:, None] * jnp.int32(D)
+                + jax.lax.broadcasted_iota(jnp.int32, (1, D), 1),
+                salt=jnp.asarray(salt).astype(jnp.uint32) ^ jnp.uint32(0x5EED),
+            )
+            return self._uniform_to_normal(u).astype(vdt)
+        # stateless_normal: per-key deterministic normal from the id hash.
+        u = hashing.stateless_uniform_from_ids(
+            uids[:, None] * jnp.int32(max(D, 1))
+            + jax.lax.broadcasted_iota(jnp.int32, (1, D), 1),
+            salt=salt,
+        )
+        return self._uniform_to_normal(u).astype(vdt)
+
+    def _uniform_to_normal(self, u: jnp.ndarray) -> jnp.ndarray:
+        init = self.cfg.ev.init
+        # inverse-CDF approximation via erfinv: N(mean, stddev)
+        eps = 1e-6
+        z = jnp.sqrt(2.0) * jax.scipy.special.erfinv(
+            jnp.clip(2.0 * u - 1.0, -1.0 + eps, 1.0 - eps)
+        )
+        return init.mean + init.stddev * z
+
+    # ------------------------------------------------------------ probe/insert
+
+    def _probe(
+        self,
+        keys: jnp.ndarray,
+        uids: jnp.ndarray,
+        want_create: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Vectorized open-addressing lookup-or-create.
+
+        Args:
+          keys: [C] current key array.
+          uids: [U] unique ids to resolve.
+          want_create: [U] bool — ids allowed to claim an empty slot.
+
+        Returns: (new_keys, slot_ix [U] (-1 = not found/placed), created [U],
+        failed [U]).
+        """
+        cfg = self.cfg
+        C = keys.shape[0]
+        mask_c = jnp.uint32(C - 1)
+        h = hashing.mix32(hashing.fold64(uids))
+        sentinel = jnp.asarray(empty_key(cfg), keys.dtype)
+        valid = uids != sentinel
+
+        slot_ix0 = jnp.full(uids.shape, -1, jnp.int32)
+        created0 = jnp.zeros(uids.shape, bool)
+        pending0 = valid
+
+        def cond(carry):
+            step, pending, *_ = carry
+            return jnp.logical_and(step < cfg.max_probes, jnp.any(pending))
+
+        def body(carry):
+            step, pending, slot_ix, created, keys = carry
+            pos = ((h + jnp.uint32(step)) & mask_c).astype(jnp.int32)  # [U]
+            k = keys[pos]
+            found = pending & (k == uids)
+            slot_ix = jnp.where(found, pos, slot_ix)
+            pending = pending & ~found
+            is_empty = k == sentinel
+            want = pending & is_empty & want_create
+            # Claim race: scatter all claimants; duplicates resolve to one
+            # winner, which the re-gather below reveals. Losers keep probing.
+            claim_pos = jnp.where(want, pos, C)  # C = out of bounds -> dropped
+            keys = keys.at[claim_pos].set(uids, mode="drop")
+            won = want & (keys[pos] == uids)
+            slot_ix = jnp.where(won, pos, slot_ix)
+            created = created | won
+            pending = pending & ~won
+            # ids at a *non*-creatable empty slot stop probing: the key is
+            # definitively absent (linear probing invariant).
+            give_up = pending & is_empty & ~want_create
+            pending = pending & ~give_up
+            return step + 1, pending, slot_ix, created, keys
+
+        step, pending, slot_ix, created, keys = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), pending0, slot_ix0, created0, keys)
+        )
+        failed = pending  # ran out of probes: table (region) is full
+        return keys, slot_ix, created, failed
+
+    # ----------------------------------------------------------------- lookup
+
+    def lookup_unique(
+        self,
+        state: TableState,
+        ids: jnp.ndarray,
+        *,
+        step: jnp.ndarray | int = 0,
+        train: bool = True,
+        pad_value: int = -1,
+        unique_size: Optional[int] = None,
+    ) -> Tuple[TableState, UniqueLookup]:
+        return _lookup_unique_jit(
+            self, state, ids, jnp.asarray(step, jnp.int32), train, pad_value,
+            unique_size,
+        )
+
+    def _lookup_unique_impl(
+        self,
+        state: TableState,
+        ids: jnp.ndarray,
+        step,
+        train: bool,
+        pad_value: int,
+        unique_size: Optional[int],
+        salt=None,
+    ) -> Tuple[TableState, UniqueLookup]:
+        """Deduplicate ids, resolve/insert them, gather embeddings.
+
+        `ids` may be any shape; padding positions equal to `pad_value` are
+        ignored (standard for ragged sparse features). In train mode new keys
+        are inserted, frequencies incremented and versions stamped — the
+        combined semantics of KvResourceGather + the freq/version bookkeeping
+        DeepRec does inside EmbeddingVar::GetEmbeddings/LookupOrCreateKey.
+        """
+        cfg = self.cfg
+        flat = ids.reshape(-1)
+        N = flat.shape[0]
+        U = unique_size or N
+        sentinel = jnp.asarray(empty_key(cfg), flat.dtype)
+        # Collapse padding onto the sentinel so it dedups to one fill entry.
+        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
+        uids, inverse, counts = jnp.unique(
+            flat, size=U, fill_value=sentinel, return_inverse=True, return_counts=True
+        )
+        inverse = inverse.reshape(ids.shape)  # position -> unique, in id layout
+        valid = uids != sentinel
+        # Padding contributes no counts.
+        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+
+        state, res = self._lookup_resolved(
+            state, uids, counts, valid, step=step, train=train, salt=salt
+        )
+        return state, dataclasses.replace(res, inverse=inverse)
+
+    def _lookup_resolved(
+        self,
+        state: TableState,
+        uids: jnp.ndarray,
+        counts: jnp.ndarray,
+        valid: jnp.ndarray,
+        *,
+        step: jnp.ndarray | int,
+        train: bool,
+        salt=None,
+    ) -> Tuple[TableState, UniqueLookup]:
+        """Core lookup on already-unique ids (also the per-shard entry point
+        for sharded tables, where dedup happened before the all-to-all)."""
+        cfg = self.cfg
+        step = jnp.asarray(step, jnp.int32)
+
+        bloom = state.bloom
+        want_create = valid
+        if not train:
+            want_create = jnp.zeros_like(valid)
+        elif cfg.ev.cbf_filter is not None:
+            # CBF admission: bump the sketch, only keys at/above threshold may
+            # occupy a table slot (bloom_filter_policy.h semantics).
+            from deeprec_tpu.embedding import filters as _filters
+
+            bloom, est = _filters.cbf_add(cfg.ev.cbf_filter, bloom, uids, counts)
+            want_create = valid & (est >= cfg.ev.cbf_filter.filter_freq)
+
+        keys, slot_ix, created, failed = self._probe(state.keys, uids, want_create)
+
+        present = slot_ix >= 0
+        safe_ix = jnp.where(present, slot_ix, 0)
+
+        values = state.values
+        freq = state.freq
+        version = state.version
+        dirty = state.dirty
+        if train:
+            # Initialize newly created rows.
+            init_rows = self._init_rows(uids, salt)
+            scatter_ix = jnp.where(created, slot_ix, state.capacity)
+            values = values.at[scatter_ix].set(init_rows, mode="drop")
+            upd_ix = jnp.where(present, slot_ix, state.capacity)
+            freq = freq.at[upd_ix].add(counts, mode="drop")
+            version = version.at[upd_ix].set(step, mode="drop")
+            dirty = dirty.at[upd_ix].set(True, mode="drop")
+
+        emb = values.at[safe_ix].get(mode="clip")
+
+        # Admission: counter filter gates on the (just updated) frequency.
+        admitted = present
+        if cfg.ev.counter_filter is not None and cfg.ev.counter_filter.filter_freq > 0:
+            f = freq.at[safe_ix].get(mode="clip")
+            admitted = present & (f >= cfg.ev.counter_filter.filter_freq)
+        blocked_default = jnp.asarray(
+            cfg.ev.init.default_value_no_permission, emb.dtype
+        )
+        emb = jnp.where(admitted[:, None], emb, blocked_default)
+
+        new_state = state.replace(
+            keys=keys,
+            values=values,
+            freq=freq,
+            version=version,
+            bloom=bloom,
+            dirty=dirty,
+            insert_fails=state.insert_fails + jnp.sum(failed).astype(jnp.int32),
+        )
+        res = UniqueLookup(
+            uids=uids,
+            slot_ix=slot_ix,
+            inverse=jnp.zeros((0,), jnp.int32),  # filled by lookup_unique
+            counts=counts,
+            valid=valid,
+            admitted=admitted,
+            embeddings=emb,
+        )
+        return new_state, res
+
+    def lookup_readonly(
+        self, state: TableState, ids: jnp.ndarray, pad_value: int = -1,
+        salt: Optional[int] = None,
+    ) -> jnp.ndarray:
+        """Serving lookup. For grouped/stacked tables pass the per-feature
+        salt used at training time so missing keys serve the same
+        initializer vector training would have created."""
+        return _lookup_readonly_jit(self, state, ids, pad_value, salt)
+
+    def _lookup_readonly_impl(
+        self, state: TableState, ids: jnp.ndarray, pad_value: int = -1,
+        salt=None,
+    ) -> jnp.ndarray:
+        """Serving-path lookup: no insertion, no counter updates. Missing keys
+        serve their initializer value (what a fresh key would have trained
+        from), padding serves zeros."""
+        cfg = self.cfg
+        shape = ids.shape
+        flat = ids.reshape(-1)
+        sentinel = jnp.asarray(empty_key(cfg), flat.dtype)
+        is_pad = flat == jnp.asarray(pad_value, flat.dtype)
+        flat = jnp.where(is_pad, sentinel, flat)
+        keys, slot_ix, _, _ = self._probe(
+            state.keys, flat, jnp.zeros(flat.shape, bool)
+        )
+        del keys  # unchanged: no creation
+        present = slot_ix >= 0
+        emb = state.values.at[jnp.where(present, slot_ix, 0)].get(mode="clip")
+        emb = jnp.where(present[:, None], emb, self._init_rows(flat, salt))
+        emb = jnp.where(is_pad[:, None], 0.0, emb)
+        return emb.reshape(*shape, cfg.dim)
+
+    # ---------------------------------------------------------------- updates
+
+    def scatter_update(
+        self,
+        state: TableState,
+        slot_ix: jnp.ndarray,
+        new_values: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> TableState:
+        """Write rows back (optimizers use this through their own slot logic)."""
+        ok = slot_ix >= 0
+        if mask is not None:
+            ok = ok & mask
+        ix = jnp.where(ok, slot_ix, state.capacity)
+        values = state.values.at[ix].set(
+            new_values.astype(state.values.dtype), mode="drop"
+        )
+        dirty = state.dirty.at[ix].set(True, mode="drop")
+        return state.replace(values=values, dirty=dirty)
+
+    # ------------------------------------------------------- evict & rebuild
+
+    def occupied(self, state: TableState) -> jnp.ndarray:
+        return state.keys != jnp.asarray(empty_key(self.cfg), state.keys.dtype)
+
+    def size(self, state: TableState) -> jnp.ndarray:
+        """Live key count — EV's Size()/tf.EVGetSize analog."""
+        return jnp.sum(self.occupied(state)).astype(jnp.int32)
+
+    def evict_mask(self, state: TableState, step: jnp.ndarray | int) -> jnp.ndarray:
+        """Which occupied slots the eviction policies would drop
+        (docs/docs_en/Feature-Eviction.md: GlobalStepEvict + L2WeightEvict)."""
+        cfg = self.cfg
+        occ = self.occupied(state)
+        drop = jnp.zeros_like(occ)
+        gse = cfg.ev.global_step_evict
+        if gse is not None and gse.steps_to_live > 0:
+            drop = drop | (
+                jnp.asarray(step, jnp.int32) - state.version > gse.steps_to_live
+            )
+        l2e = cfg.ev.l2_weight_evict
+        if l2e is not None and l2e.l2_weight_threshold >= 0:
+            norm2 = jnp.sum(
+                state.values.astype(jnp.float32) ** 2, axis=1
+            )
+            drop = drop | (norm2 < l2e.l2_weight_threshold)
+        return occ & drop
+
+    def rebuild(
+        self, state: TableState, keep: Optional[jnp.ndarray] = None,
+        new_capacity: Optional[int] = None,
+    ) -> TableState:
+        """Re-insert surviving entries into a fresh table.
+
+        Used for (a) eviction — linear probing cannot delete in place without
+        breaking chains, and rebuilds also re-compact them — and (b) growth to
+        a larger capacity. O(C), runs at checkpoint cadence, fully on device.
+        """
+        cfg = self.cfg
+        C_new = new_capacity or state.capacity
+        if C_new & (C_new - 1):
+            raise ValueError("new_capacity must be a power of two")
+        occ = self.occupied(state)
+        if keep is not None:
+            occ = occ & keep
+        sentinel = jnp.asarray(empty_key(cfg), state.keys.dtype)
+        uids = jnp.where(occ, state.keys, sentinel)
+
+        fresh_keys = jnp.full((C_new,), sentinel, state.keys.dtype)
+        fresh_keys, slot_ix, created, failed = self._probe(fresh_keys, uids, occ)
+        # Survivors always fit: C_new >= live count and probing is unbounded
+        # only by max_probes — extremely unlikely to fail at <=50% load, but
+        # surface it if it happens.
+        ix = jnp.where(slot_ix >= 0, slot_ix, C_new)
+
+        def move(arr, fill):
+            out = jnp.full((C_new,) + arr.shape[1:], fill, arr.dtype)
+            return out.at[ix].set(arr, mode="drop")
+
+        return TableState(
+            keys=fresh_keys,
+            values=move(state.values, 0),
+            freq=move(state.freq, 0),
+            version=move(state.version, -1),
+            slots={
+                # Per-table scalar slots (e.g. AdamAsync beta powers, shape
+                # [1, 1]) are not per-key rows — pass them through.
+                k: (move(v, 0) if v.shape[0] == state.capacity else v)
+                for k, v in state.slots.items()
+            },
+            bloom=state.bloom,
+            dirty=move(state.dirty, False),
+            insert_fails=jnp.sum(failed).astype(jnp.int32),
+        )
+
+    def evict(self, state: TableState, step: jnp.ndarray | int) -> TableState:
+        return _evict_jit(self, state, jnp.asarray(step, jnp.int32))
+
+    def grow(self, state: TableState, new_capacity: int) -> TableState:
+        """Host-orchestrated growth (recompiles downstream jits once per
+        capacity — the price of dynamic tables in a static-shape world)."""
+        return self.rebuild(state, new_capacity=new_capacity)
+
+
+# --------------------------------------------------------------------------
+# Jitted trampolines: public methods route through these so eager callers
+# (tests, serving glue) hit the compile cache instead of op-by-op dispatch.
+# Inside a user jit they inline into the surrounding program.
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))
+def _lookup_unique_jit(table, state, ids, step, train, pad_value, unique_size):
+    return table._lookup_unique_impl(state, ids, step, train, pad_value, unique_size)
+
+
+@_functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _lookup_readonly_jit(table, state, ids, pad_value, salt):
+    return table._lookup_readonly_impl(state, ids, pad_value, salt)
+
+
+@_functools.partial(jax.jit, static_argnums=(0,))
+def _evict_jit(table, state, step):
+    drop = table.evict_mask(state, step)
+    return table.rebuild(state, keep=~drop)

@@ -1,0 +1,75 @@
+"""Integer hashing utilities for hash-embedding tables.
+
+TPU-native notes: everything here is vectorized uint32 arithmetic (VPU friendly,
+no 64-bit emulation on the hot path). 64-bit keys are folded to 32 bits before
+mixing; the table itself stores the full-width key for exact matching, so the
+fold only affects probe-start distribution, never correctness.
+
+Reference parity: DeepRec hashes keys inside its lockless CPU maps
+(/root/reference/tensorflow/core/framework/embedding/cpu_hash_map_kv.h) and via
+cuco on GPU (gpu_hash_table.cu.cc). Here hashing is explicit because the probe
+sequence is computed in compiled XLA/Pallas code.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def name_salt(name: str) -> int:
+    """Stable per-name initializer salt. THE single definition — training
+    (Bundle.salts) and serving (lookup_readonly) must agree on it, or grouped
+    tables would serve different initializer vectors than training created."""
+    import zlib
+
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
+def fold64(ids: jnp.ndarray) -> jnp.ndarray:
+    """Fold integer ids of any width to uint32 for hashing."""
+    if ids.dtype in (jnp.int64, jnp.uint64):
+        lo = ids.astype(jnp.uint32)
+        hi = (ids >> 32).astype(jnp.uint32)
+        return lo ^ (hi * jnp.uint32(0x9E3779B9))
+    return ids.astype(jnp.uint32)
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer: a fast, well-distributed 32-bit mixer (VPU ops only)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_to_bucket(ids: jnp.ndarray, num_buckets: int, salt: int = 0) -> jnp.ndarray:
+    """Hash ids into [0, num_buckets). num_buckets must be a power of two."""
+    assert num_buckets > 0 and (num_buckets & (num_buckets - 1)) == 0, (
+        f"num_buckets must be a power of two, got {num_buckets}"
+    )
+    h = mix32(fold64(ids) ^ jnp.uint32(salt))
+    return (h & jnp.uint32(num_buckets - 1)).astype(jnp.int32)
+
+
+def hash_shard(ids: jnp.ndarray, num_shards: int) -> jnp.ndarray:
+    """Owner shard of each id for model-parallel sharded tables (any num_shards)."""
+    h = mix32(fold64(ids))
+    # num_shards is usually a small power of two; modulo is fine either way.
+    return (h % jnp.uint32(num_shards)).astype(jnp.int32)
+
+
+def stateless_uniform_from_ids(
+    ids: jnp.ndarray, salt, dtype=jnp.float32
+) -> jnp.ndarray:
+    """Deterministic per-id uniform in [0, 1) — used by per-key initializers.
+
+    Being a pure function of (id, salt) makes initialization reproducible
+    across shards, restarts and table growth without threading PRNG state
+    through the lookup path. `salt` may be a python int or a traced scalar
+    (grouped tables pass a per-table salt through vmap).
+    """
+    bits = mix32(fold64(ids) ^ mix32(jnp.asarray(salt).astype(jnp.uint32)))
+    # 24 high bits -> [0, 1) float
+    return (bits >> 8).astype(dtype) * dtype(1.0 / (1 << 24))

@@ -1,0 +1,248 @@
+"""Core hash-embedding table tests — the CRUD/filter/eviction coverage of
+DeepRec's embedding_variable_ops_test (reference: core/kernels/
+embedding_variable_ops_test.cc, python/ops/embedding_variable_ops_test.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeprec_tpu import (
+    CBFFilter,
+    CounterFilter,
+    EmbeddingTable,
+    EmbeddingVariableOption,
+    GlobalStepEvict,
+    InitializerOption,
+    L2WeightEvict,
+    TableConfig,
+    combine,
+)
+
+
+def make_table(**kw):
+    base = dict(name="t", dim=8, capacity=256)
+    base.update(kw)
+    return EmbeddingTable(TableConfig(**base))
+
+
+def test_create_and_lookup_inserts_keys():
+    t = make_table()
+    s = t.create()
+    ids = jnp.array([3, 7, 3, 11, 7, 3], jnp.int32)
+    s, res = t.lookup_unique(s, ids, step=1)
+    assert int(t.size(s)) == 3
+    # all real ids resolved to distinct slots
+    valid = np.asarray(res.valid)
+    slots = np.asarray(res.slot_ix)[valid]
+    assert (slots >= 0).all()
+    assert len(set(slots.tolist())) == len(slots)
+    # counts reflect duplication
+    uids = np.asarray(res.uids)
+    counts = {int(u): int(c) for u, c, v in zip(uids, np.asarray(res.counts), valid) if v}
+    assert counts == {3: 3, 7: 2, 11: 1}
+
+
+def test_lookup_is_stable_across_calls():
+    t = make_table()
+    s = t.create()
+    ids = jnp.arange(32, dtype=jnp.int32)
+    s, r1 = t.lookup_unique(s, ids, step=1)
+    s, r2 = t.lookup_unique(s, ids, step=2)
+    np.testing.assert_array_equal(np.asarray(r1.slot_ix), np.asarray(r2.slot_ix))
+    np.testing.assert_allclose(
+        np.asarray(r1.embeddings), np.asarray(r2.embeddings), rtol=1e-6
+    )
+    assert int(t.size(s)) == 32
+
+
+def test_initializer_deterministic_per_key():
+    t = make_table()
+    s1 = t.create()
+    s2 = t.create()
+    ids = jnp.array([5, 9], jnp.int32)
+    # insert in different orders / tables — same key must get same init value
+    s1, ra = t.lookup_unique(s1, ids)
+    s2, rb = t.lookup_unique(s2, jnp.array([9, 100, 5], jnp.int32))
+    ua, ea = np.asarray(ra.uids), np.asarray(ra.embeddings)
+    ub, eb = np.asarray(rb.uids), np.asarray(rb.embeddings)
+    for k in (5, 9):
+        va = ea[list(ua).index(k)]
+        vb = eb[list(ub).index(k)]
+        np.testing.assert_allclose(va, vb, rtol=1e-6)
+    # init values look like N(0, 0.05): nonzero, small
+    assert 0 < np.abs(ea).mean() < 0.2
+
+
+def test_padding_ignored():
+    t = make_table()
+    s = t.create()
+    ids = jnp.array([[1, 2, -1], [3, -1, -1]], jnp.int32)
+    s, res = t.lookup_unique(s, ids, step=0)
+    assert int(t.size(s)) == 3
+    assert int(jnp.sum(res.counts)) == 3
+
+
+def test_collision_heavy_insert_all_resolve():
+    # capacity 64, insert 48 ids (75% load) — all must land via probing
+    t = make_table(capacity=64)
+    s = t.create()
+    ids = jnp.arange(48, dtype=jnp.int32) * 7919  # scattered hashes
+    s, res = t.lookup_unique(s, ids)
+    assert int(t.size(s)) == 48
+    assert int(s.insert_fails) == 0
+    slots = np.asarray(res.slot_ix)[np.asarray(res.valid)]
+    assert len(set(slots.tolist())) == 48
+
+
+def test_table_full_reports_fails():
+    t = make_table(capacity=16, max_probes=16)
+    s = t.create()
+    s, _ = t.lookup_unique(s, jnp.arange(16, dtype=jnp.int32) * 13)
+    s, res = t.lookup_unique(s, (jnp.arange(8, dtype=jnp.int32) + 100) * 17)
+    assert int(s.insert_fails) > 0
+    # failed ids serve the no-permission default (0) and slot -1
+    failed = np.asarray(res.slot_ix) < 0
+    assert failed.any()
+
+
+def test_freq_and_version_tracking():
+    t = make_table()
+    s = t.create()
+    s, r1 = t.lookup_unique(s, jnp.array([42, 42, 7], jnp.int32), step=5)
+    s, r2 = t.lookup_unique(s, jnp.array([42], jnp.int32), step=9)
+    slot42 = int(np.asarray(r2.slot_ix)[list(np.asarray(r2.uids)).index(42)])
+    assert int(s.freq[slot42]) == 3
+    assert int(s.version[slot42]) == 9
+
+
+def test_counter_filter_blocks_until_threshold():
+    t = make_table(
+        ev=EmbeddingVariableOption(counter_filter=CounterFilter(filter_freq=3))
+    )
+    s = t.create()
+    ids = jnp.array([77], jnp.int32)
+    s, r1 = t.lookup_unique(s, ids, step=0)  # freq 1: blocked
+    s, r2 = t.lookup_unique(s, ids, step=1)  # freq 2: blocked
+    s, r3 = t.lookup_unique(s, ids, step=2)  # freq 3: admitted
+    i = list(np.asarray(r1.uids)).index(77)
+    assert not bool(r1.admitted[i]) and not bool(r2.admitted[i])
+    assert bool(r3.admitted[i])
+    np.testing.assert_allclose(np.asarray(r1.embeddings[i]), 0.0)
+    assert np.abs(np.asarray(r3.embeddings[i])).max() > 0
+
+
+def test_cbf_filter_defers_slot_allocation():
+    t = make_table(
+        ev=EmbeddingVariableOption(
+            cbf_filter=CBFFilter(filter_freq=2, max_element_size=1 << 12)
+        )
+    )
+    s = t.create()
+    ids = jnp.array([123], jnp.int32)
+    s, r1 = t.lookup_unique(s, ids)
+    assert int(t.size(s)) == 0  # below threshold: no slot consumed
+    s, r2 = t.lookup_unique(s, ids)
+    assert int(t.size(s)) == 1  # sketch count reached 2: admitted + created
+    i = list(np.asarray(r2.uids)).index(123)
+    assert int(r2.slot_ix[i]) >= 0
+
+
+def test_global_step_eviction():
+    t = make_table(
+        ev=EmbeddingVariableOption(global_step_evict=GlobalStepEvict(steps_to_live=10))
+    )
+    s = t.create()
+    s, _ = t.lookup_unique(s, jnp.array([1, 2], jnp.int32), step=0)
+    s, _ = t.lookup_unique(s, jnp.array([2], jnp.int32), step=50)
+    s = t.evict(s, step=55)
+    assert int(t.size(s)) == 1  # key 1 (version 0) expired; key 2 survives
+    # survivor still resolvable with its value intact
+    s2, res = t.lookup_unique(s, jnp.array([2], jnp.int32), step=55)
+    i = list(np.asarray(res.uids)).index(2)
+    assert int(res.slot_ix[i]) >= 0
+
+
+def test_l2_eviction():
+    t = make_table(
+        ev=EmbeddingVariableOption(l2_weight_evict=L2WeightEvict(l2_weight_threshold=0.5))
+    )
+    s = t.create()
+    s, res = t.lookup_unique(s, jnp.array([1, 2], jnp.int32))
+    # force key 1 tiny, key 2 large
+    ix = {int(u): int(sl) for u, sl in zip(np.asarray(res.uids), np.asarray(res.slot_ix))}
+    vals = s.values.at[ix[1]].set(0.001).at[ix[2]].set(1.0)
+    s = s.replace(values=vals)
+    s = t.evict(s, step=0)
+    assert int(t.size(s)) == 1
+
+
+def test_rebuild_preserves_values_and_grow():
+    t = make_table(capacity=64)
+    s = t.create()
+    ids = jnp.arange(40, dtype=jnp.int32) * 3 + 1
+    s, r1 = t.lookup_unique(s, ids, step=2)
+    before = {
+        int(u): np.asarray(r1.embeddings)[i]
+        for i, u in enumerate(np.asarray(r1.uids))
+        if bool(r1.valid[i])
+    }
+    s = t.grow(s, 256)
+    assert s.capacity == 256
+    assert int(t.size(s)) == 40
+    t2 = EmbeddingTable(TableConfig(name="t", dim=8, capacity=256))
+    s, r2 = t2.lookup_unique(s, ids, step=3)
+    for i, u in enumerate(np.asarray(r2.uids)):
+        if bool(r2.valid[i]):
+            np.testing.assert_allclose(
+                np.asarray(r2.embeddings)[i], before[int(u)], rtol=1e-6
+            )
+
+
+def test_scatter_update_and_dirty_tracking():
+    t = make_table()
+    s = t.create()
+    s, res = t.lookup_unique(s, jnp.array([5, 6], jnp.int32))
+    s = s.replace(dirty=jnp.zeros_like(s.dirty))  # simulate post-save reset
+    new_vals = jnp.ones_like(res.embeddings)
+    s = t.scatter_update(s, res.slot_ix, new_vals, mask=res.valid)
+    assert int(jnp.sum(s.dirty)) == 2
+    emb = t.lookup_readonly(s, jnp.array([5], jnp.int32))
+    np.testing.assert_allclose(np.asarray(emb[0]), 1.0)
+
+
+def test_readonly_missing_serves_initializer():
+    t = make_table()
+    s = t.create()
+    emb = t.lookup_readonly(s, jnp.array([999, -1], jnp.int32))
+    assert np.abs(np.asarray(emb[0])).max() > 0  # initializer value
+    np.testing.assert_allclose(np.asarray(emb[1]), 0.0)  # padding -> zeros
+
+
+def test_combiners():
+    emb_u = jnp.array([[1.0, 1.0], [2.0, 2.0], [0.0, 0.0]])
+    inverse = jnp.array([[0, 1], [1, 2]])
+    mask = jnp.array([[True, True], [True, False]])
+    np.testing.assert_allclose(
+        np.asarray(combine(emb_u, inverse, mask, "sum")), [[3, 3], [2, 2]]
+    )
+    np.testing.assert_allclose(
+        np.asarray(combine(emb_u, inverse, mask, "mean")), [[1.5, 1.5], [2, 2]]
+    )
+    np.testing.assert_allclose(
+        np.asarray(combine(emb_u, inverse, mask, "sqrtn")),
+        [[3 / np.sqrt(2), 3 / np.sqrt(2)], [2, 2]],
+    )
+
+
+def test_lookup_jits_and_donates():
+    t = make_table()
+
+    @jax.jit
+    def step(s, ids):
+        s, res = t.lookup_unique(s, ids, step=0)
+        return s, res.embeddings
+
+    s = t.create()
+    s, e1 = step(s, jnp.array([1, 2, 3], jnp.int32))
+    s, e2 = step(s, jnp.array([3, 4, 5], jnp.int32))
+    assert int(t.size(s)) == 5
