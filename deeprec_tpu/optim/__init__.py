@@ -1,0 +1,13 @@
+from deeprec_tpu.optim.sparse import (
+    REGISTRY,
+    Adagrad,
+    AdagradDecay,
+    Adam,
+    AdamAsync,
+    AdamW,
+    Ftrl,
+    GradientDescent,
+    SparseOptimizer,
+    make,
+)
+from deeprec_tpu.optim.apply import apply_gradients, ensure_slots

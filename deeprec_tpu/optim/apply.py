@@ -1,0 +1,83 @@
+"""Applying sparse gradients to a table — the KvResourceSparseApply* executor.
+
+Pipeline (mirrors DeepRec's backward path, SURVEY.md §3.1): autodiff produces
+gradients w.r.t. the *unique* gathered embeddings; this module gathers the
+matching value/slot rows, runs the optimizer row-function, masks out invalid /
+filter-blocked keys, and scatters everything back. One fused pass over [U, D].
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup
+from deeprec_tpu.optim.sparse import SCALAR_PREFIX, SparseOptimizer
+
+
+def ensure_slots(
+    table: EmbeddingTable, state: TableState, opt: SparseOptimizer
+) -> TableState:
+    """Create the optimizer's slot arrays for this table (idempotent).
+
+    The analog of slot-variable creation in DeepRec's optimizers
+    (python/training/adam_async.py etc.), with slots packed next to values.
+    """
+    C, D = state.capacity, state.dim
+    slots = dict(state.slots)
+    for name, (shape, init) in opt.slot_specs(D).items():
+        if name in slots:
+            continue
+        if name.startswith(SCALAR_PREFIX):
+            slots[name] = jnp.full((1, 1), init, jnp.float32)
+        else:
+            slots[name] = jnp.full((C,) + tuple(shape), init, jnp.float32)
+    return state.replace(slots=slots)
+
+
+def apply_gradients(
+    table: EmbeddingTable,
+    state: TableState,
+    opt: SparseOptimizer,
+    res: UniqueLookup,
+    grad_u: jnp.ndarray,  # [U, D] grads w.r.t. res.embeddings
+    *,
+    step: jnp.ndarray | int = 0,
+    lr: Optional[jnp.ndarray | float] = None,
+    grad_averaging: bool = False,
+) -> TableState:
+    """Update the touched rows of `state` in one gather→compute→scatter pass."""
+    step = jnp.asarray(step, jnp.int32)
+    lr = jnp.asarray(opt.lr if lr is None else lr, jnp.float32)
+
+    ok = (res.slot_ix >= 0) & res.valid & res.admitted  # [U]
+    safe_ix = jnp.where(ok, res.slot_ix, 0)
+    drop_ix = jnp.where(ok, res.slot_ix, state.capacity)
+
+    grad = grad_u.astype(jnp.float32)
+    if grad_averaging:
+        grad = grad / jnp.maximum(res.counts.astype(jnp.float32), 1.0)[:, None]
+
+    value = state.values.at[safe_ix].get(mode="clip").astype(jnp.float32)
+    row_slots: Dict[str, jnp.ndarray] = {}
+    for name, arr in state.slots.items():
+        if name.startswith(SCALAR_PREFIX):
+            row_slots[name] = arr  # [1, 1] per-table scalar, passed through
+        else:
+            row_slots[name] = arr.at[safe_ix].get(mode="clip")
+
+    new_value, new_slots = opt.update(value, row_slots, grad, res.counts, step, lr)
+
+    values = state.values.at[drop_ix].set(
+        new_value.astype(state.values.dtype), mode="drop"
+    )
+    slots = dict(state.slots)
+    for name, rows in new_slots.items():
+        if name.startswith(SCALAR_PREFIX):
+            slots[name] = rows
+        else:
+            slots[name] = state.slots[name].at[drop_ix].set(rows, mode="drop")
+    dirty = state.dirty.at[drop_ix].set(True, mode="drop")
+    version = state.version.at[drop_ix].set(step, mode="drop")
+    return state.replace(values=values, slots=slots, dirty=dirty, version=version)
