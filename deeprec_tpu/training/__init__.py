@@ -1,0 +1,8 @@
+from deeprec_tpu.training.trainer import ModelInputs, Trainer, TrainState
+from deeprec_tpu.training.metrics import (
+    AucState,
+    accuracy,
+    auc_compute,
+    auc_update,
+    bce_loss,
+)

@@ -1,0 +1,55 @@
+"""Streaming metrics. The benchmark harness asserts AUC/ACC scraped from
+logs (reference: modelzoo/benchmark/*/log_process.py), so AUC must be
+computable online without holding all predictions: histogram-based streaming
+AUC (the same approach tf.metrics.auc uses, with fixed thresholds bins)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+NUM_BINS = 512
+
+
+@struct.dataclass
+class AucState:
+    pos: jnp.ndarray  # [NUM_BINS] float32 — positive-label prob histogram
+    neg: jnp.ndarray  # [NUM_BINS]
+
+    @classmethod
+    def create(cls) -> "AucState":
+        z = jnp.zeros((NUM_BINS,), jnp.float32)
+        return cls(pos=z, neg=z)
+
+
+def auc_update(state: AucState, probs: jnp.ndarray, labels: jnp.ndarray) -> AucState:
+    probs = probs.reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    bins = jnp.clip((probs * NUM_BINS).astype(jnp.int32), 0, NUM_BINS - 1)
+    pos = state.pos.at[bins].add(labels)
+    neg = state.neg.at[bins].add(1.0 - labels)
+    return AucState(pos=pos, neg=neg)
+
+
+def auc_compute(state: AucState) -> jnp.ndarray:
+    """Probability a random positive outranks a random negative, from the
+    histograms (ties get half credit)."""
+    P = jnp.sum(state.pos)
+    N = jnp.sum(state.neg)
+    neg_below = jnp.cumsum(state.neg) - state.neg
+    wins = jnp.sum(state.pos * neg_below) + 0.5 * jnp.sum(state.pos * state.neg)
+    return jnp.where((P > 0) & (N > 0), wins / (P * N), 0.5)
+
+
+def accuracy(probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    pred = (probs.reshape(-1) >= 0.5).astype(jnp.float32)
+    return jnp.mean((pred == labels.reshape(-1).astype(jnp.float32)).astype(jnp.float32))
+
+
+def bce_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Numerically stable sigmoid cross-entropy."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0.0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )

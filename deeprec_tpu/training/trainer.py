@@ -1,0 +1,347 @@
+"""Generic train/eval step over (hash tables + dense params).
+
+The structural translation of DeepRec's session-run training (SURVEY.md §3.1):
+one jitted function per step performs — sparse lookups (with insertion,
+frequency, admission), the dense forward/backward, the fused sparse applies
+and the dense optimizer update. XLA sees the whole step as one program, which
+is what replaces DeepRec's executor/cost-model machinery
+(docs/docs_en/Executor-Optimization.md) on TPU.
+
+GroupEmbedding is built in: features whose tables share a config and id shape
+are automatically *bundled* — their states stack along a leading table axis
+and a single vmapped lookup/apply serves all of them, exactly the
+N-lookups-in-one-kernel optimization of DeepRec's GroupEmbeddingVarLookup
+(core/ops/kv_variable_ops.cc:404; docs/docs_en/Group-Embedding.md), and it
+also keeps the compiled program small (one probe loop, not one per feature).
+
+Models are plain objects exposing:
+    features: Sequence[SparseFeature | DenseFeature]
+    init(key) -> dense params (pytree)
+    apply(params, inputs: ModelInputs, train: bool) -> logits [B] or
+        {task: logits} for multi-task models (labels then come from
+        batch['label_<task>']).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from deeprec_tpu import features as fcol
+from deeprec_tpu.embedding import combiners
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState
+from deeprec_tpu.features import SparseFeature
+from deeprec_tpu.optim.apply import apply_gradients, ensure_slots
+from deeprec_tpu.optim.sparse import SparseOptimizer
+from deeprec_tpu.training import metrics as M
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray  # [] int32 global step
+    tables: Dict[str, TableState]  # bundle name -> (stacked) table state
+    dense: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class Bundle:
+    """A set of features served by one (possibly stacked) table state.
+
+    stacked=True: `table` holds the shared per-member config; state arrays
+    carry a leading [T] table axis and lookups/applies are vmapped over it.
+    stacked=False: a single table, optionally shared by several features
+    (shared_embedding semantics) which then look up sequentially.
+    """
+
+    name: str
+    table: EmbeddingTable
+    features: List[SparseFeature]
+    stacked: bool
+
+    @property
+    def salts(self):
+        from deeprec_tpu.utils.hashing import name_salt
+
+        return jnp.asarray([name_salt(f.name) for f in self.features], jnp.uint32)
+
+
+def build_bundles(specs) -> Dict[str, Bundle]:
+    """Group single-use tables by (config-sans-name, id rank/pad); keep
+    shared tables as individual bundles."""
+    sparse = fcol.sparse_features(specs)
+    by_table: Dict[str, List[SparseFeature]] = {}
+    for f in sparse:
+        by_table.setdefault(fcol.resolve_table_name(f), []).append(f)
+    cfgs = fcol.table_configs(specs)
+
+    bundles: Dict[str, Bundle] = {}
+    groups: Dict[tuple, List[SparseFeature]] = {}
+    for tname, feats in by_table.items():
+        cfg = cfgs[tname]
+        if len(feats) > 1:
+            bundles[tname] = Bundle(tname, EmbeddingTable(cfg), feats, False)
+        else:
+            f = feats[0]
+            # Pooling kind + declared max_len separate sequence features
+            # ([B, L] ids) from scalar bags so stacked shapes stay compatible
+            # (a runtime shape check in _lookup_all backstops undeclared L).
+            key = (dataclasses.replace(cfg, name="_"), f.pad_value, f.pooling,
+                   f.max_len)
+            groups.setdefault(key, []).append(f)
+    for i, (key, feats) in enumerate(sorted(groups.items(), key=lambda kv: kv[1][0].name)):
+        if len(feats) == 1:
+            f = feats[0]
+            tname = fcol.resolve_table_name(f)
+            bundles[tname] = Bundle(tname, EmbeddingTable(cfgs[tname]), feats, False)
+        else:
+            cfg = dataclasses.replace(key[0], name=f"group{i}")
+            bundles[cfg.name] = Bundle(cfg.name, EmbeddingTable(cfg), feats, True)
+    return bundles
+
+
+@dataclasses.dataclass
+class ModelInputs:
+    """What the model's apply() receives each step."""
+
+    pooled: Dict[str, jnp.ndarray]  # feature -> [B, D]
+    seq: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]  # feature -> ([B,L,D], [B,L] mask)
+    dense: Dict[str, jnp.ndarray]  # feature -> [B, W]
+
+
+def _prep_ids(ids):
+    return ids[:, None] if ids.ndim == 1 else ids
+
+
+# Module-level so repeated evaluate() calls hit one compile cache.
+_jit_auc_update = jax.jit(M.auc_update)
+
+
+class Trainer:
+    def __init__(
+        self,
+        model,
+        sparse_opt: SparseOptimizer,
+        dense_opt: Optional[optax.GradientTransformation] = None,
+        grad_averaging: bool = False,
+    ):
+        self.model = model
+        self.sparse_opt = sparse_opt
+        self.dense_opt = dense_opt or optax.adam(1e-3)
+        self.grad_averaging = grad_averaging
+        self.sparse_specs = fcol.sparse_features(model.features)
+        self.dense_specs = fcol.dense_features(model.features)
+        self.bundles = build_bundles(model.features)
+        self._train_step = jax.jit(self._step_impl, donate_argnums=0)
+        self._eval_step = jax.jit(self._eval_impl)
+
+    # Back-compat/introspection: table object + state accessor per table name.
+    @property
+    def tables(self) -> Dict[str, EmbeddingTable]:
+        out = {}
+        for b in self.bundles.values():
+            for f in b.features:
+                out[fcol.resolve_table_name(f)] = b.table
+        return out
+
+    def table_state(self, state: TrainState, table_name: str) -> TableState:
+        """Extract the (unstacked) state of one named table."""
+        for b in self.bundles.values():
+            for k, f in enumerate(b.features):
+                if fcol.resolve_table_name(f) == table_name:
+                    ts = state.tables[b.name]
+                    return jax.tree.map(lambda a: a[k], ts) if b.stacked else ts
+        raise KeyError(table_name)
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, seed: int = 0) -> TrainState:
+        key = jax.random.PRNGKey(seed)
+        dense = self.model.init(key)
+        tables = {}
+        for bname, b in self.bundles.items():
+            local = ensure_slots(b.table, b.table.create(), self.sparse_opt)
+            if b.stacked:
+                T = len(b.features)
+                local = jax.tree.map(lambda a: jnp.stack([a] * T), local)
+            tables[bname] = local
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            tables=tables,
+            dense=dense,
+            opt_state=self.dense_opt.init(dense),
+        )
+
+    # ------------------------------------------------------------- internals
+    #
+    # _lookup_one/_apply_one are the per-bundle primitives; ShardedTrainer
+    # overrides just these two to swap in the collective path, so the
+    # bundling/stacking control flow below exists exactly once.
+
+    def _lookup_one(self, b: Bundle, state, ids, pad, salt, step, train):
+        return b.table._lookup_unique_impl(
+            state, ids, step, train, pad, None, salt=salt
+        )
+
+    def _apply_one(self, b: Bundle, state, res, grad, step, lr):
+        return apply_gradients(
+            b.table, state, self.sparse_opt, res, grad, step=step, lr=lr,
+            grad_averaging=self.grad_averaging,
+        )
+
+    def _lookup_all(self, tables, batch, step, train):
+        """Run every bundle's lookup. Returns (tables, per-feature views,
+        per-bundle stacked results for the backward pass)."""
+        views = {}  # feature -> (embeddings [U,D], inverse, mask)
+        bundle_res = {}  # bundle -> stacked result
+        for bname, b in self.bundles.items():
+            if b.stacked:
+                shapes = {f.name: _prep_ids(batch[f.name]).shape for f in b.features}
+                if len(set(shapes.values())) > 1:
+                    raise ValueError(
+                        f"grouped features have mismatched id shapes {shapes}; "
+                        "declare distinct SparseFeature.max_len values to keep "
+                        "them in separate embedding groups"
+                    )
+                ids = jnp.stack([_prep_ids(batch[f.name]) for f in b.features])
+                pad = b.features[0].pad_value
+                masks = ids != jnp.asarray(pad, ids.dtype)
+
+                def one(s, i, sa, b=b, pad=pad):
+                    return self._lookup_one(b, s, i, pad, sa, step, train)
+
+                tables[bname], res = jax.vmap(one)(tables[bname], ids, b.salts)
+                bundle_res[bname] = res
+                for k, f in enumerate(b.features):
+                    views[f.name] = (
+                        res.embeddings[k],
+                        res.inverse[k],
+                        masks[k],
+                    )
+            else:
+                for f in b.features:
+                    ids = _prep_ids(batch[f.name])
+                    mask = ids != jnp.asarray(f.pad_value, ids.dtype)
+                    tables[bname], res = self._lookup_one(
+                        b, tables[bname], ids, f.pad_value, None, step, train
+                    )
+                    bundle_res.setdefault(bname, {})[f.name] = res
+                    views[f.name] = (res.embeddings, res.inverse, mask)
+        return tables, views, bundle_res
+
+    def _build_inputs(self, embs, views, batch) -> ModelInputs:
+        pooled, seq = {}, {}
+        for f in self.sparse_specs:
+            _, inverse, mask = views[f.name]
+            e_u = embs[f.name]
+            if f.pooling == "none":
+                e = e_u[inverse]  # [B, L, D]
+                seq[f.name] = (jnp.where(mask[..., None], e, 0.0), mask)
+            else:
+                pooled[f.name] = combiners.combine(e_u, inverse, mask, f.pooling)
+        dense = {f.name: batch[f.name] for f in self.dense_specs}
+        return ModelInputs(pooled=pooled, seq=seq, dense=dense)
+
+    def _apply_all(self, tables, bundle_res, g_embs, step, lr):
+        for bname, b in self.bundles.items():
+            if b.stacked:
+                res = bundle_res[bname]
+                grads = jnp.stack([g_embs[f.name] for f in b.features])
+
+                def one(s, r, g, b=b):
+                    return self._apply_one(b, s, r, g, step, lr)
+
+                tables[bname] = jax.vmap(one)(tables[bname], res, grads)
+            else:
+                for f in b.features:
+                    tables[bname] = self._apply_one(
+                        b, tables[bname], bundle_res[bname][f.name],
+                        g_embs[f.name], step, lr,
+                    )
+        return tables
+
+    def _loss_from_logits(self, out, batch):
+        if isinstance(out, dict):
+            losses = {
+                task: M.bce_loss(logits, batch[f"label_{task}"])
+                for task, logits in out.items()
+            }
+            return sum(losses.values()), out
+        return M.bce_loss(out, batch["label"]), out
+
+    def _step_impl(self, state: TrainState, batch, lr):
+        step = state.step
+        tables = dict(state.tables)
+        tables, views, bundle_res = self._lookup_all(tables, batch, step, True)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+        def loss_fn(dense, embs):
+            inputs = self._build_inputs(embs, views, batch)
+            out = self.model.apply(dense, inputs, train=True)
+            loss, out = self._loss_from_logits(out, batch)
+            return loss, out
+
+        (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.dense, embs)
+
+        updates, opt_state = self.dense_opt.update(g_dense, state.opt_state,
+                                                   state.dense)
+        dense = optax.apply_updates(state.dense, updates)
+        tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
+
+        new_state = TrainState(
+            step=step + 1, tables=tables, dense=dense, opt_state=opt_state
+        )
+        mets = {"loss": loss}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = M.accuracy(probs, batch["label"])
+        return new_state, mets
+
+    def _eval_impl(self, state: TrainState, batch):
+        tables = dict(state.tables)
+        tables, views, _ = self._lookup_all(tables, batch, state.step, False)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+        inputs = self._build_inputs(embs, views, batch)
+        out = self.model.apply(state.dense, inputs, train=False)
+        loss, out = self._loss_from_logits(out, batch)
+        if isinstance(out, dict):
+            probs = {k: jax.nn.sigmoid(v) for k, v in out.items()}
+        else:
+            probs = jax.nn.sigmoid(out)
+        return loss, probs
+
+    # --------------------------------------------------------------- public
+
+    def train_step(self, state: TrainState, batch, lr: Optional[float] = None):
+        # lr always rides as a traced scalar so schedules never recompile.
+        lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
+        return self._train_step(state, batch, lr)
+
+    def eval_step(self, state: TrainState, batch):
+        return self._eval_step(state, batch)
+
+    def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
+        """Streamed AUC/loss over an iterable of batches. Multi-task models
+        report one AUC per task (labels under 'label_<task>')."""
+        aucs: Dict[str, M.AucState] = {}
+        total, n = 0.0, 0
+        upd = _jit_auc_update
+        for batch in batches:
+            loss, probs = self.eval_step(state, batch)
+            task_probs = probs if isinstance(probs, dict) else {"": probs}
+            for task, p in task_probs.items():
+                label = batch[f"label_{task}"] if task else batch["label"]
+                aucs.setdefault(task, M.AucState.create())
+                aucs[task] = upd(aucs[task], p, label)
+            total += float(loss)
+            n += 1
+        out = {"loss": total / max(n, 1)}
+        for task, st in aucs.items():
+            out[f"auc_{task}" if task else "auc"] = float(M.auc_compute(st))
+        return out
