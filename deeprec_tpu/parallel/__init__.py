@@ -1,0 +1,3 @@
+from deeprec_tpu.parallel.mesh import make_mesh, shard_batch
+from deeprec_tpu.parallel.sharded import ShardedLookup, ShardedTable
+from deeprec_tpu.parallel.trainer import ShardedTrainer

@@ -1,0 +1,28 @@
+"""Device-mesh helpers.
+
+The distributed design (SURVEY.md §2.5/§7): one mesh axis `data` carries both
+data parallelism (batch split across all devices) and embedding model
+parallelism (tables hash-sharded across the same devices) — exactly the
+topology of DeepRec's CollectiveStrategy scope()/embedding_scope() over
+HybridBackend/SOK (group_embedding_collective_strategy.py:29-108), with the
+NVLink/NCCL exchanges replaced by XLA collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def shard_batch(mesh: Mesh, batch: dict, axis: str = "data") -> dict:
+    """Place a host batch with batch-dim sharding over the mesh."""
+    sharding = NamedSharding(mesh, P(axis))
+    return {k: jax.device_put(v, sharding) for k, v in batch.items()}

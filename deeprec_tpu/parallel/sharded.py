@@ -1,0 +1,166 @@
+"""Pod-sharded embedding tables: lookup/apply inside shard_map.
+
+This is the subsystem that dissolves DeepRec's distributed parameter plane —
+the async-PS graph partitioning, the seastar/GRPC++ data plane
+(contrib/star/*), StarServer's lock-free PS runtime and SOK's embedding
+all2all (addons/sparse_operation_kit) — into compiled XLA collectives over
+ICI (SURVEY.md §2.5, §3.5).
+
+Design (per table, inside one `shard_map` region spanning the train step):
+
+  forward:
+    local ids --unique--> local uniques U
+    all_gather(uids)                 # tiny: G = N*U int32
+    owner mask = hash_shard(id) == my_shard
+    owner-side global dedup + lookup_or_create on the LOCAL shard state
+    embeddings scattered back to gathered layout, zero elsewhere
+    psum_scatter over the shard axis  ->  [U, D] local unique embeddings
+  backward:
+    all_gather(grad_u)               # [G, D]
+    segment-sum into owner-unique rows (cross-replica duplicate ids merge
+    here — this is what makes the update exact synchronous SGD, unlike the
+    racy lock-free applies of StarServer)
+    one fused sparse-apply on the local shard
+
+Every collective is a single XLA op riding ICI; there is no parameter-server
+process, no RPC stack, no send/recv graph partitioning.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup, empty_key
+from deeprec_tpu.optim import apply as optim_apply
+from deeprec_tpu.optim.sparse import SparseOptimizer
+from deeprec_tpu.utils import hashing
+
+
+@struct.dataclass
+class ShardedLookup:
+    """Per-device result of a sharded lookup (lives inside shard_map)."""
+
+    inverse: jnp.ndarray  # [B, L] position -> local unique index
+    counts: jnp.ndarray  # [U] local unique counts
+    valid: jnp.ndarray  # [U]
+    embeddings: jnp.ndarray  # [U, D] local unique embeddings
+    owner_res: UniqueLookup  # owner-side lookup (slot ids on the local shard)
+    o_inverse: jnp.ndarray  # [G] gathered-position -> owner-unique index
+    owned: jnp.ndarray  # [G] bool — rows this shard owns
+
+
+class ShardedTable:
+    """Collective lookup/apply for one table sharded over `axis` (call the
+    methods from inside a shard_map over that axis; state is the LOCAL shard's
+    TableState with capacity = global_capacity / num_shards)."""
+
+    def __init__(self, table: EmbeddingTable, num_shards: int, axis: str = "data"):
+        self.table = table
+        self.num_shards = num_shards
+        self.axis = axis
+
+    def lookup_unique(
+        self,
+        state: TableState,
+        ids: jnp.ndarray,
+        *,
+        step: jnp.ndarray | int = 0,
+        train: bool = True,
+        pad_value: int = -1,
+        salt=None,
+    ) -> Tuple[TableState, ShardedLookup]:
+        cfg = self.table.cfg
+        N = self.num_shards
+        axis = self.axis
+        sentinel = jnp.asarray(empty_key(cfg), ids.dtype)
+
+        flat = ids.reshape(-1)
+        U = flat.shape[0]
+        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
+        uids, inverse, counts = jnp.unique(
+            flat, size=U, fill_value=sentinel, return_inverse=True, return_counts=True
+        )
+        valid = uids != sentinel
+        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+
+        # Exchange unique ids (cheap: ints) so every shard sees all candidates.
+        g_uids = jax.lax.all_gather(uids, axis, tiled=True)  # [G]
+        g_counts = jax.lax.all_gather(counts, axis, tiled=True)  # [G]
+        G = g_uids.shape[0]
+        me = jax.lax.axis_index(axis)
+        owned = (hashing.hash_shard(g_uids, N) == me) & (g_uids != sentinel)
+
+        # Owner-side global dedup: the same id may arrive from many replicas.
+        o_ids = jnp.where(owned, g_uids, sentinel)
+        o_uids, o_inverse, _ = jnp.unique(
+            o_ids, size=G, fill_value=sentinel, return_inverse=True,
+            return_counts=True,
+        )
+        o_valid = o_uids != sentinel
+        o_counts = (
+            jnp.zeros((G,), jnp.int32)
+            .at[o_inverse]
+            .add(jnp.where(owned, g_counts, 0))
+        )
+        o_counts = jnp.where(o_valid, o_counts, 0)
+
+        state, res = self.table._lookup_resolved(
+            state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
+        )
+
+        # Back to gathered layout; non-owned rows contribute zero, then one
+        # reduce-scatter hands each replica its own unique rows.
+        e_g = res.embeddings[o_inverse] * owned[:, None].astype(res.embeddings.dtype)
+        emb_local = jax.lax.psum_scatter(
+            e_g.astype(jnp.float32), axis, scatter_dimension=0, tiled=True
+        )  # [U, D]
+
+        return state, ShardedLookup(
+            inverse=inverse.reshape(ids.shape),
+            counts=counts,
+            valid=valid,
+            embeddings=emb_local,
+            owner_res=res,
+            o_inverse=o_inverse,
+            owned=owned,
+        )
+
+    def apply_gradients(
+        self,
+        state: TableState,
+        opt: SparseOptimizer,
+        sl: ShardedLookup,
+        grad_u: jnp.ndarray,  # [U, D] grads w.r.t. sl.embeddings
+        *,
+        step: jnp.ndarray | int = 0,
+        lr=None,
+        grad_averaging: bool = False,
+    ) -> TableState:
+        g_g = jax.lax.all_gather(
+            grad_u.astype(jnp.float32), self.axis, tiled=True
+        )  # [G, D]
+        G, D = g_g.shape
+        o_grad = (
+            jnp.zeros((G, D), jnp.float32)
+            .at[sl.o_inverse]
+            .add(g_g * sl.owned[:, None].astype(jnp.float32))
+        )
+        # Per-replica losses are means over the LOCAL batch (B/N); summing N
+        # replicas' grads here would make the sparse step N x the
+        # single-device one while dense grads get pmean'd. Rescale so both
+        # paths see the global-batch-mean gradient.
+        o_grad = o_grad / jnp.float32(self.num_shards)
+        return optim_apply.apply_gradients(
+            self.table,
+            state,
+            opt,
+            sl.owner_res,
+            o_grad,
+            step=step,
+            lr=lr,
+            grad_averaging=grad_averaging,
+        )

@@ -1,0 +1,245 @@
+"""SPMD trainer: whole-train-step shard_map over the `data` axis.
+
+One compiled program per step does: batch-parallel dense forward/backward
+(grads psum'd over ICI), hash-sharded table lookups (all_gather ids +
+reduce-scatter embeddings) and owner-side fused sparse applies. This replaces
+DeepRec's worker/PS process split (SURVEY.md §3.2) — there is no separate
+parameter process; the "PS" is the sharded table arrays resident in each
+chip's HBM, and the "RPC" is compiled collectives.
+
+Bundled (GroupEmbedding) tables vmap the collective lookup over the table
+axis, so the ids of N tables ride ONE batched all_gather and their embeddings
+ONE batched reduce-scatter — the same batching trick as DeepRec's grouped SOK
+lookup (docs/docs_en/Group-Embedding.md).
+
+Usable identically on a real TPU mesh or on N virtual CPU devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=N) — the reference tests
+distributed behavior with in-process fake clusters the same way (SURVEY §4).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deeprec_tpu import features as fcol
+from deeprec_tpu.embedding.table import EmbeddingTable
+from deeprec_tpu.optim.apply import ensure_slots
+from deeprec_tpu.parallel.sharded import ShardedTable
+from deeprec_tpu.training import metrics as M
+from deeprec_tpu.training.trainer import (
+    Bundle,
+    ModelInputs,
+    Trainer,
+    TrainState,
+    _prep_ids,
+    build_bundles,
+)
+
+
+def _local_cfg(cfg, num_shards: int):
+    assert cfg.capacity % num_shards == 0, (
+        f"table {cfg.name}: capacity {cfg.capacity} not divisible by mesh size"
+    )
+    return dataclasses.replace(cfg, capacity=cfg.capacity // num_shards)
+
+
+class ShardedTrainer(Trainer):
+    """Drop-in Trainer over a device mesh: tables hash-sharded, batch split."""
+
+    def __init__(
+        self,
+        model,
+        sparse_opt,
+        dense_opt: Optional[optax.GradientTransformation] = None,
+        mesh: Optional[Mesh] = None,
+        axis: str = "data",
+        grad_averaging: bool = False,
+    ):
+        from deeprec_tpu.parallel.mesh import make_mesh
+
+        self.mesh = mesh or make_mesh(axis=axis)
+        self.axis = axis
+        self.num_shards = self.mesh.devices.size
+        super().__init__(model, sparse_opt, dense_opt, grad_averaging)
+        # Re-point bundles at per-shard capacities + collective wrappers.
+        for bname, b in self.bundles.items():
+            b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
+        self.sharded = {
+            bname: ShardedTable(b.table, self.num_shards, axis)
+            for bname, b in self.bundles.items()
+        }
+        self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
+        self._eval_step = jax.jit(self._sharded_eval)
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, seed: int = 0) -> TrainState:
+        key = jax.random.PRNGKey(seed)
+        dense = self.model.init(key)
+        N = self.num_shards
+        tables = {}
+        for bname, b in self.bundles.items():
+            local = ensure_slots(b.table, b.table.create(), self.sparse_opt)
+            # layout: [T?, N, C_local, ...] — shard axis right before capacity
+            local = jax.tree.map(lambda a: jnp.stack([a] * N), local)
+            if b.stacked:
+                T = len(b.features)
+                local = jax.tree.map(lambda a: jnp.stack([a] * T), local)
+                spec = P(None, self.axis)
+            else:
+                spec = P(self.axis)
+            tables[bname] = jax.device_put(local, NamedSharding(self.mesh, spec))
+        repl = NamedSharding(self.mesh, P())
+        return TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+            tables=tables,
+            dense=jax.device_put(dense, repl),
+            opt_state=jax.device_put(self.dense_opt.init(dense), repl),
+        )
+
+    # -------------------------------------------------------------- internals
+
+    def _table_spec(self, bname):
+        b = self.bundles[bname]
+        return P(None, self.axis) if b.stacked else P(self.axis)
+
+    def _specs_for(self, state: TrainState, batch):
+        ax = self.axis
+        state_spec = TrainState(
+            step=P(),
+            tables={
+                bname: jax.tree.map(lambda _: self._table_spec(bname), ts)
+                for bname, ts in state.tables.items()
+            },
+            dense=jax.tree.map(lambda _: P(), state.dense),
+            opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+        )
+        batch_spec = jax.tree.map(lambda _: P(ax), batch)
+        return state_spec, batch_spec
+
+    def _squeeze(self, bname, ts):
+        ax = 1 if self.bundles[bname].stacked else 0
+        return jax.tree.map(lambda a: jnp.squeeze(a, axis=ax), ts)
+
+    def _unsqueeze(self, bname, ts):
+        ax = 1 if self.bundles[bname].stacked else 0
+        return jax.tree.map(lambda a: jnp.expand_dims(a, axis=ax), ts)
+
+    # Per-bundle primitives: the only thing that differs from the base
+    # Trainer is that lookup/apply go through the collective ShardedTable.
+    def _lookup_one(self, b, state, ids, pad, salt, step, train):
+        return self.sharded[b.name].lookup_unique(
+            state, ids, step=step, train=train, pad_value=pad, salt=salt
+        )
+
+    def _apply_one(self, b, state, res, grad, step, lr):
+        return self.sharded[b.name].apply_gradients(
+            state, self.sparse_opt, res, grad, step=step, lr=lr,
+            grad_averaging=self.grad_averaging,
+        )
+
+    # ------------------------------------------------------------------ steps
+
+    def _sharded_step(self, state: TrainState, batch, lr):
+        state_spec, batch_spec = self._specs_for(state, batch)
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec, P()),
+            out_specs=(state_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(state, batch, lr):
+            step = state.step
+            tables = {
+                bname: self._squeeze(bname, ts)
+                for bname, ts in state.tables.items()
+            }
+            tables, views, bundle_res = self._lookup_all(
+                tables, batch, step, True
+            )
+            embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+            def loss_fn(dense, embs):
+                inputs = self._build_inputs(embs, views, batch)
+                out = self.model.apply(dense, inputs, train=True)
+                loss, out = self._loss_from_logits(out, batch)
+                return loss, out
+
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.dense, embs)
+
+            # Data-parallel dense grads: mean over replicas via ICI allreduce.
+            g_dense = jax.lax.pmean(g_dense, self.axis)
+            updates, opt_state = self.dense_opt.update(
+                g_dense, state.opt_state, state.dense
+            )
+            dense = optax.apply_updates(state.dense, updates)
+
+            tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
+
+            mets = {"loss": jax.lax.pmean(loss, self.axis)}
+            if not isinstance(out, dict):
+                probs = jax.nn.sigmoid(out)
+                mets["accuracy"] = jax.lax.pmean(
+                    M.accuracy(probs, batch["label"]), self.axis
+                )
+            else:
+                mets["accuracy"] = jnp.zeros(())
+            new_state = TrainState(
+                step=step + 1,
+                tables={
+                    bname: self._unsqueeze(bname, ts)
+                    for bname, ts in tables.items()
+                },
+                dense=dense,
+                opt_state=opt_state,
+            )
+            return new_state, mets
+
+        return run(state, batch, lr)
+
+    def _sharded_eval(self, state: TrainState, batch):
+        state_spec, batch_spec = self._specs_for(state, batch)
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(P(), P(self.axis)),
+            check_vma=False,
+        )
+        def run(state, batch):
+            tables = {
+                bname: self._squeeze(bname, ts)
+                for bname, ts in state.tables.items()
+            }
+            tables, views, _ = self._lookup_all(
+                tables, batch, state.step, False
+            )
+            embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+            inputs = self._build_inputs(embs, views, batch)
+            out = self.model.apply(state.dense, inputs, train=False)
+            loss, out = self._loss_from_logits(out, batch)
+            probs = (
+                {k: jax.nn.sigmoid(v) for k, v in out.items()}
+                if isinstance(out, dict)
+                else jax.nn.sigmoid(out)
+            )
+            return jax.lax.pmean(loss, self.axis), probs
+
+        return run(state, batch)
