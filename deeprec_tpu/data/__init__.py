@@ -1,0 +1,9 @@
+from deeprec_tpu.data.synthetic import (
+    SyntheticBehaviorSequence,
+    SyntheticCriteo,
+    SyntheticMultiTask,
+    SyntheticTwoTower,
+)
+from deeprec_tpu.data.readers import CriteoCSVReader, ParquetReader
+from deeprec_tpu.data.prefetch import Prefetcher, staged
+from deeprec_tpu.data.work_queue import WorkQueue, parse_slice

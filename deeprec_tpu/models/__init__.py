@@ -1,0 +1,10 @@
+from deeprec_tpu.models.wdl import WDL
+from deeprec_tpu.models.dlrm import DLRM
+from deeprec_tpu.models.deepfm import DeepFM
+from deeprec_tpu.models.dcn import DCNv2
+from deeprec_tpu.models.din import DIN
+from deeprec_tpu.models.dien import DIEN
+from deeprec_tpu.models.bst import BST
+from deeprec_tpu.models.dssm import DSSM
+from deeprec_tpu.models.masknet import MaskNet
+from deeprec_tpu.models.multitask import DBMTL, ESMM, MMoE, PLE, SimpleMultiTask

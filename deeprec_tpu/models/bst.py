@@ -1,0 +1,60 @@
+"""BST — Behavior Sequence Transformer (reference modelzoo/bst/train.py):
+the target item is appended to the behavior sequence, a transformer encoder
+block mixes them, and the mean-pooled encoding + user feed the MLP head.
+Learned positional embeddings as in the paper."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.models.taobao import behavior_features
+
+
+@dataclasses.dataclass
+class BST:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    heads: int = 4
+    ff: int = 128
+    blocks: int = 1
+    max_len: int = 200
+    hidden: Sequence[int] = (256, 64)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        self.features = behavior_features(self.emb_dim, self.capacity, self.ev)
+
+    def init(self, key):
+        ks = jax.random.split(key, self.blocks + 2)
+        D = 2 * self.emb_dim
+        return {
+            "pos": jax.random.normal(ks[0], (self.max_len + 1, D)) * 0.02,
+            "blocks": [
+                nn.transformer_block_init(ks[1 + i], D, self.heads, self.ff)
+                for i in range(self.blocks)
+            ],
+            "mlp": nn.mlp_init(ks[-1], self.emb_dim + D, list(self.hidden) + [1]),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        hist_i, mask = inputs.seq["hist_items"]
+        hist_c, _ = inputs.seq["hist_cats"]
+        hist = jnp.concatenate([hist_i, hist_c], axis=-1)  # [B, L, D]
+        target = jnp.concatenate(
+            [inputs.pooled["target_item"], inputs.pooled["target_cat"]], axis=-1
+        )
+        B, L, D = hist.shape
+        seq = jnp.concatenate([hist, target[:, None, :]], axis=1)  # [B, L+1, D]
+        seq = seq + params["pos"][None, : L + 1, :]
+        m = jnp.concatenate([mask, jnp.ones((B, 1), bool)], axis=1)
+        for blk in params["blocks"]:
+            seq = nn.transformer_block_apply(blk, seq, m, self.heads)
+        denom = jnp.sum(m, axis=1, keepdims=True).astype(jnp.float32)
+        pooled = jnp.sum(seq, axis=1) / jnp.maximum(denom, 1.0)
+        x = jnp.concatenate([inputs.pooled["user"], pooled], axis=-1)
+        return nn.mlp_apply(params["mlp"], x)[:, 0]

@@ -1,0 +1,55 @@
+"""DCN / DCNv2 on Criteo (/root/reference/modelzoo/{dcn,dcnv2}/train.py):
+cross network × deep tower, concatenated into the output head."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import CRITEO_CAT, CRITEO_DENSE, criteo_features
+
+
+@dataclasses.dataclass
+class DCNv2:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    cross_depth: int = 3
+    hidden: Sequence[int] = (1024, 512)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+    num_cat: int = len(CRITEO_CAT)
+    num_dense: int = len(CRITEO_DENSE)
+
+    def __post_init__(self):
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def _width(self):
+        return self.num_cat * self.emb_dim + self.num_dense
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        w = self._width()
+        return {
+            "cross": nn.crossnet_init(k1, w, self.cross_depth),
+            "deep": nn.mlp_init(k2, w, list(self.hidden)),
+            "head": nn.dense_init(k3, w + self.hidden[-1], 1),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        embs = [inputs.pooled[c] for c in self._cats]
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        x0 = jnp.concatenate(embs + [dense], axis=-1)
+        cross = nn.crossnet_apply(params["cross"], x0)
+        deep = nn.mlp_apply(params["deep"], x0, final_activation=jax.nn.relu)
+        out = nn.dense_apply(params["head"], jnp.concatenate([cross, deep], -1))
+        return out[:, 0]

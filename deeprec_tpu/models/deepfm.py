@@ -1,0 +1,55 @@
+"""DeepFM on Criteo (/root/reference/modelzoo/deepfm/train.py): FM
+second-order interactions + deep MLP over shared field embeddings."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import CRITEO_CAT, CRITEO_DENSE, criteo_features
+
+
+@dataclasses.dataclass
+class DeepFM:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    hidden: Sequence[int] = (1024, 512, 256)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+    num_cat: int = len(CRITEO_CAT)
+    num_dense: int = len(CRITEO_DENSE)
+
+    def __post_init__(self):
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        deep_in = self.num_cat * self.emb_dim + self.num_dense
+        return {
+            "deep": nn.mlp_init(k1, deep_in, list(self.hidden) + [1]),
+            "linear_w": jax.random.normal(k2, (self.num_cat + self.num_dense,))
+            * 0.01,
+            "bias": jnp.zeros(()),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        embs = jnp.stack([inputs.pooled[c] for c in self._cats], axis=1)  # [B,F,D]
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        fm = nn.fm_apply(embs)[:, 0]
+        B = embs.shape[0]
+        deep_in = jnp.concatenate([embs.reshape(B, -1), dense], axis=-1)
+        deep = nn.mlp_apply(params["deep"], deep_in)[:, 0]
+        first = (
+            jnp.concatenate([embs[:, :, 0], dense], axis=-1) @ params["linear_w"]
+        )
+        return fm + deep + first + params["bias"]

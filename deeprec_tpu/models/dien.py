@@ -1,0 +1,61 @@
+"""DIEN — Deep Interest Evolution Network (reference modelzoo/dien/train.py):
+interest extraction GRU over behavior, then an attention-gated AUGRU whose
+final hidden state is the evolved interest. The AUGRU runs as a lax.scan —
+compiler-friendly recurrence, no dynamic lengths."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.models.taobao import behavior_features
+
+
+@dataclasses.dataclass
+class DIEN:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    gru_hidden: int = 32
+    hidden: Sequence[int] = (200, 80)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        self.features = behavior_features(self.emb_dim, self.capacity, self.ev)
+
+    def init(self, key):
+        ks = jax.random.split(key, 4)
+        D = 2 * self.emb_dim
+        H = self.gru_hidden
+        in_dim = self.emb_dim + D + H
+        return {
+            "gru1": nn.gru_init(ks[0], D, H),
+            "augru": nn.gru_init(ks[1], H, H),
+            "att_w": nn.dense_init(ks[2], H, D),
+            "mlp": nn.mlp_init(ks[3], in_dim, list(self.hidden) + [1]),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        hist_i, mask = inputs.seq["hist_items"]
+        hist_c, _ = inputs.seq["hist_cats"]
+        hist = jnp.concatenate([hist_i, hist_c], axis=-1)  # [B, L, D]
+        target = jnp.concatenate(
+            [inputs.pooled["target_item"], inputs.pooled["target_cat"]], axis=-1
+        )
+        # interest extraction
+        _, states1 = nn.gru_apply(params["gru1"], hist, mask)  # [B, L, H]
+        # attention scores vs target (bilinear through att_w)
+        proj = nn.dense_apply(params["att_w"], states1)  # [B, L, D]
+        scores = jnp.einsum("bld,bd->bl", proj, target) / jnp.sqrt(
+            jnp.float32(target.shape[-1])
+        )
+        scores = jnp.where(mask, scores, -1e9)
+        att = jax.nn.softmax(scores, axis=1)
+        att = jnp.where(mask, att, 0.0)
+        # interest evolution: AUGRU over extracted states
+        final, _ = nn.gru_apply(params["augru"], states1, mask, att=att)
+        x = jnp.concatenate([inputs.pooled["user"], target, final], axis=-1)
+        return nn.mlp_apply(params["mlp"], x, activation=jax.nn.sigmoid)[:, 0]

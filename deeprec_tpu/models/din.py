@@ -1,0 +1,50 @@
+"""DIN — Deep Interest Network (reference modelzoo/din/train.py): local
+activation unit attends over the user's behavior sequence conditioned on the
+target item; attention-pooled history + target + user feed an MLP head."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.models.taobao import behavior_features
+
+
+@dataclasses.dataclass
+class DIN:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    att_hidden: Sequence[int] = (36,)
+    hidden: Sequence[int] = (200, 80)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        self.features = behavior_features(self.emb_dim, self.capacity, self.ev)
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        D = 2 * self.emb_dim  # item ++ cat
+        in_dim = self.emb_dim + 2 * D  # user + target + attended-history
+        return {
+            "att": nn.din_attention_init(k1, D, self.att_hidden),
+            "mlp": nn.mlp_init(k2, in_dim, list(self.hidden) + [1]),
+        }
+
+    def _sequences(self, inputs):
+        hist_i, mask = inputs.seq["hist_items"]
+        hist_c, _ = inputs.seq["hist_cats"]
+        hist = jnp.concatenate([hist_i, hist_c], axis=-1)  # [B, L, 2d]
+        target = jnp.concatenate(
+            [inputs.pooled["target_item"], inputs.pooled["target_cat"]], axis=-1
+        )  # [B, 2d]
+        return hist, mask, target
+
+    def apply(self, params, inputs, train: bool):
+        hist, mask, target = self._sequences(inputs)
+        attended = nn.din_attention_apply(params["att"], target, hist, mask)
+        x = jnp.concatenate([inputs.pooled["user"], target, attended], axis=-1)
+        return nn.mlp_apply(params["mlp"], x, activation=jax.nn.sigmoid)[:, 0]

@@ -1,0 +1,54 @@
+"""DLRM on Criteo — the north-star benchmark model
+(/root/reference/modelzoo/dlrm/train.py): bottom MLP over numerics, dim-d
+embeddings per categorical field, pairwise dot interactions, top MLP."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import CRITEO_CAT, CRITEO_DENSE, criteo_features
+
+
+@dataclasses.dataclass
+class DLRM:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    bottom: Sequence[int] = (512, 256, 64, 16)
+    top: Sequence[int] = (512, 256, 1)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+    num_cat: int = len(CRITEO_CAT)
+    num_dense: int = len(CRITEO_DENSE)
+
+    def __post_init__(self):
+        assert self.bottom[-1] == self.emb_dim, "bottom MLP must end at emb_dim"
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        F = self.num_cat + 1
+        inter = F * (F - 1) // 2
+        return {
+            "bottom": nn.mlp_init(k1, self.num_dense, list(self.bottom)),
+            "top": nn.mlp_init(k2, inter + self.emb_dim, list(self.top)),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        bottom = nn.mlp_apply(params["bottom"], dense, final_activation=jax.nn.relu)
+        embs = jnp.stack([inputs.pooled[c] for c in self._cats], axis=1)  # [B,F,D]
+        stack = jnp.concatenate([bottom[:, None, :], embs], axis=1)
+        inter = nn.dot_interaction(stack)
+        top_in = jnp.concatenate([bottom, inter], axis=-1)
+        return nn.mlp_apply(params["top"], top_in)[:, 0]

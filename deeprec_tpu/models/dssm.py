@@ -1,0 +1,57 @@
+"""DSSM two-tower retrieval (reference modelzoo/dssm/train.py): user tower
+and item tower, cosine-similarity logit scaled by a learnable temperature."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
+from deeprec_tpu.features import DenseFeature, SparseFeature
+
+
+@dataclasses.dataclass
+class DSSM:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    num_user_feats: int = 4
+    num_item_feats: int = 4
+    hidden: Sequence[int] = (256, 128, 64)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        def tc(name):
+            return TableConfig(name=name, dim=self.emb_dim, capacity=self.capacity,
+                               ev=self.ev)
+
+        self.user_feats = [f"U{i}" for i in range(self.num_user_feats)]
+        self.item_feats = [f"V{i}" for i in range(self.num_item_feats)]
+        self.features = [
+            SparseFeature(name=n, table=tc(n)) for n in self.user_feats
+        ] + [SparseFeature(name=n, table=tc(n)) for n in self.item_feats]
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "user": nn.mlp_init(k1, self.num_user_feats * self.emb_dim,
+                                list(self.hidden)),
+            "item": nn.mlp_init(k2, self.num_item_feats * self.emb_dim,
+                                list(self.hidden)),
+            "temp": jnp.asarray(5.0),
+        }
+
+    def towers(self, params, inputs):
+        u = jnp.concatenate([inputs.pooled[n] for n in self.user_feats], -1)
+        v = jnp.concatenate([inputs.pooled[n] for n in self.item_feats], -1)
+        u = nn.mlp_apply(params["user"], u)
+        v = nn.mlp_apply(params["item"], v)
+        u = u / jnp.maximum(jnp.linalg.norm(u, axis=-1, keepdims=True), 1e-6)
+        v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-6)
+        return u, v
+
+    def apply(self, params, inputs, train: bool):
+        u, v = self.towers(params, inputs)
+        return jnp.sum(u * v, axis=-1) * params["temp"]

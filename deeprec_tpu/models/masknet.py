@@ -1,0 +1,73 @@
+"""MaskNet (reference modelzoo/masknet/train.py): serial instance-guided
+MaskBlocks — each block projects the raw feature concat into a
+multiplicative mask over the running hidden state."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import criteo_features
+
+
+@dataclasses.dataclass
+class MaskNet:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    num_blocks: int = 3
+    block_dim: int = 64
+    mask_hidden: int = 64
+    hidden: Sequence[int] = (64,)
+    num_cat: int = 26
+    num_dense: int = 13
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def _width(self):
+        return self.num_cat * self.emb_dim + self.num_dense
+
+    def init(self, key):
+        W = self._width()
+        ks = jax.random.split(key, 3 * self.num_blocks + 1)
+        blocks = []
+        d = W
+        for i in range(self.num_blocks):
+            blocks.append(
+                {
+                    "mask1": nn.dense_init(ks[3 * i], W, self.mask_hidden),
+                    "mask2": nn.dense_init(ks[3 * i + 1], self.mask_hidden, d),
+                    "proj": nn.dense_init(ks[3 * i + 2], d, self.block_dim),
+                    "ln": nn.layernorm_init(self.block_dim),
+                }
+            )
+            d = self.block_dim
+        return {
+            "blocks": blocks,
+            "head": nn.mlp_init(ks[-1], self.block_dim, list(self.hidden) + [1]),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        embs = [inputs.pooled[c] for c in self._cats]
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], -1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        x = jnp.concatenate(embs + [dense], -1)
+        h = x
+        for blk in params["blocks"]:
+            mask = nn.dense_apply(
+                blk["mask2"], jax.nn.relu(nn.dense_apply(blk["mask1"], x))
+            )
+            h = nn.layernorm_apply(blk["ln"], nn.dense_apply(blk["proj"], mask * h))
+            h = jax.nn.relu(h)
+        return nn.mlp_apply(params["head"], h)[:, 0]

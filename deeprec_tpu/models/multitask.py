@@ -1,0 +1,222 @@
+"""Multi-task CTR models (reference modelzoo/{esmm,mmoe,ple,dbmtl,
+simple_multitask}): all return {task: logits}; the Trainer pairs each task
+with batch['label_<task>'].
+
+Shared scaffolding: Criteo-style sparse+dense features feeding a shared
+embedding concat, then the per-architecture routing."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import criteo_features
+
+
+@dataclasses.dataclass
+class _MTBase:
+    emb_dim: int = 8
+    capacity: int = 1 << 14
+    num_cat: int = 8
+    num_dense: int = 4
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+
+    def __post_init__(self):
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def _width(self):
+        return self.num_cat * self.emb_dim + self.num_dense
+
+    def _concat(self, inputs):
+        embs = [inputs.pooled[c] for c in self._cats]
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], -1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        return jnp.concatenate(embs + [dense], -1)
+
+
+def _prob_logit(p, eps=1e-7):
+    p = jnp.clip(p, eps, 1.0 - eps)
+    return jnp.log(p) - jnp.log1p(-p)
+
+
+@dataclasses.dataclass
+class SimpleMultiTask(_MTBase):
+    """Shared bottom MLP + independent task towers
+    (modelzoo/simple_multitask/train.py)."""
+
+    bottom: Sequence[int] = (128,)
+    tower: Sequence[int] = (32,)
+    tasks: Sequence[str] = ("ctr", "cvr")
+
+    def init(self, key):
+        ks = jax.random.split(key, 1 + len(self.tasks))
+        return {
+            "bottom": nn.mlp_init(ks[0], self._width(), list(self.bottom)),
+            "towers": {
+                t: nn.mlp_init(ks[1 + i], self.bottom[-1], list(self.tower) + [1])
+                for i, t in enumerate(self.tasks)
+            },
+        }
+
+    def apply(self, params, inputs, train: bool) -> Dict[str, jnp.ndarray]:
+        h = nn.mlp_apply(params["bottom"], self._concat(inputs),
+                         final_activation=jax.nn.relu)
+        return {
+            t: nn.mlp_apply(params["towers"][t], h)[:, 0] for t in self.tasks
+        }
+
+
+@dataclasses.dataclass
+class ESMM(_MTBase):
+    """Entire-space multi-task model (modelzoo/esmm): pCTR and pCVR towers on
+    shared embeddings; supervised as ctr (clicks) and ctcvr = pCTR*pCVR
+    (conversions over the whole exposure space)."""
+
+    tower: Sequence[int] = (64, 32)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "ctr": nn.mlp_init(k1, self._width(), list(self.tower) + [1]),
+            "cvr": nn.mlp_init(k2, self._width(), list(self.tower) + [1]),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        x = self._concat(inputs)
+        ctr_logit = nn.mlp_apply(params["ctr"], x)[:, 0]
+        cvr_logit = nn.mlp_apply(params["cvr"], x)[:, 0]
+        pctcvr = jax.nn.sigmoid(ctr_logit) * jax.nn.sigmoid(cvr_logit)
+        return {"ctr": ctr_logit, "ctcvr": _prob_logit(pctcvr)}
+
+
+@dataclasses.dataclass
+class MMoE(_MTBase):
+    """Multi-gate mixture of experts (modelzoo/mmoe): shared experts, one
+    softmax gate per task."""
+
+    num_experts: int = 4
+    expert: Sequence[int] = (64,)
+    tower: Sequence[int] = (32,)
+    tasks: Sequence[str] = ("ctr", "cvr")
+
+    def init(self, key):
+        ks = jax.random.split(key, self.num_experts + 2 * len(self.tasks))
+        W = self._width()
+        return {
+            "experts": [
+                nn.mlp_init(ks[i], W, list(self.expert))
+                for i in range(self.num_experts)
+            ],
+            "gates": {
+                t: nn.dense_init(ks[self.num_experts + i], W, self.num_experts)
+                for i, t in enumerate(self.tasks)
+            },
+            "towers": {
+                t: nn.mlp_init(
+                    ks[self.num_experts + len(self.tasks) + i],
+                    self.expert[-1], list(self.tower) + [1],
+                )
+                for i, t in enumerate(self.tasks)
+            },
+        }
+
+    def apply(self, params, inputs, train: bool):
+        x = self._concat(inputs)
+        experts = jnp.stack(
+            [nn.mlp_apply(e, x, final_activation=jax.nn.relu)
+             for e in params["experts"]],
+            axis=1,
+        )  # [B, E, H]
+        out = {}
+        for t in self.tasks:
+            g = jax.nn.softmax(nn.dense_apply(params["gates"][t], x), axis=-1)
+            h = jnp.einsum("be,beh->bh", g, experts)
+            out[t] = nn.mlp_apply(params["towers"][t], h)[:, 0]
+        return out
+
+
+@dataclasses.dataclass
+class PLE(_MTBase):
+    """Progressive layered extraction (modelzoo/ple): one CGC layer with
+    shared + per-task experts, gated per task, then task towers."""
+
+    shared_experts: int = 2
+    task_experts: int = 2
+    expert: Sequence[int] = (64,)
+    tower: Sequence[int] = (32,)
+    tasks: Sequence[str] = ("ctr", "cvr")
+
+    def init(self, key):
+        T = len(self.tasks)
+        n_exp = self.shared_experts + T * self.task_experts
+        ks = jax.random.split(key, n_exp + 2 * T)
+        W = self._width()
+        i = 0
+        experts = {"shared": []}
+        for _ in range(self.shared_experts):
+            experts["shared"].append(nn.mlp_init(ks[i], W, list(self.expert))); i += 1
+        for t in self.tasks:
+            experts[t] = []
+            for _ in range(self.task_experts):
+                experts[t].append(nn.mlp_init(ks[i], W, list(self.expert))); i += 1
+        gates, towers = {}, {}
+        for t in self.tasks:
+            gates[t] = nn.dense_init(ks[i], W, self.shared_experts + self.task_experts); i += 1
+            towers[t] = nn.mlp_init(ks[i], self.expert[-1], list(self.tower) + [1]); i += 1
+        return {"experts": experts, "gates": gates, "towers": towers}
+
+    def apply(self, params, inputs, train: bool):
+        x = self._concat(inputs)
+        shared = [
+            nn.mlp_apply(e, x, final_activation=jax.nn.relu)
+            for e in params["experts"]["shared"]
+        ]
+        out = {}
+        for t in self.tasks:
+            own = [
+                nn.mlp_apply(e, x, final_activation=jax.nn.relu)
+                for e in params["experts"][t]
+            ]
+            stack = jnp.stack(shared + own, axis=1)  # [B, S+K, H]
+            g = jax.nn.softmax(nn.dense_apply(params["gates"][t], x), axis=-1)
+            h = jnp.einsum("be,beh->bh", g, stack)
+            out[t] = nn.mlp_apply(params["towers"][t], h)[:, 0]
+        return out
+
+
+@dataclasses.dataclass
+class DBMTL(_MTBase):
+    """Deep bayesian multi-task (modelzoo/dbmtl): shared bottom, task towers,
+    and an explicit ctr→cvr causal link on the hidden features."""
+
+    bottom: Sequence[int] = (128,)
+    tower: Sequence[int] = (32,)
+
+    def init(self, key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        H = self.bottom[-1]
+        return {
+            "bottom": nn.mlp_init(k1, self._width(), list(self.bottom)),
+            "ctr": nn.mlp_init(k2, H, list(self.tower) + [1]),
+            "cvr": nn.mlp_init(k3, H + self.tower[-1], list(self.tower) + [1]),
+            "link": nn.mlp_init(k4, H, list(self.tower)),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        h = nn.mlp_apply(params["bottom"], self._concat(inputs),
+                         final_activation=jax.nn.relu)
+        ctr_logit = nn.mlp_apply(params["ctr"], h)[:, 0]
+        ctr_hidden = nn.mlp_apply(params["link"], h, final_activation=jax.nn.relu)
+        cvr_in = jnp.concatenate([h, ctr_hidden], -1)
+        cvr_logit = nn.mlp_apply(params["cvr"], cvr_in)[:, 0]
+        return {"ctr": ctr_logit, "cvr": cvr_logit}

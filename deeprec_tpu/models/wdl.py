@@ -1,0 +1,54 @@
+"""Wide & Deep on Criteo — the reference's baseline model
+(/root/reference/modelzoo/wide_and_deep/train.py): 13 numeric + 26
+categorical features; wide = linear over per-feature scalar embeddings,
+deep = MLP over concatenated dim-d embeddings + numerics."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu import nn
+from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
+from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.models.criteo import CRITEO_CAT, CRITEO_DENSE, criteo_features
+
+
+@dataclasses.dataclass
+class WDL:
+    emb_dim: int = 16
+    capacity: int = 1 << 16
+    hidden: Sequence[int] = (1024, 512, 256)
+    ev: EmbeddingVariableOption = EmbeddingVariableOption()
+    num_cat: int = len(CRITEO_CAT)
+    num_dense: int = len(CRITEO_DENSE)
+
+    def __post_init__(self):
+        self.features = criteo_features(
+            emb_dim=self.emb_dim, capacity=self.capacity, ev=self.ev,
+            num_cat=self.num_cat, num_dense=self.num_dense,
+        )
+        self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
+        self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        deep_in = self.num_cat * self.emb_dim + self.num_dense
+        return {
+            "deep": nn.mlp_init(k1, deep_in, list(self.hidden) + [1]),
+            # wide: linear over embeddings' first component + numerics
+            "wide_w": jax.random.normal(k2, (self.num_cat + self.num_dense,)) * 0.01,
+            "wide_b": jnp.zeros(()),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        embs = [inputs.pooled[c] for c in self._cats]  # each [B, d]
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))  # Criteo standard transform
+        deep_in = jnp.concatenate(embs + [dense], axis=-1)
+        deep_out = nn.mlp_apply(params["deep"], deep_in)[:, 0]
+        wide_in = jnp.concatenate([e[:, :1] for e in embs] + [dense], axis=-1)
+        wide_out = wide_in @ params["wide_w"] + params["wide_b"]
+        return deep_out + wide_out

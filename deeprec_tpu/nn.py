@@ -1,0 +1,226 @@
+"""Minimal functional NN layer library for the modelzoo.
+
+Plain pytree params + pure apply functions — no framework dependency, full
+control of dtypes (bf16 compute / f32 params, the TPU translation of
+DeepRec's BFloat16 scope: docs/docs_en/BFloat16.md, usage
+modelzoo/wide_and_deep/train.py:187-199). All matmuls carry
+preferred_element_type=float32 so the MXU accumulates in f32.
+
+Layers cover the reference modelzoo's building blocks: MLP towers, DIN's
+local-activation attention (modelzoo/din), DIEN's GRU/AUGRU (modelzoo/dien),
+BST's transformer block (modelzoo/bst), DCN's cross network (modelzoo/dcnv2),
+DeepFM's FM layer and DLRM's dot interaction.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def _glorot(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = shape[0], shape[-1]
+    lim = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -lim, lim)
+
+
+def matmul(x, w):
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+# ----------------------------------------------------------------- dense / MLP
+
+
+def dense_init(key, in_dim: int, out_dim: int) -> Params:
+    kw, _ = jax.random.split(key)
+    return {"w": _glorot(kw, (in_dim, out_dim)), "b": jnp.zeros((out_dim,))}
+
+
+def dense_apply(p: Params, x, compute_dtype=jnp.bfloat16):
+    y = matmul(x.astype(compute_dtype), p["w"].astype(compute_dtype))
+    return y.astype(jnp.float32) + p["b"]
+
+
+def mlp_init(key, in_dim: int, hidden: Sequence[int]) -> Params:
+    keys = jax.random.split(key, len(hidden))
+    layers = []
+    d = in_dim
+    for k, h in zip(keys, hidden):
+        layers.append(dense_init(k, d, h))
+        d = h
+    return {"layers": layers}
+
+
+def mlp_apply(p: Params, x, activation=jax.nn.relu, final_activation=None,
+              compute_dtype=jnp.bfloat16):
+    n = len(p["layers"])
+    for i, layer in enumerate(p["layers"]):
+        x = dense_apply(layer, x, compute_dtype)
+        if i < n - 1:
+            x = activation(x)
+        elif final_activation is not None:
+            x = final_activation(x)
+    return x
+
+
+def layernorm_init(dim: int) -> Params:
+    return {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))}
+
+
+def layernorm_apply(p: Params, x, eps=1e-6):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+
+# ------------------------------------------------------- DIN attention pooling
+
+
+def din_attention_init(key, dim: int, hidden: Sequence[int] = (36,)) -> Params:
+    # scorer input: [item, hist, item-hist, item*hist]
+    return {"mlp": mlp_init(key, 4 * dim, list(hidden) + [1])}
+
+
+def din_attention_apply(p: Params, query, keys, mask):
+    """DIN local activation unit (modelzoo/din/train.py attention):
+    query [B, D] target item, keys [B, L, D] behavior sequence."""
+    B, L, D = keys.shape
+    q = jnp.broadcast_to(query[:, None, :], (B, L, D))
+    feats = jnp.concatenate([q, keys, q - keys, q * keys], axis=-1)
+    scores = mlp_apply(p["mlp"], feats.reshape(B * L, 4 * D)).reshape(B, L)
+    scores = jnp.where(mask, scores, -1e9)
+    w = jax.nn.softmax(scores, axis=1)
+    w = jnp.where(mask, w, 0.0)
+    return jnp.einsum("bl,bld->bd", w, keys)
+
+
+# ----------------------------------------------------------------- GRU / AUGRU
+
+
+def gru_init(key, in_dim: int, hid: int) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "wz": _glorot(k1, (in_dim + hid, hid)),
+        "wr": _glorot(k2, (in_dim + hid, hid)),
+        "wh": _glorot(k3, (in_dim + hid, hid)),
+        "bz": jnp.zeros((hid,)),
+        "br": jnp.zeros((hid,)),
+        "bh": jnp.zeros((hid,)),
+    }
+
+
+def _gru_cell(p, h, x, att: Optional[jnp.ndarray] = None):
+    xh = jnp.concatenate([x, h], axis=-1)
+    z = jax.nn.sigmoid(matmul(xh, p["wz"]) + p["bz"])
+    r = jax.nn.sigmoid(matmul(xh, p["wr"]) + p["br"])
+    xrh = jnp.concatenate([x, r * h], axis=-1)
+    hh = jnp.tanh(matmul(xrh, p["wh"]) + p["bh"])
+    if att is not None:
+        # AUGRU: attention scales the update gate (DIEN,
+        # modelzoo/dien/train.py "augru")
+        z = att[:, None] * z
+    return (1.0 - z) * h + z * hh
+
+
+def gru_apply(p: Params, xs, mask, att=None):
+    """Run a (AU)GRU over [B, L, D] with [B, L] mask via lax.scan.
+
+    Returns final hidden state [B, H] and all hidden states [B, L, H].
+    Masked positions carry the previous state through (standard padded-seq
+    handling, compiler-friendly — no dynamic lengths).
+    """
+    B, L, D = xs.shape
+    H = p["bz"].shape[0]
+    h0 = jnp.zeros((B, H), jnp.float32)
+
+    def step(h, inp):
+        x, m, a = inp
+        h_new = _gru_cell(p, h, x, a)
+        h = jnp.where(m[:, None], h_new, h)
+        return h, h
+
+    xs_t = jnp.moveaxis(xs, 1, 0)  # [L, B, D]
+    mask_t = jnp.moveaxis(mask, 1, 0)
+    att_t = (
+        jnp.moveaxis(att, 1, 0)
+        if att is not None
+        else jnp.ones((L, B), jnp.float32)
+    )
+    h_final, hs = jax.lax.scan(step, h0, (xs_t, mask_t, att_t))
+    return h_final, jnp.moveaxis(hs, 0, 1)
+
+
+# ------------------------------------------------------------ transformer (BST)
+
+
+def transformer_block_init(key, dim: int, heads: int, ff: int) -> Params:
+    # NB: `heads` stays static config (apply arg), NOT a params leaf — ints in
+    # the differentiated pytree would crash jax.grad.
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "qkv": _glorot(k1, (dim, 3 * dim)),
+        "proj": _glorot(k2, (dim, dim)),
+        "ff1": dense_init(k3, dim, ff),
+        "ff2": dense_init(k4, ff, dim),
+        "ln1": layernorm_init(dim),
+        "ln2": layernorm_init(dim),
+    }
+
+
+def transformer_block_apply(p: Params, x, mask, heads: int):
+    """Post-LN transformer encoder block with padding mask: x [B, L, D]."""
+    B, L, D = x.shape
+    H = heads
+    qkv = matmul(x, p["qkv"]).reshape(B, L, 3, H, D // H)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, Dh]
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(D / H)
+    logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+    att = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhlm,bmhd->blhd", att, v).reshape(B, L, D)
+    x = layernorm_apply(p["ln1"], x + matmul(out, p["proj"]))
+    ff = dense_apply(p["ff2"], jax.nn.relu(dense_apply(p["ff1"], x)))
+    x = layernorm_apply(p["ln2"], x + ff)
+    return jnp.where(mask[..., None], x, 0.0)
+
+
+# -------------------------------------------------------------- DCN cross net
+
+
+def crossnet_init(key, dim: int, depth: int) -> Params:
+    keys = jax.random.split(key, depth)
+    return {
+        "layers": [
+            {"w": _glorot(k, (dim, dim)), "b": jnp.zeros((dim,))} for k in keys
+        ]
+    }
+
+
+def crossnet_apply(p: Params, x0):
+    """DCNv2 cross layer: x_{l+1} = x0 * (W x_l + b) + x_l
+    (modelzoo/dcnv2/train.py)."""
+    x = x0
+    for layer in p["layers"]:
+        x = x0 * (matmul(x, layer["w"]) + layer["b"]) + x
+    return x
+
+
+# ------------------------------------------------------------------- FM / dot
+
+
+def fm_apply(emb_stack):
+    """Second-order FM interaction over [B, F, D] field embeddings
+    (DeepFM, modelzoo/deepfm): 0.5 * ((Σv)² − Σv²) summed over D."""
+    s = jnp.sum(emb_stack, axis=1)
+    sq = jnp.sum(emb_stack * emb_stack, axis=1)
+    return 0.5 * jnp.sum(s * s - sq, axis=1, keepdims=True)
+
+
+def dot_interaction(emb_stack, keep_diag: bool = False):
+    """DLRM pairwise dot interactions over [B, F, D] -> [B, F*(F-1)/2]."""
+    B, F, D = emb_stack.shape
+    z = jnp.einsum("bfd,bgd->bfg", emb_stack, emb_stack)
+    i, j = jnp.triu_indices(F, k=0 if keep_diag else 1)
+    return z[:, i, j]
