@@ -1,0 +1,391 @@
+"""Checkpointing: full + incremental saves of sparse tables and dense params.
+
+Parity with DeepRec's EV checkpoint machinery (SURVEY.md §3.3):
+  * Full save: per table, the compacted tensors keys/values/freqs/versions
+    (+ optimizer slots and filter sketch) with partition offsets — the
+    "9 parts" export of SaveV2(has_ev=true)
+    (docs/docs_en/Embedding-Variable.md "Checkpoint",
+    embedding_var_ckpt_data.cc). Non-admitted (filtered) keys are saved with
+    their frequency so admission counters survive restore
+    (TF_EV_SAVE_FILTERED_FEATURES behavior).
+  * Incremental save: only rows dirtied since the last save — the IncrSave /
+    IndicesIncrRecorder delta path (core/kernels/incr_save_restore_ops.h:43),
+    used for fast PS failover and serving delta updates.
+  * Restore: latest full checkpoint, then replay deltas in order
+    (Incremental-Checkpoint.md:3-7). Keys are re-inserted by probing, so a
+    checkpoint restores onto ANY topology — different mesh size or grown
+    capacity — which is what elastic re-scaling needs (elastic_training.proto
+    semantics without the gRPC choreography).
+
+Format: a directory per step, numpy .npz per table plus dense.npz and a JSON
+manifest. Host-side; runs at checkpoint cadence, not on the hot path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
+from deeprec_tpu.training.trainer import TrainState, Trainer
+from deeprec_tpu.utils import hashing
+
+
+# ----------------------------------------------------------- table export
+
+
+def is_per_row(name: str) -> bool:
+    """Checkpoint-array routing by NAME (never by shape, which is ambiguous):
+    per-row arrays are compacted/partitioned; per-table arrays (CBF sketch,
+    scalar optimizer slots) are carried whole."""
+    if name in ("keys", "values", "freqs", "versions"):
+        return True
+    return name.startswith("slot:") and not name.startswith("slot:scalar/")
+
+
+def export_table_arrays(
+    table: EmbeddingTable, state_np: Dict[str, np.ndarray], only_dirty: bool
+) -> Dict[str, np.ndarray]:
+    """Compact one LOCAL table state (host numpy arrays) to its live rows."""
+    keys = state_np["keys"]
+    occ = keys != empty_key(table.cfg)
+    if only_dirty:
+        occ = occ & state_np["dirty"]
+    idx = np.nonzero(occ)[0]
+    out = {
+        "keys": keys[idx],
+        "values": state_np["values"][idx],
+        "freqs": state_np["freq"][idx],
+        "versions": state_np["version"][idx],
+    }
+    for sname, arr in state_np.items():
+        if sname.startswith("slot:"):
+            out[sname] = arr[idx] if is_per_row(sname) else arr
+    if state_np.get("bloom") is not None:
+        out["bloom"] = state_np["bloom"]
+    return out
+
+
+def _state_to_np(ts: TableState) -> Dict[str, np.ndarray]:
+    d = {
+        "keys": np.asarray(ts.keys),
+        "values": np.asarray(ts.values),
+        "freq": np.asarray(ts.freq),
+        "version": np.asarray(ts.version),
+        "dirty": np.asarray(ts.dirty),
+    }
+    for sname, arr in ts.slots.items():
+        d["slot:" + sname] = np.asarray(arr)
+    if ts.bloom is not None:
+        d["bloom"] = np.asarray(ts.bloom)
+    return d
+
+
+def import_rows(
+    table: EmbeddingTable,
+    state: TableState,
+    rows: Dict[str, np.ndarray],
+    strict: bool = True,
+) -> TableState:
+    """Insert checkpointed rows into a (fresh or live) local table state."""
+    n = rows["keys"].shape[0]
+    if n == 0:
+        if "bloom" in rows and state.bloom is not None:
+            state = state.replace(bloom=jnp.asarray(rows["bloom"]))
+        return state
+    keys = jnp.asarray(rows["keys"])
+    new_keys, slot_ix, created, failed = table._probe(
+        state.keys, keys, jnp.ones((n,), bool)
+    )
+    if strict and bool(jnp.any(failed)):
+        raise RuntimeError(
+            f"table {table.cfg.name}: {int(jnp.sum(failed))} keys failed to "
+            f"insert on restore — grow the capacity"
+        )
+    ix = jnp.where(slot_ix >= 0, slot_ix, state.capacity)
+    values = state.values.at[ix].set(
+        jnp.asarray(rows["values"]).astype(state.values.dtype), mode="drop"
+    )
+    freq = state.freq.at[ix].set(jnp.asarray(rows["freqs"]), mode="drop")
+    version = state.version.at[ix].set(jnp.asarray(rows["versions"]), mode="drop")
+    slots = dict(state.slots)
+    for sname, arr in state.slots.items():
+        key = "slot:" + sname
+        if key not in rows:
+            continue
+        r = jnp.asarray(rows[key])
+        if is_per_row(key):
+            slots[sname] = arr.at[ix].set(r, mode="drop")
+        else:
+            slots[sname] = r
+    bloom = state.bloom
+    if "bloom" in rows and bloom is not None:
+        bloom = jnp.asarray(rows["bloom"])
+    return state.replace(
+        keys=new_keys, values=values, freq=freq, version=version, slots=slots,
+        bloom=bloom,
+    )
+
+
+# -------------------------------------------------------- checkpoint manager
+
+
+def _tree_to_npz_dict(tree) -> Dict[str, np.ndarray]:
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    return {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+
+
+def _tree_from_npz_dict(template, data) -> object:
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    new_leaves = [
+        jnp.asarray(data[f"leaf_{i}"]).astype(l.dtype).reshape(l.shape)
+        if hasattr(l, "dtype")
+        else data[f"leaf_{i}"]
+        for i, l in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+class CheckpointManager:
+    """Save/restore for a Trainer (single-device or sharded).
+
+    Layout:
+        <dir>/full-<step>/manifest.json, dense.npz, table_<bundle>[_tK].npz
+        <dir>/incr-<step>/...            (deltas since previous save)
+    """
+
+    def __init__(self, directory: str, trainer: Trainer, keep: int = 3):
+        self.dir = directory
+        self.trainer = trainer
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------- helpers
+
+    def _bundle_states(self, state: TrainState, bname: str) -> List[Tuple[str, Dict]]:
+        """Split a (possibly stacked and/or sharded) bundle state into LOCAL
+        per-table host states, tagged 'tK' for stacked member K. Shard dims
+        are concatenated: rows from all shards merge into one export (the
+        partition_offset records the split for forensics)."""
+        b = self.trainer.bundles[bname]
+        ts = state.tables[bname]
+        out = []
+        members = range(len(b.features)) if b.stacked else [None]
+        for k in members:
+            sub = jax.tree.map(lambda a: a[k], ts) if b.stacked else ts
+            out.append((f"t{k}" if k is not None else "t", _state_to_np(sub)))
+        return out
+
+    def _is_sharded(self) -> bool:
+        return hasattr(self.trainer, "num_shards")
+
+    def _export_bundle(self, state, bname, only_dirty) -> Dict[str, Dict[str, np.ndarray]]:
+        b = self.trainer.bundles[bname]
+        exports = {}
+        for tag, np_state in self._bundle_states(state, bname):
+            if self._is_sharded():
+                # leading dim = shard axis: compact each shard, concatenate,
+                # remember offsets (DeepRec's -partition_offset tensor)
+                parts = []
+                offsets = [0]
+                N = np_state["keys"].shape[0]
+                for s in range(N):
+                    local = {k: v[s] for k, v in np_state.items()}
+                    parts.append(export_table_arrays(b.table, local, only_dirty))
+                    offsets.append(offsets[-1] + parts[-1]["keys"].shape[0])
+                merged = {}
+                for k in parts[0]:
+                    if is_per_row(k):
+                        merged[k] = np.concatenate([p[k] for p in parts])
+                    elif k == "bloom":
+                        # counting sketches are additive: the sum is a valid
+                        # (conservative) global sketch
+                        merged[k] = np.sum([p[k] for p in parts], axis=0)
+                    else:  # per-table scalar slot: identical on all shards
+                        merged[k] = parts[0][k]
+                merged["partition_offset"] = np.asarray(offsets, np.int64)
+                exports[tag] = merged
+            else:
+                exports[tag] = export_table_arrays(b.table, np_state, only_dirty)
+        return exports
+
+    def _clear_dirty(self, state: TrainState) -> TrainState:
+        tables = {
+            bname: ts.replace(dirty=jax.tree.map(jnp.zeros_like, ts.dirty))
+            if not isinstance(ts, dict)
+            else ts
+            for bname, ts in state.tables.items()
+        }
+        return TrainState(
+            step=state.step, tables=tables, dense=state.dense,
+            opt_state=state.opt_state,
+        )
+
+    # ---------------------------------------------------------------- save
+
+    def save(self, state: TrainState) -> Tuple[TrainState, str]:
+        """Full checkpoint. Returns (state with dirty bits cleared, path)."""
+        step = int(state.step)
+        path = os.path.join(self.dir, f"full-{step}")
+        os.makedirs(path, exist_ok=True)
+        for bname in self.trainer.bundles:
+            for tag, arrays in self._export_bundle(state, bname, False).items():
+                np.savez(os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays)
+        np.savez(os.path.join(path, "dense.npz"), **_tree_to_npz_dict(state.dense))
+        np.savez(
+            os.path.join(path, "opt.npz"), **_tree_to_npz_dict(state.opt_state)
+        )
+        manifest = {
+            "step": step,
+            "kind": "full",
+            "bundles": {
+                bn: [f.name for f in b.features]
+                for bn, b in self.trainer.bundles.items()
+            },
+        }
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        self._gc()
+        return self._clear_dirty(state), path
+
+    def save_incremental(self, state: TrainState) -> Tuple[TrainState, str]:
+        """Delta checkpoint: rows touched since the previous (full or incr)
+        save. The consumer replays deltas over the latest full save."""
+        step = int(state.step)
+        path = os.path.join(self.dir, f"incr-{step}")
+        os.makedirs(path, exist_ok=True)
+        for bname in self.trainer.bundles:
+            for tag, arrays in self._export_bundle(state, bname, True).items():
+                np.savez(os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays)
+        np.savez(os.path.join(path, "dense.npz"), **_tree_to_npz_dict(state.dense))
+        np.savez(os.path.join(path, "opt.npz"), **_tree_to_npz_dict(state.opt_state))
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump({"step": step, "kind": "incr"}, f)
+        return self._clear_dirty(state), path
+
+    # ------------------------------------------------------------- restore
+
+    def _list(self, kind: str) -> List[int]:
+        pat = re.compile(rf"^{kind}-(\d+)$")
+        out = []
+        for d in os.listdir(self.dir):
+            m = pat.match(d)
+            if m and os.path.exists(os.path.join(self.dir, d, "manifest.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_full(self) -> Optional[int]:
+        fulls = self._list("full")
+        return fulls[-1] if fulls else None
+
+    def restore(self, template: Optional[TrainState] = None) -> TrainState:
+        """Latest full checkpoint + all newer deltas, onto the trainer's
+        CURRENT topology (mesh size / capacity may differ from save time)."""
+        full_step = self.latest_full()
+        if full_step is None:
+            raise FileNotFoundError(f"no full checkpoint under {self.dir}")
+        state = template if template is not None else self.trainer.init(0)
+        state = self._apply_ckpt(state, os.path.join(self.dir, f"full-{full_step}"),
+                                 load_dense=True)
+        for istep in [s for s in self._list("incr") if s > full_step]:
+            state = self._apply_ckpt(
+                state, os.path.join(self.dir, f"incr-{istep}"), load_dense=True
+            )
+            full_step = istep
+        with open(os.path.join(self.dir, self._latest_dir(), "manifest.json")) as f:
+            step = json.load(f)["step"]
+        return TrainState(
+            step=jnp.asarray(step, jnp.int32),
+            tables=state.tables,
+            dense=state.dense,
+            opt_state=state.opt_state,
+        )
+
+    def _latest_dir(self) -> str:
+        fulls = self._list("full")
+        incrs = [s for s in self._list("incr") if s > fulls[-1]]
+        return f"incr-{incrs[-1]}" if incrs else f"full-{fulls[-1]}"
+
+    def _apply_ckpt(self, state: TrainState, path: str, load_dense: bool) -> TrainState:
+        tables = dict(state.tables)
+        for bname, b in self.trainer.bundles.items():
+            ts = tables[bname]
+            members = range(len(b.features)) if b.stacked else [None]
+            new_members = []
+            for k in members:
+                tag = f"t{k}" if k is not None else "t"
+                fpath = os.path.join(path, f"table_{bname}_{tag}.npz")
+                sub = jax.tree.map(lambda a: a[k], ts) if b.stacked else ts
+                if os.path.exists(fpath):
+                    rows = dict(np.load(fpath))
+                    rows.pop("partition_offset", None)
+                    sub = self._import_local(b.table, sub, rows)
+                new_members.append(sub)
+            if b.stacked:
+                ts = jax.tree.map(lambda *xs: jnp.stack(xs), *new_members)
+            else:
+                ts = new_members[0]
+            tables[bname] = ts
+        dense, opt_state = state.dense, state.opt_state
+        if load_dense and os.path.exists(os.path.join(path, "dense.npz")):
+            dense = _tree_from_npz_dict(state.dense, np.load(os.path.join(path, "dense.npz")))
+        if load_dense and os.path.exists(os.path.join(path, "opt.npz")):
+            opt_state = _tree_from_npz_dict(
+                state.opt_state, np.load(os.path.join(path, "opt.npz"))
+            )
+        return TrainState(step=state.step, tables=tables, dense=dense,
+                          opt_state=opt_state)
+
+    def _import_local(self, table, sub: TableState, rows) -> TableState:
+        """Import rows into a local (possibly shard-stacked) table state."""
+        if self._is_sharded():
+            N = self.trainer.num_shards
+            owner = np.asarray(hashing.hash_shard(jnp.asarray(rows["keys"]), N))
+            shards = []
+            for s in range(N):
+                sel = owner == s
+                shard_rows = {
+                    k: (v[sel] if is_per_row(k) else v) for k, v in rows.items()
+                }
+                # The saved bloom is a GLOBAL (summed) sketch; handing it to
+                # every shard would inflate ~N× on the next save cycle.
+                # Rebuild each shard's sketch from its owned rows' freqs
+                # instead — exact for admitted keys; sub-threshold-only keys
+                # restart their admission count (documented semantic).
+                shard_rows.pop("bloom", None)
+                local = jax.tree.map(lambda a: a[s], sub)
+                local = import_rows(table, local, shard_rows)
+                cbf = table.cfg.ev.cbf_filter
+                if cbf is not None and local.bloom is not None:
+                    from deeprec_tpu.embedding import filters as _filters
+
+                    bloom = jnp.zeros_like(local.bloom)
+                    if shard_rows["keys"].shape[0] > 0:
+                        bloom, _ = _filters.cbf_add(
+                            cbf,
+                            bloom,
+                            jnp.asarray(shard_rows["keys"]),
+                            jnp.asarray(shard_rows["freqs"], jnp.int32),
+                        )
+                    local = local.replace(bloom=bloom)
+                shards.append(local)
+            return jax.tree.map(lambda *xs: jnp.stack(xs), *shards)
+        return import_rows(table, sub, rows)
+
+    # ----------------------------------------------------------------- gc
+
+    def _gc(self):
+        fulls = self._list("full")
+        for s in fulls[: -self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(self.dir, f"full-{s}"), ignore_errors=True)
+            for i in self._list("incr"):
+                if i <= s:
+                    shutil.rmtree(
+                        os.path.join(self.dir, f"incr-{i}"), ignore_errors=True
+                    )
