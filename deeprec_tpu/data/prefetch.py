@@ -1,0 +1,78 @@
+"""Staged input pipeline: host prefetch + async device transfer.
+
+DeepRec overlaps input with compute through graph surgery — tf.staged buffers
++ a background PrefetchRunner (python/ops/prefetch.py, prefetch_runner.cc) and
+the SmartStagePass that auto-carves the IO subgraph
+(core/graph/smart_stage_pass.cc). On TPU none of that graph machinery is
+needed: the same overlap is an async host thread that (a) pulls batches from
+the reader, (b) starts the host→HBM transfer early (jax.device_put is async),
+(c) keeps a small ring of in-flight batches while the train step consumes the
+previous one. XLA's async dispatch does the rest.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class Prefetcher:
+    """Wrap a host batch iterator; keep `depth` batches in flight on device.
+
+    The equivalent of tf.staged(..., num_threads=) + make_prefetch_hook —
+    one object, no session hooks.
+    """
+
+    def __init__(
+        self,
+        source: Iterator[Dict[str, np.ndarray]],
+        depth: int = 2,
+        transform: Optional[Callable] = None,
+    ):
+        self.source = iter(source)
+        self.depth = max(1, depth)
+        self.transform = transform or (lambda b: jax.device_put(b))
+        self.q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for batch in self.source:
+                if self._stop.is_set():
+                    return
+                # device_put returns immediately; the transfer overlaps the
+                # consumer's compute.
+                self.q.put(self.transform(batch))
+            self.q.put(None)
+        except Exception as e:  # surface reader errors to the consumer
+            self.q.put(e)
+            self.q.put(None)  # terminate iteration if the consumer continues
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def staged(source, depth: int = 2, transform=None) -> Prefetcher:
+    """tf.staged analog: `for batch in staged(reader): ...`"""
+    return Prefetcher(source, depth=depth, transform=transform)

@@ -1,0 +1,133 @@
+"""Columnar input readers — the ParquetDataset / CSV path of DeepRec
+(core/kernels/data/parquet_dataset_ops.cc, arrow-based;
+modelzoo train.py CSV readers). Host-side, feeding the staged prefetcher.
+
+Criteo layout: label \\t I1..I13 \\t C1..C26 (categorical as hex strings).
+Categorical values are hashed to the table key space with the same mix used
+by the embedding engine, so readers and tables agree on id semantics.
+"""
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+CRITEO_COLUMNS = (
+    ["label"] + [f"I{i}" for i in range(1, 14)] + [f"C{i}" for i in range(1, 27)]
+)
+
+
+def _hash_strings(col: "np.ndarray", salt: int) -> np.ndarray:
+    """Vectorized string -> int32 id (crc32-based; stable across runs)."""
+    out = np.empty(len(col), np.int32)
+    for i, v in enumerate(col):
+        if v is None or v == "" or (isinstance(v, float) and np.isnan(v)):
+            out[i] = -1
+        else:
+            out[i] = (zlib.crc32(str(v).encode()) ^ salt) & 0x7FFFFFFF
+    return out
+
+
+class CriteoCSVReader:
+    """Batched reader for Criteo-format TSV files."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch_size: int = 2048,
+        num_dense: int = 13,
+        num_cat: int = 26,
+        drop_remainder: bool = True,
+    ):
+        self.paths = list(paths)
+        self.B = batch_size
+        self.num_dense = num_dense
+        self.num_cat = num_cat
+        self.drop_remainder = drop_remainder
+
+    def _frame_to_batches(self, df) -> Iterator[Dict[str, np.ndarray]]:
+        import pandas as pd  # noqa
+
+        n = len(df)
+        for start in range(0, n, self.B):
+            chunk = df.iloc[start : start + self.B]
+            if len(chunk) < self.B and self.drop_remainder:
+                return
+            out: Dict[str, np.ndarray] = {
+                "label": chunk["label"].to_numpy(np.float32)
+            }
+            for i in range(1, self.num_dense + 1):
+                out[f"I{i}"] = np.nan_to_num(
+                    chunk[f"I{i}"].to_numpy(np.float32)
+                ).reshape(-1, 1)
+            for i in range(1, self.num_cat + 1):
+                out[f"C{i}"] = _hash_strings(
+                    chunk[f"C{i}"].to_numpy(object), salt=i * 0x9E3779B9 & 0x7FFFFFFF
+                )
+            yield out
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        import pandas as pd
+
+        for path in self.paths:
+            for df in pd.read_csv(
+                path,
+                sep="\t",
+                names=CRITEO_COLUMNS[: 1 + self.num_dense + self.num_cat],
+                chunksize=self.B * 16,
+                header=None,
+            ):
+                yield from self._frame_to_batches(df)
+
+
+class ParquetReader:
+    """Arrow-backed parquet batch reader (ParquetDataset parity). Columns map
+    1:1 to batch keys; string/categorical columns are hashed to int32 ids."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch_size: int = 2048,
+        columns: Optional[Sequence[str]] = None,
+        hash_columns: Sequence[str] = (),
+        drop_remainder: bool = True,
+    ):
+        self.paths = list(paths)
+        self.B = batch_size
+        self.columns = list(columns) if columns else None
+        self.hash_columns = set(hash_columns)
+        self.drop_remainder = drop_remainder
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        import pyarrow.parquet as pq
+
+        buf: Dict[str, List[np.ndarray]] = {}
+        count = 0
+        for path in self.paths:
+            pf = pq.ParquetFile(path)
+            for rb in pf.iter_batches(batch_size=self.B, columns=self.columns):
+                cols = {}
+                for name, col in zip(rb.schema.names, rb.columns):
+                    arr = col.to_numpy(zero_copy_only=False)
+                    if name in self.hash_columns or arr.dtype == object:
+                        arr = _hash_strings(arr, salt=zlib.crc32(name.encode()))
+                    cols[name] = arr
+                for name, arr in cols.items():
+                    buf.setdefault(name, []).append(arr)
+                count += len(next(iter(cols.values())))
+                while count >= self.B:
+                    batch, buf, count = _take(buf, self.B)
+                    yield batch
+        if count and not self.drop_remainder:
+            batch, buf, count = _take(buf, count)
+            yield batch
+
+
+def _take(buf, n):
+    joined = {k: np.concatenate(v) for k, v in buf.items()}
+    batch = {k: v[:n] for k, v in joined.items()}
+    rest = {k: [v[n:]] for k, v in joined.items()}
+    remaining = len(next(iter(rest.values()))[0])
+    return batch, rest, remaining

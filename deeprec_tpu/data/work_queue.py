@@ -1,0 +1,151 @@
+"""WorkQueue: dynamic work-item sharding with checkpointable state.
+
+Parity with DeepRec's WorkQueue (python/ops/work_queue.py, spec
+docs/docs_en/WorkQueue.md): a global queue of work items (file names, file
+slices) that workers `take()` from dynamically — slow workers take fewer
+items, which is the straggler mitigation and the elasticity primitive
+(workers can join/leave between takes). Supports epochs, shuffling, slicing
+and save/restore.
+
+Two modes:
+  * in-process (default): plain thread-safe queue.
+  * file-coordinated: a shared JSON state file + lockfile lets N independent
+    host processes (multi-host TPU workers on a shared FS) take disjoint
+    items — the TPU stand-in for the PS-hosted queue resource.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import random
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+
+class WorkQueue:
+    def __init__(
+        self,
+        works: Sequence[str],
+        num_epochs: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        num_slices: int = 1,
+        coordination_file: Optional[str] = None,
+    ):
+        """num_slices > 1 splits each work item into `item#slice/total` —
+        DeepRec's sliced-file sharding for large files."""
+        items: List[str] = []
+        for epoch in range(num_epochs):
+            epoch_items = []
+            for w in works:
+                for s in range(num_slices):
+                    epoch_items.append(
+                        f"{w}#{s}/{num_slices}" if num_slices > 1 else w
+                    )
+            if shuffle:
+                rng = random.Random(seed + epoch)
+                rng.shuffle(epoch_items)
+            items.extend(epoch_items)
+        self._items = items
+        self._cursor = 0
+        self._lock = threading.Lock()
+        self._coord = coordination_file
+        if self._coord and not os.path.exists(self._coord):
+            self._write_coord({"cursor": 0, "items": items})
+
+    # ------------------------------------------------------------ in-process
+
+    def take(self) -> Optional[str]:
+        """Next work item, or None when exhausted."""
+        if self._coord:
+            return self._take_coordinated()
+        with self._lock:
+            if self._cursor >= len(self._items):
+                return None
+            item = self._items[self._cursor]
+            self._cursor += 1
+            return item
+
+    def size(self) -> int:
+        if self._coord:
+            st = self._read_coord()
+            return len(st["items"]) - st["cursor"]
+        with self._lock:
+            return len(self._items) - self._cursor
+
+    def __iter__(self) -> Iterator[str]:
+        while True:
+            item = self.take()
+            if item is None:
+                return
+            yield item
+
+    # ------------------------------------------------------- save / restore
+
+    def save(self) -> dict:
+        """Checkpointable state (WorkQueueSave parity)."""
+        if self._coord:
+            return self._read_coord()
+        with self._lock:
+            return {"cursor": self._cursor, "items": self._items}
+
+    def restore(self, state: dict) -> None:
+        if self._coord:
+            self._write_coord(state)
+            return
+        with self._lock:
+            self._items = list(state["items"])
+            self._cursor = int(state["cursor"])
+
+    # ------------------------------------------------- file-coordinated mode
+
+    def _with_lock(self, fn):
+        lock_path = self._coord + ".lock"
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                return fn()
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    def _read_coord(self) -> dict:
+        def read():
+            with open(self._coord) as f:
+                return json.load(f)
+
+        return self._with_lock(read)
+
+    def _write_coord(self, state: dict) -> None:
+        def write():
+            tmp = self._coord + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self._coord)
+
+        self._with_lock(write)
+
+    def _take_coordinated(self) -> Optional[str]:
+        def take():
+            with open(self._coord) as f:
+                st = json.load(f)
+            if st["cursor"] >= len(st["items"]):
+                return None
+            item = st["items"][st["cursor"]]
+            st["cursor"] += 1
+            tmp = self._coord + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(st, f)
+            os.replace(tmp, self._coord)
+            return item
+
+        return self._with_lock(take)
+
+
+def parse_slice(item: str):
+    """'path#k/n' -> (path, k, n); plain items -> (item, 0, 1)."""
+    if "#" not in item:
+        return item, 0, 1
+    path, frac = item.rsplit("#", 1)
+    k, n = frac.split("/")
+    return path, int(k), int(n)
