@@ -1,0 +1,148 @@
+"""Multi-tier embedding storage: HBM working set + host-DRAM overflow.
+
+DeepRec's HbmDramStorage (core/framework/embedding/hbm_dram_storage.h, cache
++ EvictionManager in cache.h/eviction_manager.h) keeps hot keys on the GPU
+and migrates cold ones to DRAM with background threads. The TPU translation:
+the device table IS the hot tier (fixed-capacity HBM arrays); a host-side
+choreography step — run every `sync_every` steps, off the jitted hot path —
+demotes cold rows (lowest-frequency LFU or oldest-version LRU) to the native
+HostKV store and promotes host-resident rows whose keys reappeared on device.
+
+Promotion correctness: when a demoted key is looked up again, the device
+table creates a fresh slot with initializer values. sync() detects device
+rows whose key exists in the host tier and whose device freq is LOWER than
+the host freq — i.e. freshly re-created — and restores the host row
+(values + optimizer slots are NOT in the host tier; DeepRec's DRAM tier
+likewise stores values + stats, and optimizer slots restart. freq/version
+merge so admission state survives the round-trip).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeprec_tpu.config import StorageType
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
+from deeprec_tpu.native import HostKV
+
+
+@dataclasses.dataclass
+class TierStats:
+    demoted: int = 0
+    promoted: int = 0
+    host_size: int = 0
+    device_size: int = 0
+
+
+class MultiTierTable:
+    """Wraps an EmbeddingTable with a host overflow tier.
+
+    Usage: call `sync(state, step)` periodically from the host loop (e.g.
+    every N steps or at checkpoint time). Lookup/apply stay the plain
+    compiled table ops — the tier logic never touches the hot path, which is
+    what makes this design TPU-viable.
+    """
+
+    def __init__(
+        self,
+        table: EmbeddingTable,
+        high_watermark: float = 0.8,
+        low_watermark: float = 0.6,
+        storage_path: Optional[str] = None,
+    ):
+        cfg = table.cfg
+        self.table = table
+        self.high = high_watermark
+        self.low = low_watermark
+        self.host = HostKV(dim=cfg.dim, initial_capacity=cfg.capacity)
+        self.cache_strategy = cfg.ev.storage.cache_strategy
+        self.storage_path = storage_path or cfg.ev.storage.storage_path
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, state: TableState, step: int) -> tuple[TableState, TierStats]:
+        stats = TierStats()
+        keys = np.asarray(state.keys)
+        occ = keys != empty_key(self.table.cfg)
+        freq = np.asarray(state.freq)
+        version = np.asarray(state.version)
+
+        # -------- promote: device rows re-created while a host copy exists
+        dev_keys = keys[occ].astype(np.int64)
+        if len(dev_keys):
+            h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
+            dev_ix = np.nonzero(occ)[0][found]
+            if dev_ix.size:
+                hf = h_freq[found]
+                hv = h_vals[found]
+                hver = h_ver[found]
+                df = freq[dev_ix]
+                # freshly re-created rows have tiny device freq vs host freq
+                refreshed = df <= hf
+                if refreshed.any():
+                    ix = jnp.asarray(dev_ix[refreshed], jnp.int32)
+                    state = state.replace(
+                        values=state.values.at[ix].set(
+                            jnp.asarray(hv[refreshed], state.values.dtype)
+                        ),
+                        freq=state.freq.at[ix].add(
+                            jnp.asarray(hf[refreshed], jnp.int32)
+                        ),
+                    )
+                    stats.promoted = int(refreshed.sum())
+                # either way the host copy is now stale: drop it
+                self.host.erase(dev_keys[found])
+
+        # -------- demote: bring occupancy under the low watermark
+        C = state.capacity
+        live = int(occ.sum())
+        if live > int(self.high * C):
+            n_out = live - int(self.low * C)
+            occ_ix = np.nonzero(occ)[0]
+            if self.cache_strategy == "lru":
+                order = np.argsort(version[occ_ix])  # oldest-touched first
+            else:  # lfu
+                order = np.argsort(freq[occ_ix])  # coldest first
+            out_ix = occ_ix[order[:n_out]]
+            out_keys = keys[out_ix].astype(np.int64)
+            self.host.put(
+                out_keys,
+                np.asarray(state.values)[out_ix],
+                freq[out_ix],
+                version[out_ix],
+            )
+            keep = np.ones(C, bool)
+            keep[out_ix] = False
+            state = self.table.rebuild(state, keep=jnp.asarray(keep))
+            stats.demoted = int(n_out)
+
+        stats.host_size = len(self.host)
+        stats.device_size = int(self.table.size(state))
+        return state, stats
+
+    # ------------------------------------------------------------- serving
+
+    def lookup_with_fallback(self, state: TableState, ids) -> jnp.ndarray:
+        """Readonly lookup that also consults the host tier for misses —
+        the serving-path equivalent of HbmDram's CopyEmbeddingsFromCPUToGPU."""
+        emb = np.array(self.table.lookup_readonly(state, ids))  # writable copy
+        flat_ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        h_vals, _, _, found = self.host.get(flat_ids)
+        if found.any():
+            emb = emb.reshape(len(flat_ids), -1)
+            emb[found] = h_vals[found]
+            emb = emb.reshape(*np.asarray(ids).shape, -1)
+        return jnp.asarray(emb)
+
+    # ----------------------------------------------------------- spill/load
+
+    def spill(self, path: Optional[str] = None) -> None:
+        """Persist the host tier (the SSD/LevelDB-tier analog)."""
+        self.host.save(path or self.storage_path or "host_tier.bin")
+
+    def load(self, path: Optional[str] = None) -> None:
+        self.host.load(path or self.storage_path or "host_tier.bin")

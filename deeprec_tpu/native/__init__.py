@@ -1,0 +1,181 @@
+"""ctypes bindings for the native host runtime (libdeeprec_host.so).
+
+Native C++ is the right tool for the host-side KV store backing multi-tier
+embedding storage (DeepRec keeps this layer in C++ too — SURVEY.md §2.1). The
+library auto-builds with `make` on first use; a pure-numpy fallback keeps the
+framework functional in build-less environments (behavior-identical, slower).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdeeprec_host.so")
+_lib = None
+_build_attempted = False
+
+
+def _try_build() -> Optional[ctypes.CDLL]:
+    global _build_attempted
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True, timeout=120
+        )
+        return ctypes.CDLL(_SO)
+    except Exception:
+        return None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.path.exists(_SO):
+        try:
+            _lib = ctypes.CDLL(_SO)
+            return _lib
+        except OSError:
+            pass
+    _lib = _try_build()
+    if _lib is not None:
+        _configure(_lib)
+    return _lib
+
+
+def _configure(lib):
+    u64, i64p, f32p, i32p, u8p = (
+        ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.float32, flags="C"),
+        np.ctypeslib.ndpointer(np.int32, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+    )
+    lib.hkv_create.restype = ctypes.c_void_p
+    lib.hkv_create.argtypes = [ctypes.c_int, u64]
+    lib.hkv_destroy.argtypes = [ctypes.c_void_p]
+    lib.hkv_size.restype = u64
+    lib.hkv_size.argtypes = [ctypes.c_void_p]
+    lib.hkv_put_batch.argtypes = [ctypes.c_void_p, u64, i64p, f32p, i32p, i32p]
+    lib.hkv_get_batch.argtypes = [ctypes.c_void_p, u64, i64p, f32p, i32p, i32p, u8p]
+    lib.hkv_erase_batch.argtypes = [ctypes.c_void_p, u64, i64p]
+    lib.hkv_export.argtypes = [ctypes.c_void_p, i64p, f32p, i32p, i32p]
+    lib.hkv_save.restype = ctypes.c_int
+    lib.hkv_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hkv_load.restype = ctypes.c_int
+    lib.hkv_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+
+class HostKV:
+    """int64 key -> (float32[dim] value, freq, version) host store.
+
+    Native-backed when the .so is available; numpy-dict fallback otherwise.
+    """
+
+    def __init__(self, dim: int, initial_capacity: int = 1 << 16):
+        self.dim = dim
+        self._lib = load_library()
+        if self._lib is not None:
+            _configure(self._lib)
+            self._h = self._lib.hkv_create(dim, initial_capacity)
+            self._fallback = None
+        else:
+            self._h = None
+            self._fallback = {}
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def __len__(self) -> int:
+        if self.native:
+            return int(self._lib.hkv_size(self._h))
+        return len(self._fallback)
+
+    def put(self, keys, values, freqs=None, versions=None) -> None:
+        keys = np.ascontiguousarray(keys, np.int64)
+        values = np.ascontiguousarray(values, np.float32).reshape(len(keys), self.dim)
+        freqs = np.ascontiguousarray(
+            freqs if freqs is not None else np.zeros(len(keys)), np.int32
+        )
+        versions = np.ascontiguousarray(
+            versions if versions is not None else np.full(len(keys), -1), np.int32
+        )
+        if self.native:
+            self._lib.hkv_put_batch(self._h, len(keys), keys, values, freqs, versions)
+        else:
+            for i, k in enumerate(keys):
+                self._fallback[int(k)] = (
+                    values[i].copy(), int(freqs[i]), int(versions[i])
+                )
+
+    def get(self, keys) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """-> (values [n, dim], freqs [n], versions [n], found [n] bool)"""
+        keys = np.ascontiguousarray(keys, np.int64)
+        n = len(keys)
+        values = np.zeros((n, self.dim), np.float32)
+        freqs = np.zeros(n, np.int32)
+        versions = np.full(n, -1, np.int32)
+        found = np.zeros(n, np.uint8)
+        if self.native:
+            self._lib.hkv_get_batch(self._h, n, keys, values, freqs, versions, found)
+        else:
+            for i, k in enumerate(keys):
+                hit = self._fallback.get(int(k))
+                if hit is not None:
+                    values[i], freqs[i], versions[i] = hit
+                    found[i] = 1
+        return values, freqs, versions, found.astype(bool)
+
+    def erase(self, keys) -> None:
+        keys = np.ascontiguousarray(keys, np.int64)
+        if self.native:
+            self._lib.hkv_erase_batch(self._h, len(keys), keys)
+        else:
+            for k in keys:
+                self._fallback.pop(int(k), None)
+
+    def export(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        n = len(self)
+        keys = np.zeros(n, np.int64)
+        values = np.zeros((n, self.dim), np.float32)
+        freqs = np.zeros(n, np.int32)
+        versions = np.zeros(n, np.int32)
+        if self.native:
+            self._lib.hkv_export(self._h, keys, values, freqs, versions)
+        else:
+            for i, (k, (v, f, ver)) in enumerate(self._fallback.items()):
+                keys[i], values[i], freqs[i], versions[i] = k, v, f, ver
+        return keys, values, freqs, versions
+
+    def save(self, path: str) -> None:
+        if self.native:
+            rc = self._lib.hkv_save(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"hkv_save({path}) failed rc={rc}")
+        else:
+            k, v, f, ver = self.export()
+            np.savez(path, keys=k, values=v, freqs=f, versions=ver)
+
+    def load(self, path: str) -> None:
+        if self.native:
+            rc = self._lib.hkv_load(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"hkv_load({path}) failed rc={rc}")
+        else:
+            d = np.load(path if path.endswith(".npz") else path + ".npz")
+            self.put(d["keys"], d["values"], d["freqs"], d["versions"])
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None and self._lib is not None:
+            try:
+                self._lib.hkv_destroy(self._h)
+            except Exception:
+                pass

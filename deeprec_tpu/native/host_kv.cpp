@@ -1,0 +1,217 @@
+// Host-DRAM KV store for embedding overflow tiers.
+//
+// The native piece of the multi-tier storage design (SURVEY.md §2.1): DeepRec
+// keeps cold embeddings in DRAM/PMEM/SSD behind C++ KV interfaces
+// (embedding/kv_interface.h, dense_hash_map_kv.h, ssd_hash_kv.h). On a TPU VM
+// the analog is a host-memory table the Python tier choreographs against the
+// in-HBM device table: demote cold rows here, promote them back on re-touch,
+// spill to a file for the SSD tier. Open-addressing, power-of-two capacity,
+// auto-growing; batch APIs only (the ctypes boundary is amortized over
+// thousands of keys per call).
+//
+// Build: make (g++ -O3 -shared -fPIC). Exposed via ctypes — no pybind11
+// dependency per the environment constraints.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kEmpty = INT64_MIN;
+
+inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+struct Store {
+  int dim;
+  uint64_t capacity;  // power of two
+  uint64_t size;
+  std::vector<int64_t> keys;
+  std::vector<float> values;    // [capacity, dim]
+  std::vector<int32_t> freq;
+  std::vector<int32_t> version;
+
+  explicit Store(int d, uint64_t cap) : dim(d), capacity(cap), size(0) {
+    keys.assign(capacity, kEmpty);
+    values.assign(capacity * dim, 0.f);
+    freq.assign(capacity, 0);
+    version.assign(capacity, -1);
+  }
+
+  uint64_t probe(int64_t key) const {
+    uint64_t mask = capacity - 1;
+    uint64_t pos = mix64(static_cast<uint64_t>(key)) & mask;
+    while (keys[pos] != kEmpty && keys[pos] != key) pos = (pos + 1) & mask;
+    return pos;
+  }
+
+  void grow() {
+    Store bigger(dim, capacity * 2);
+    for (uint64_t i = 0; i < capacity; ++i) {
+      if (keys[i] == kEmpty) continue;
+      uint64_t pos = bigger.probe(keys[i]);
+      bigger.keys[pos] = keys[i];
+      std::memcpy(&bigger.values[pos * dim], &values[i * dim],
+                  sizeof(float) * dim);
+      bigger.freq[pos] = freq[i];
+      bigger.version[pos] = version[i];
+    }
+    bigger.size = size;
+    *this = std::move(bigger);
+  }
+
+  void put(int64_t key, const float* row, int32_t f, int32_t v) {
+    if ((size + 1) * 4 >= capacity * 3) grow();  // keep load factor < 75%
+    uint64_t pos = probe(key);
+    if (keys[pos] == kEmpty) {
+      keys[pos] = key;
+      ++size;
+    }
+    std::memcpy(&values[pos * dim], row, sizeof(float) * dim);
+    freq[pos] = f;
+    version[pos] = v;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hkv_create(int dim, uint64_t initial_capacity) {
+  uint64_t cap = 1024;
+  while (cap < initial_capacity) cap <<= 1;
+  return new Store(dim, cap);
+}
+
+void hkv_destroy(void* h) { delete static_cast<Store*>(h); }
+
+uint64_t hkv_size(void* h) { return static_cast<Store*>(h)->size; }
+
+int hkv_dim(void* h) { return static_cast<Store*>(h)->dim; }
+
+// Insert or overwrite n rows.
+void hkv_put_batch(void* h, uint64_t n, const int64_t* keys,
+                   const float* values, const int32_t* freqs,
+                   const int32_t* versions) {
+  Store* s = static_cast<Store*>(h);
+  for (uint64_t i = 0; i < n; ++i) {
+    s->put(keys[i], &values[i * s->dim], freqs ? freqs[i] : 0,
+           versions ? versions[i] : -1);
+  }
+}
+
+// Gather n rows; found[i]=1 when present (values/freqs/versions filled),
+// untouched outputs otherwise.
+void hkv_get_batch(void* h, uint64_t n, const int64_t* keys, float* out_values,
+                   int32_t* out_freqs, int32_t* out_versions,
+                   uint8_t* out_found) {
+  Store* s = static_cast<Store*>(h);
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t pos = s->probe(keys[i]);
+    if (s->keys[pos] == keys[i]) {
+      out_found[i] = 1;
+      std::memcpy(&out_values[i * s->dim], &s->values[pos * s->dim],
+                  sizeof(float) * s->dim);
+      if (out_freqs) out_freqs[i] = s->freq[pos];
+      if (out_versions) out_versions[i] = s->version[pos];
+    } else {
+      out_found[i] = 0;
+    }
+  }
+}
+
+// Remove n keys (missing keys ignored). Rebuilds once at the end so probe
+// chains stay healthy (backshift-free deletion).
+void hkv_erase_batch(void* h, uint64_t n, const int64_t* keys) {
+  Store* s = static_cast<Store*>(h);
+  uint64_t erased = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t pos = s->probe(keys[i]);
+    if (s->keys[pos] == keys[i]) {
+      s->keys[pos] = INT64_MIN + 1;  // tombstone, cleaned below
+      ++erased;
+    }
+  }
+  if (!erased) return;
+  Store fresh(s->dim, s->capacity);
+  for (uint64_t i = 0; i < s->capacity; ++i) {
+    if (s->keys[i] == kEmpty || s->keys[i] == INT64_MIN + 1) continue;
+    fresh.put(s->keys[i], &s->values[i * s->dim], s->freq[i], s->version[i]);
+  }
+  *s = std::move(fresh);
+}
+
+// Export all rows (caller allocates hkv_size() rows).
+void hkv_export(void* h, int64_t* keys, float* values, int32_t* freqs,
+                int32_t* versions) {
+  Store* s = static_cast<Store*>(h);
+  uint64_t j = 0;
+  for (uint64_t i = 0; i < s->capacity; ++i) {
+    if (s->keys[i] == kEmpty) continue;
+    keys[j] = s->keys[i];
+    std::memcpy(&values[j * s->dim], &s->values[i * s->dim],
+                sizeof(float) * s->dim);
+    freqs[j] = s->freq[i];
+    versions[j] = s->version[i];
+    ++j;
+  }
+}
+
+// File spill/load — the SSD/LevelDB-tier analog (ssd_hash_kv.h): a flat
+// binary record format (header + rows).
+int hkv_save(void* h, const char* path) {
+  Store* s = static_cast<Store*>(h);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint64_t magic = 0xDEE99EC0011ULL, dim = s->dim, n = s->size;
+  std::fwrite(&magic, 8, 1, f);
+  std::fwrite(&dim, 8, 1, f);
+  std::fwrite(&n, 8, 1, f);
+  for (uint64_t i = 0; i < s->capacity; ++i) {
+    if (s->keys[i] == kEmpty) continue;
+    std::fwrite(&s->keys[i], 8, 1, f);
+    std::fwrite(&s->values[i * s->dim], sizeof(float), s->dim, f);
+    std::fwrite(&s->freq[i], 4, 1, f);
+    std::fwrite(&s->version[i], 4, 1, f);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int hkv_load(void* h, const char* path) {
+  Store* s = static_cast<Store*>(h);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t magic = 0, dim = 0, n = 0;
+  if (std::fread(&magic, 8, 1, f) != 1 || magic != 0xDEE99EC0011ULL ||
+      std::fread(&dim, 8, 1, f) != 1 || dim != (uint64_t)s->dim ||
+      std::fread(&n, 8, 1, f) != 1) {
+    std::fclose(f);
+    return -2;
+  }
+  std::vector<float> row(s->dim);
+  for (uint64_t i = 0; i < n; ++i) {
+    int64_t k;
+    int32_t fr, ver;
+    if (std::fread(&k, 8, 1, f) != 1 ||
+        std::fread(row.data(), sizeof(float), s->dim, f) != (size_t)s->dim ||
+        std::fread(&fr, 4, 1, f) != 1 || std::fread(&ver, 4, 1, f) != 1) {
+      std::fclose(f);
+      return -3;
+    }
+    s->put(k, row.data(), fr, ver);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

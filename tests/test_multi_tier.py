@@ -1,0 +1,86 @@
+"""Multi-tier (HBM + host DRAM) storage tests — HbmDramStorage semantics
+(reference embedding_variable_ops_test.cc multi-tier cases)."""
+import jax.numpy as jnp
+import numpy as np
+
+from deeprec_tpu import EmbeddingTable, EmbeddingVariableOption, StorageOption, TableConfig
+from deeprec_tpu.config import StorageType
+from deeprec_tpu.embedding.multi_tier import MultiTierTable
+
+
+def make(capacity=64, strategy="lfu"):
+    cfg = TableConfig(
+        name="mt",
+        dim=4,
+        capacity=capacity,
+        ev=EmbeddingVariableOption(
+            storage=StorageOption(storage_type=StorageType.HBM_DRAM,
+                                  cache_strategy=strategy)
+        ),
+    )
+    t = EmbeddingTable(cfg)
+    return t, MultiTierTable(t, high_watermark=0.75, low_watermark=0.5)
+
+
+def test_demotion_on_pressure_and_fallback_serving():
+    t, mt = make()
+    s = t.create()
+    # fill beyond the high watermark (48/64); hot ids looked up many times
+    hot = jnp.arange(10, dtype=jnp.int32)
+    for _ in range(5):
+        s, _ = t.lookup_unique(s, hot, step=1)
+    cold = jnp.arange(10, 52, dtype=jnp.int32)
+    s, _ = t.lookup_unique(s, cold, step=2)
+
+    s, stats = mt.sync(s, step=3)
+    assert stats.demoted > 0
+    assert stats.device_size <= 32  # low watermark
+    assert stats.host_size == stats.demoted
+    # hot keys survive on device (LFU)
+    for k in range(10):
+        assert np.abs(np.asarray(t.lookup_readonly(s, jnp.array([k], jnp.int32)))).max() > 0
+    # demoted keys still servable through the fallback path
+    emb = mt.lookup_with_fallback(s, jnp.arange(52, dtype=jnp.int32))
+    assert np.isfinite(np.asarray(emb)).all()
+
+
+def test_promotion_restores_values():
+    t, mt = make()
+    s = t.create()
+    ids = jnp.arange(52, dtype=jnp.int32)
+    s, res = t.lookup_unique(s, ids, step=0)
+    # write recognizable values then force demotion
+    marked = jnp.full_like(res.embeddings, 3.25)
+    s = t.scatter_update(s, res.slot_ix, marked, mask=res.valid)
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0
+    host_before = stats.host_size
+
+    # demoted key 0..? — find one demoted id
+    demoted = [
+        k for k in range(52)
+        if np.abs(np.asarray(t.lookup_readonly(s, jnp.array([k], jnp.int32)))).max() < 3
+    ]
+    assert demoted
+    k = demoted[0]
+    # key comes back: device re-creates it with init values...
+    s, _ = t.lookup_unique(s, jnp.array([k], jnp.int32), step=2)
+    # ...and sync promotes the host row back
+    s, stats2 = mt.sync(s, step=3)
+    assert stats2.promoted >= 1
+    emb = np.asarray(t.lookup_readonly(s, jnp.array([k], jnp.int32)))
+    np.testing.assert_allclose(emb[0], 3.25, rtol=1e-6)
+    assert stats2.host_size < host_before  # host copy dropped after promote
+
+
+def test_spill_and_load(tmp_path):
+    t, mt = make()
+    s = t.create()
+    s, _ = t.lookup_unique(s, jnp.arange(52, dtype=jnp.int32), step=0)
+    s, stats = mt.sync(s, step=1)
+    assert stats.host_size > 0
+    p = str(tmp_path / "tier.bin")
+    mt.spill(p)
+    t2, mt2 = make()
+    mt2.load(p)
+    assert len(mt2.host) == stats.host_size
