@@ -1,0 +1,62 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Metric: DLRM synthetic-Criteo training throughput (examples/sec) on the
+available device, batch 2048, reference protocol mean(steps/sec) × batch
+(modelzoo/benchmark/*/README.md). vs_baseline compares against the
+reference's best published DLRM number: 188.11 global steps/sec × bs 2048 =
+385,249 examples/sec on 1×A100-80G + 64-core Xeon
+(docs/docs_en/Smart-Stage.md:182-190, see BASELINE.md).
+"""
+import json
+import time
+
+BASELINE_EXAMPLES_PER_SEC = 188.11 * 2048  # DLRM GPU SmartStage, BASELINE.md
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import DLRM
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    B = 2048
+    model = DLRM(emb_dim=16, capacity=1 << 20)
+    trainer = Trainer(model, Adagrad(lr=0.05))
+    state = trainer.init(0)
+    gen = SyntheticCriteo(batch_size=B, vocab=1_000_000, seed=0)
+
+    # Pre-generate host batches so input generation isn't measured.
+    batches = [
+        {k: jnp.asarray(v) for k, v in gen.batch().items()} for _ in range(8)
+    ]
+
+    # Warmup (compile + table fill).
+    for i in range(3):
+        state, mets = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(mets["loss"])
+
+    steps = 30
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, mets = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(mets["loss"])
+    dt = time.perf_counter() - t0
+
+    ex_per_sec = steps * B / dt
+    print(
+        json.dumps(
+            {
+                "metric": "dlrm_criteo_examples_per_sec",
+                "value": round(ex_per_sec, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(ex_per_sec / BASELINE_EXAMPLES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
