@@ -1,0 +1,1 @@
+from deeprec_tpu.serving.predictor import ModelServer, Predictor

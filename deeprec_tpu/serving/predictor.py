@@ -1,0 +1,240 @@
+"""Serving: jit-compiled predictor with hot-swapped full/delta model updates.
+
+Parity with DeepRec's serving stack (SURVEY.md §2.7/§3.4) re-cut for TPU:
+  * Processor initialize()/process()  -> Predictor(model, ckpt_dir) /
+    predict(batch) — one jitted readonly forward, no training machinery.
+  * ModelInstanceMgr's FullModelUpdate/DeltaModelUpdate background polling
+    (model_instance.h:44-232) -> poll_updates(): picks up new full
+    checkpoints and replays incremental deltas IN PLACE on the live sparse
+    tables, then atomically swaps the state reference.
+  * SessionGroup's N-sessions concurrency (direct_session_group.h) ->
+    ModelServer: a micro-batching queue in front of the jitted function.
+    JAX dispatch is thread-safe and XLA executes one program at a time per
+    device, so the TPU-native equivalent of "N sessions" is request
+    coalescing into full batches, not N executors.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeprec_tpu.optim.sparse import GradientDescent
+from deeprec_tpu.training.checkpoint import CheckpointManager
+from deeprec_tpu.training.trainer import Trainer, TrainState
+
+
+class Predictor:
+    """Load-latest-and-serve. Thread-safe; updates swap atomically."""
+
+    def __init__(self, model, ckpt_dir: str):
+        self.model = model
+        # Serving needs no optimizer; slot-less sparse opt keeps restore lean
+        # (checkpointed slot arrays are skipped when the template has none).
+        self._trainer = Trainer(model, GradientDescent(), optax.identity())
+        self._ck = CheckpointManager(ckpt_dir, self._trainer)
+        self._state: Optional[TrainState] = None
+        self._applied: set = set()
+        self._lock = threading.Lock()
+        self.reload()
+
+    # ------------------------------------------------------------- updates
+
+    def reload(self) -> None:
+        """Full reload from the latest checkpoint chain (FullModelUpdate)."""
+        with self._lock:
+            # List BEFORE restoring: a delta landing mid-restore then stays
+            # un-applied and is picked up by the next poll (replaying a delta
+            # restore() already consumed is idempotent, missing one is not).
+            dirs = set(self._dirs())
+            state = self._ck.restore()
+            self._state = state
+            self._applied = dirs
+
+    def _dirs(self) -> List[str]:
+        fulls = self._ck._list("full")
+        if not fulls:
+            return []
+        out = [f"full-{fulls[-1]}"]
+        out += [f"incr-{s}" for s in self._ck._list("incr") if s > fulls[-1]]
+        return out
+
+    def poll_updates(self) -> bool:
+        """Apply anything new: a newer full checkpoint triggers a full
+        reload; new deltas replay onto the live state (DeltaModelUpdate).
+        Returns True if the model changed."""
+        new = [d for d in self._dirs() if d not in self._applied]
+        if not new:
+            return False
+        if any(d.startswith("full-") for d in new):
+            self.reload()
+            return True
+        with self._lock:
+            state = self._state
+            last_step = int(state.step)
+            for d in sorted(new, key=lambda s: int(s.split("-")[1])):
+                state = self._ck._apply_ckpt(
+                    state, os.path.join(self._ck.dir, d), load_dense=True
+                )
+                last_step = max(last_step, int(d.split("-")[1]))
+                self._applied.add(d)
+            self._state = TrainState(
+                step=jnp.asarray(last_step, jnp.int32),
+                tables=state.tables,
+                dense=state.dense,
+                opt_state=state.opt_state,
+            )
+        return True
+
+    # ------------------------------------------------------------- predict
+
+    def predict(self, batch: Dict[str, np.ndarray]):
+        """Probabilities for one batch (dict keyed per task for MTL)."""
+        state = self._state  # atomic reference read
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        _, probs = self._trainer.eval_step(state, self._with_dummy_labels(batch))
+        return jax.tree.map(np.asarray, probs)
+
+    def _with_dummy_labels(self, batch):
+        # eval_step computes a loss; serve requests carry no labels. The
+        # model declares its tasks (label_tasks); single-task models use
+        # plain 'label'.
+        b = next(iter(batch.values())).shape[0]
+        out = dict(batch)
+        tasks = getattr(self.model, "label_tasks", None)
+        if tasks:
+            for task in tasks:
+                out.setdefault(f"label_{task}", jnp.zeros((b,), jnp.float32))
+        else:
+            out.setdefault("label", jnp.zeros((b,), jnp.float32))
+        return out
+
+    @property
+    def step(self) -> int:
+        return int(self._state.step)
+
+    def model_info(self) -> Dict:
+        """get_serving_model_info parity."""
+        sizes = {}
+        for name, t in self._trainer.tables.items():
+            sizes[name] = int(t.size(self._trainer.table_state(self._state, name)))
+        return {"step": self.step, "table_sizes": sizes}
+
+
+class ModelServer:
+    """Micro-batching front: coalesce single requests into device batches.
+
+    The SessionGroup analog — concurrency through batching, not through N
+    session replicas (docs/docs_en/SessionGroup.md's goal, TPU-shaped).
+    """
+
+    def __init__(
+        self,
+        predictor: Predictor,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        poll_updates_secs: float = 0.0,
+    ):
+        self.predictor = predictor
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+        self._poller = None
+        if poll_updates_secs > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, args=(poll_updates_secs,), daemon=True
+            )
+            self._poller.start()
+
+    def _poll_loop(self, secs):
+        while not self._stop.is_set():
+            time.sleep(secs)
+            try:
+                self.predictor.poll_updates()
+                self.update_failures = 0
+            except Exception as e:
+                # surfaced via consecutive-failure counter + log: a corrupt
+                # checkpoint must not silently freeze the served model
+                self.update_failures = getattr(self, "update_failures", 0) + 1
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "model update poll failed (%d consecutive): %s",
+                    self.update_failures, e,
+                )
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            pending = [first]
+            deadline = time.monotonic() + self.max_wait
+            while len(pending) < self.max_batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    pending.append(self._q.get(timeout=left))
+                except queue.Empty:
+                    break
+            self._serve(pending)
+
+    def _serve(self, pending: List[Tuple[Dict, "queue.Queue"]]):
+        reqs = [r for r, _ in pending]
+        sizes = [next(iter(r.values())).shape[0] for r in reqs]
+        batch = {
+            k: np.concatenate([np.asarray(r[k]) for r in reqs])
+            for k in reqs[0]
+        }
+        # Pad to a power-of-two bucket (capped at max_batch) so the jitted
+        # predict compiles once per bucket instead of once per arrival-timing
+        # dependent size — otherwise concurrent load is a compile storm.
+        total = sum(sizes)
+        bucket = 1
+        while bucket < total:
+            bucket <<= 1
+        bucket = min(max(bucket, 8), max(self.max_batch, total))
+        if bucket > total:
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], bucket - total, axis=0)])
+                for k, v in batch.items()
+            }
+        try:
+            probs = self.predictor.predict(batch)
+            off = 0
+            for (_, reply), n in zip(pending, sizes):
+                sl = (
+                    {k: v[off : off + n] for k, v in probs.items()}
+                    if isinstance(probs, dict)
+                    else probs[off : off + n]
+                )
+                reply.put(sl)
+                off += n
+        except Exception as e:
+            for _, reply in pending:
+                reply.put(e)
+
+    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
+        """Blocking predict for one (mini-)request — the process() call."""
+        reply: "queue.Queue" = queue.Queue(maxsize=1)
+        self._q.put((features, reply))
+        out = reply.get(timeout=timeout)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=2)
