@@ -23,6 +23,9 @@ class BST:
     ff: int = 128
     blocks: int = 1
     max_len: int = 200
+    # Pallas flash attention for long histories (SIM-scale); needs the
+    # padded sequence length to be a multiple of 128.
+    use_flash: bool = False
     hidden: Sequence[int] = (256, 64)
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
@@ -53,7 +56,8 @@ class BST:
         seq = seq + params["pos"][None, : L + 1, :]
         m = jnp.concatenate([mask, jnp.ones((B, 1), bool)], axis=1)
         for blk in params["blocks"]:
-            seq = nn.transformer_block_apply(blk, seq, m, self.heads)
+            seq = nn.transformer_block_apply(blk, seq, m, self.heads,
+                                             flash=self.use_flash)
         denom = jnp.sum(m, axis=1, keepdims=True).astype(jnp.float32)
         pooled = jnp.sum(seq, axis=1) / jnp.maximum(denom, 1.0)
         x = jnp.concatenate([inputs.pooled["user"], pooled], axis=-1)

@@ -20,6 +20,12 @@ from deeprec_tpu.models.criteo import criteo_features
 
 @dataclasses.dataclass
 class _MTBase:
+    """Subclasses with a `tasks` field expose label_tasks for serving."""
+
+    @property
+    def label_tasks(self):
+        return tuple(getattr(self, "tasks", ()))
+
     emb_dim: int = 8
     capacity: int = 1 << 14
     num_cat: int = 8
@@ -83,6 +89,7 @@ class ESMM(_MTBase):
     (conversions over the whole exposure space)."""
 
     tower: Sequence[int] = (64, 32)
+    label_tasks = ("ctr", "ctcvr")
 
     def init(self, key):
         k1, k2 = jax.random.split(key)
@@ -201,6 +208,7 @@ class DBMTL(_MTBase):
 
     bottom: Sequence[int] = (128,)
     tower: Sequence[int] = (32,)
+    label_tasks = ("ctr", "cvr")
 
     def init(self, key):
         k1, k2, k3, k4 = jax.random.split(key, 4)

@@ -170,16 +170,42 @@ def transformer_block_init(key, dim: int, heads: int, ff: int) -> Params:
     }
 
 
-def transformer_block_apply(p: Params, x, mask, heads: int):
-    """Post-LN transformer encoder block with padding mask: x [B, L, D]."""
+def transformer_block_apply(p: Params, x, mask, heads: int, flash: bool = False):
+    """Post-LN transformer encoder block with padding mask: x [B, L, D].
+
+    flash=True routes attention through the Pallas flash kernel (O(L·block)
+    memory — for long behavior histories; L must be a multiple of 128)."""
     B, L, D = x.shape
     H = heads
     qkv = matmul(x, p["qkv"]).reshape(B, L, 3, H, D // H)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, Dh]
-    logits = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(D / H)
-    logits = jnp.where(mask[:, None, None, :], logits, -1e9)
-    att = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhlm,bmhd->blhd", att, v).reshape(B, L, D)
+    if flash:
+        from deeprec_tpu.ops.flash_attention import flash_attention
+
+        blk = 128
+        Lp = ((L + blk - 1) // blk) * blk
+        pad = Lp - L
+        qh = jnp.moveaxis(q, 2, 1)  # [B, H, L, Dh]
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        if pad:
+            zeros = ((0, 0), (0, 0), (0, pad), (0, 0))
+            qh = jnp.pad(qh, zeros)
+            kh = jnp.pad(kh, zeros)
+            vh = jnp.pad(vh, zeros)
+            fmask = jnp.pad(mask, ((0, 0), (0, pad)))
+        else:
+            fmask = mask
+        out = jnp.moveaxis(flash_attention(qh, kh, vh, fmask), 1, 2)
+        out = out[:, :L].reshape(B, L, D)
+    else:
+        from deeprec_tpu.ops.flash_attention import attention_reference
+
+        out = attention_reference(
+            jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1), jnp.moveaxis(v, 2, 1),
+            mask,
+        )
+        out = jnp.moveaxis(out, 1, 2).reshape(B, L, D)
     x = layernorm_apply(p["ln1"], x + matmul(out, p["proj"]))
     ff = dense_apply(p["ff2"], jax.nn.relu(dense_apply(p["ff1"], x)))
     x = layernorm_apply(p["ln2"], x + ff)
