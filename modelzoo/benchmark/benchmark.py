@@ -1,0 +1,95 @@
+"""Modelzoo benchmark harness — trains every model, scrapes throughput/AUC.
+
+Parity with the reference harness (modelzoo/benchmark/{cpu,gpu}/benchmark.sh +
+config.yaml + log_process.py): each model runs `train.py` as a subprocess for
+`--steps` steps at `--batch_size`; throughput = mean(global_step/sec over the
+post-warmup window) × batch_size; final AUC scraped from the log. Emits one
+JSON report.
+
+Usage:  python modelzoo/benchmark/benchmark.py --steps 600 --batch_size 2048
+        [--models wide_and_deep,dlrm,...] [--sharded]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+ZOO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALL_MODELS = [
+    "wide_and_deep", "deepfm", "dlrm", "dcnv2", "masknet",
+    "din", "dien", "bst", "dssm",
+    "esmm", "mmoe", "ple", "dbmtl", "simple_multitask",
+]
+
+STEP_RE = re.compile(r"global_step/sec: ([0-9.]+)")
+AUC_RE = re.compile(r"Eval AUC: ([0-9.]+)")
+
+
+def run_model(name: str, args) -> dict:
+    cmd = [
+        sys.executable, os.path.join(ZOO, name, "train.py"),
+        "--steps", str(args.steps),
+        "--batch_size", str(args.batch_size),
+        "--capacity", str(args.capacity),
+        "--eval_every", str(args.steps),
+        "--log_every", "50",
+    ]
+    if args.sharded:
+        cmd.append("--sharded")
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=args.timeout,
+        cwd=os.path.join(ZOO, name),
+    )
+    log = proc.stdout + proc.stderr
+    sps = [float(m) for m in STEP_RE.findall(log)]
+    aucs = [float(m) for m in AUC_RE.findall(log)]
+    warm = sps[1:] if len(sps) > 1 else sps  # drop the compile window
+    out = {
+        "model": name,
+        "ok": proc.returncode == 0 and bool(warm),
+        "global_step_per_sec": round(sum(warm) / len(warm), 2) if warm else 0.0,
+        "examples_per_sec": round(
+            (sum(warm) / len(warm)) * args.batch_size, 1
+        ) if warm else 0.0,
+        "auc": aucs[-1] if aucs else None,
+    }
+    if not out["ok"]:
+        out["log_tail"] = log[-800:]
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", default=",".join(ALL_MODELS))
+    p.add_argument("--steps", type=int, default=600)
+    p.add_argument("--batch_size", type=int, default=2048)
+    p.add_argument("--capacity", type=int, default=1 << 18)
+    p.add_argument("--sharded", action="store_true")
+    p.add_argument("--timeout", type=int, default=1800)
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+
+    results = []
+    for name in args.models.split(","):
+        print(f"=== {name} ===", flush=True)
+        r = run_model(name.strip(), args)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    report = {
+        "batch_size": args.batch_size,
+        "steps": args.steps,
+        "results": results,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Shared modelzoo training driver — the `python train.py` CLI every model
+directory exposes (reference: modelzoo/<model>/train.py argument surface:
+--batch_size --steps --checkpoint ... README per model).
+
+Supports synthetic data (default; no dataset mounted) or real Criteo TSV /
+parquet files, single-device or mesh-sharded execution, full + incremental
+checkpointing, periodic eval with AUC, and benchmark-harness-compatible log
+lines:  `global_step/sec: <v>`  and  `Eval AUC: <v>`  (scraped by
+modelzoo/benchmark/benchmark.py the way log_process.py does).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+def build_argparser(name: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=f"Train {name} on TPU (deeprec_tpu)")
+    p.add_argument("--batch_size", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=2000)
+    p.add_argument("--emb_dim", type=int, default=16)
+    p.add_argument("--capacity", type=int, default=1 << 20)
+    p.add_argument("--vocab", type=int, default=1_000_000,
+                   help="synthetic id vocabulary per feature")
+    p.add_argument("--learning_rate", type=float, default=0.05)
+    p.add_argument("--dense_lr", type=float, default=1e-3)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adagrad_decay", "adam",
+                            "adam_async", "adamw", "ftrl"])
+    p.add_argument("--data", default="synthetic",
+                   help="'synthetic', a criteo .tsv glob, or a .parquet glob")
+    p.add_argument("--sharded", action="store_true",
+                   help="shard tables + batch over all local devices")
+    p.add_argument("--checkpoint", default="",
+                   help="checkpoint directory (enables save/restore)")
+    p.add_argument("--save_steps", type=int, default=1000)
+    p.add_argument("--incremental_save_steps", type=int, default=0)
+    p.add_argument("--eval_every", type=int, default=500)
+    p.add_argument("--eval_batches", type=int, default=8)
+    p.add_argument("--log_every", type=int, default=100)
+    p.add_argument("--filter_freq", type=int, default=0,
+                   help="counter-filter admission threshold")
+    p.add_argument("--steps_to_live", type=int, default=0,
+                   help="TTL eviction in steps (0 = off)")
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def ev_option(args):
+    from deeprec_tpu import (
+        CounterFilter,
+        EmbeddingVariableOption,
+        GlobalStepEvict,
+    )
+
+    return EmbeddingVariableOption(
+        counter_filter=CounterFilter(args.filter_freq) if args.filter_freq else None,
+        global_step_evict=(
+            GlobalStepEvict(args.steps_to_live) if args.steps_to_live else None
+        ),
+    )
+
+
+def make_optimizers(args):
+    import optax
+
+    from deeprec_tpu.optim import make
+
+    return make(args.optimizer, lr=args.learning_rate), optax.adam(args.dense_lr)
+
+
+def make_data(args, kind: str):
+    """kind: 'criteo' | 'multitask' | 'behavior' | 'twotower'."""
+    import glob
+
+    from deeprec_tpu import data as D
+
+    if args.data != "synthetic":
+        paths = sorted(glob.glob(args.data))
+        if not paths:
+            raise FileNotFoundError(f"--data glob matched nothing: {args.data}")
+        if paths[0].endswith(".parquet"):
+            return D.staged(iter(D.ParquetReader(paths, args.batch_size)))
+        return D.staged(iter(D.CriteoCSVReader(paths, args.batch_size)))
+    if kind == "criteo":
+        gen = D.SyntheticCriteo(args.batch_size, vocab=args.vocab, seed=args.seed)
+    elif kind == "multitask":
+        gen = D.SyntheticMultiTask(
+            args.batch_size, num_cat=8, num_dense=4, vocab=args.vocab,
+            seed=args.seed,
+        )
+    elif kind == "behavior":
+        gen = D.SyntheticBehaviorSequence(
+            args.batch_size, vocab=args.vocab, seed=args.seed
+        )
+    elif kind == "twotower":
+        gen = D.SyntheticTwoTower(args.batch_size, vocab=args.vocab,
+                                  seed=args.seed)
+    else:
+        raise ValueError(kind)
+    return D.staged(iter(gen))
+
+
+def run(model, args, data_kind: str) -> Dict[str, float]:
+    """The MonitoredTrainingSession loop: train, log steps/sec, eval AUC,
+    checkpoint (full + incremental)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    sparse_opt, dense_opt = make_optimizers(args)
+    if args.sharded:
+        from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+
+        mesh = make_mesh()
+        trainer = ShardedTrainer(model, sparse_opt, dense_opt, mesh=mesh)
+        put = lambda b: shard_batch(mesh, {k: jnp.asarray(v) for k, v in b.items()})
+    else:
+        trainer = Trainer(model, sparse_opt, dense_opt)
+        put = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
+
+    state = trainer.init(args.seed)
+    ck = None
+    if args.checkpoint:
+        ck = CheckpointManager(args.checkpoint, trainer)
+        try:
+            state = ck.restore()
+            print(f"restored from step {int(state.step)}")
+        except FileNotFoundError:
+            pass
+
+    data = make_data(args, data_kind)
+    eval_batches = [put(next(iter(data))) for _ in range(args.eval_batches)]
+
+    t0 = time.perf_counter()
+    window_start = int(state.step)
+    last_metrics = {}
+    for batch in data:
+        step = int(state.step)
+        if step >= args.steps:
+            break
+        state, mets = trainer.train_step(state, put(batch))
+        step += 1
+        if step % args.log_every == 0:
+            jax.block_until_ready(mets["loss"])
+            dt = time.perf_counter() - t0
+            sps = (step - window_start) / max(dt, 1e-9)
+            print(
+                f"step {step} loss {float(mets['loss']):.5f} "
+                f"global_step/sec: {sps:.2f}",
+                flush=True,
+            )
+            t0 = time.perf_counter()
+            window_start = step
+        if args.eval_every and step % args.eval_every == 0:
+            ev = trainer.evaluate(state, eval_batches)
+            for k, v in ev.items():
+                if k.startswith("auc"):
+                    print(f"Eval AUC: {v:.6f} ({k})", flush=True)
+            last_metrics = ev
+            t0 = time.perf_counter()
+            window_start = step
+        if ck and args.save_steps and step % args.save_steps == 0:
+            state, path = ck.save(state)
+            print(f"saved full checkpoint: {path}", flush=True)
+        elif (
+            ck
+            and args.incremental_save_steps
+            and step % args.incremental_save_steps == 0
+        ):
+            state, path = ck.save_incremental(state)
+            print(f"saved incremental checkpoint: {path}", flush=True)
+
+    ev = trainer.evaluate(state, eval_batches)
+    for k, v in ev.items():
+        if k.startswith("auc"):
+            print(f"Eval AUC: {v:.6f} ({k})", flush=True)
+    if ck:
+        state, path = ck.save(state)
+        print(f"saved final checkpoint: {path}", flush=True)
+    return ev
+
+
+def main(name: str, model_fn: Callable, data_kind: str, argv=None):
+    args = build_argparser(name).parse_args(argv)
+    model = model_fn(args)
+    return run(model, args, data_kind)

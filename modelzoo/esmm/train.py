@@ -1,0 +1,15 @@
+"""ESMM multi-task CTR/CTCVR (reference: modelzoo/esmm)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from common import ev_option, main
+
+
+def model_fn(args):
+    from deeprec_tpu.models import ESMM
+
+    return ESMM(emb_dim=args.emb_dim, capacity=args.capacity, ev=ev_option(args))
+
+
+if __name__ == "__main__":
+    main("esmm", model_fn, "multitask")

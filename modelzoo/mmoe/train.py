@@ -1,0 +1,15 @@
+"""MMoE multi-task (reference: modelzoo/mmoe)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from common import ev_option, main
+
+
+def model_fn(args):
+    from deeprec_tpu.models import MMoE
+
+    return MMoE(emb_dim=args.emb_dim, capacity=args.capacity, ev=ev_option(args))
+
+
+if __name__ == "__main__":
+    main("mmoe", model_fn, "multitask")

@@ -1,0 +1,15 @@
+"""Wide & Deep on Criteo (reference: modelzoo/wide_and_deep)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from common import ev_option, main
+
+
+def model_fn(args):
+    from deeprec_tpu.models import WDL
+
+    return WDL(emb_dim=args.emb_dim, capacity=args.capacity, ev=ev_option(args))
+
+
+if __name__ == "__main__":
+    main("wide_and_deep", model_fn, "criteo")
