@@ -23,5 +23,6 @@ from deeprec_tpu.config import (
 )
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup
 from deeprec_tpu.embedding.combiners import combine
+from deeprec_tpu.features import DenseFeature, SparseFeature
 
 __version__ = "0.1.0"

@@ -1,0 +1,112 @@
+"""Composite embedding schemes: multi-hash compression and adaptive
+static+dynamic lookup.
+
+Parity targets:
+  * tf.get_multihash_variable (reference tensorflow/python/ops/
+    variable_scope.py:1642 / kv_variable_ops.py MultiHashVariable): the
+    quotient–remainder trick — two small tables indexed by complementary
+    hashes of the id, combined (add/mul/concat) into one embedding. O(sqrt V)
+    memory for a V-sized vocabulary at the cost of controlled collisions.
+  * tf.nn.adaptive_embedding_lookup_sparse (embedding_ops.py:667): ids are
+    dynamically partitioned between a compact static bucketed table (cheap,
+    collisions allowed — the long tail) and the exact hash table (hot,
+    important ids), by observed frequency.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeprec_tpu.config import TableConfig
+from deeprec_tpu.embedding.table import EmbeddingTable, TableState
+from deeprec_tpu.utils import hashing
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHashConfig:
+    name: str
+    dim: int
+    num_buckets_q: int  # quotient table rows (power of two)
+    num_buckets_r: int  # remainder table rows (power of two)
+    strategy: str = "add"  # add | mul | concat
+
+
+class MultiHashTable:
+    """Quotient–remainder composed embedding. Both component tables are
+    ordinary dense arrays (every bucket always exists — no admission), so
+    this is a pure-compute lookup fully fused by XLA."""
+
+    def __init__(self, cfg: MultiHashConfig):
+        self.cfg = cfg
+        if cfg.strategy not in ("add", "mul", "concat"):
+            raise ValueError(cfg.strategy)
+
+    @property
+    def dim(self) -> int:
+        d = self.cfg.dim
+        return 2 * d if self.cfg.strategy == "concat" else d
+
+    def create(self, key) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        kq, kr = jax.random.split(key)
+        d = self.cfg.dim
+        q = jax.random.normal(kq, (self.cfg.num_buckets_q, d)) * 0.05
+        r = jax.random.normal(kr, (self.cfg.num_buckets_r, d)) * 0.05
+        return q, r
+
+    def lookup(self, params: Tuple[jnp.ndarray, jnp.ndarray], ids: jnp.ndarray):
+        q_tab, r_tab = params
+        Q = self.cfg.num_buckets_q
+        R = self.cfg.num_buckets_r
+        qi = (ids.astype(jnp.uint32) // jnp.uint32(R)) % jnp.uint32(Q)
+        ri = ids.astype(jnp.uint32) % jnp.uint32(R)
+        eq = q_tab[qi.astype(jnp.int32)]
+        er = r_tab[ri.astype(jnp.int32)]
+        if self.cfg.strategy == "add":
+            return eq + er
+        if self.cfg.strategy == "mul":
+            return eq * er
+        return jnp.concatenate([eq, er], axis=-1)
+
+
+class AdaptiveEmbedding:
+    """Frequency-adaptive routing between a static bucketed table and the
+    exact hash table.
+
+    lookup(): ids admitted by the hash table (frequency >= the table's
+    counter-filter threshold, or simply present) read exact embeddings; the
+    rest read a hash-bucketed static row. The static table absorbs the long
+    tail at fixed memory; the hash table gives head ids exact, evictable,
+    checkpointable embeddings — the adaptive_embedding_lookup semantics with
+    the dynamic_partition replaced by a masked select.
+    """
+
+    def __init__(self, table: EmbeddingTable, static_buckets: int = 1 << 14):
+        assert static_buckets & (static_buckets - 1) == 0
+        self.table = table
+        self.static_buckets = static_buckets
+
+    def create_static(self, key) -> jnp.ndarray:
+        return jax.random.normal(key, (self.static_buckets, self.table.cfg.dim)) * 0.05
+
+    def lookup_unique(self, state: TableState, static_tab, ids, *, step=0,
+                      train=True, pad_value=-1):
+        state, res = self.table.lookup_unique(
+            state, ids, step=step, train=train, pad_value=pad_value
+        )
+        bucket = hashing.hash_to_bucket(res.uids, self.static_buckets, salt=0xADA)
+        e_static = static_tab[bucket]
+        use_exact = res.admitted[:, None]
+        emb = jnp.where(use_exact, res.embeddings, e_static.astype(res.embeddings.dtype))
+        return state, res.replace(embeddings=emb), use_exact[:, 0]
+
+    def grads(self, res, use_exact, grad_u):
+        """Split upstream grads: exact-path rows go to the hash table's
+        sparse apply, static-path rows return (bucket_ix, grads) for a dense
+        scatter-add by the caller's optimizer."""
+        g_exact = jnp.where(use_exact[:, None], grad_u, 0.0)
+        g_static = jnp.where(use_exact[:, None], 0.0, grad_u)
+        bucket = hashing.hash_to_bucket(res.uids, self.static_buckets, salt=0xADA)
+        return g_exact, (bucket, g_static)

@@ -43,15 +43,35 @@ class DSSM:
             "temp": jnp.asarray(5.0),
         }
 
+    @staticmethod
+    def _normalize(x):
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-6)
+
     def towers(self, params, inputs):
-        u = jnp.concatenate([inputs.pooled[n] for n in self.user_feats], -1)
-        v = jnp.concatenate([inputs.pooled[n] for n in self.item_feats], -1)
-        u = nn.mlp_apply(params["user"], u)
-        v = nn.mlp_apply(params["item"], v)
-        u = u / jnp.maximum(jnp.linalg.norm(u, axis=-1, keepdims=True), 1e-6)
-        v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-6)
+        u = self.user_vector(params, inputs)
+        v = self.item_vectors(
+            params, jnp.concatenate([inputs.pooled[n] for n in self.item_feats], -1)
+        )
         return u, v
 
     def apply(self, params, inputs, train: bool):
         u, v = self.towers(params, inputs)
         return jnp.sum(u * v, axis=-1) * params["temp"]
+
+    def user_vector(self, params, inputs):
+        """User tower alone — compute once per user."""
+        u = jnp.concatenate([inputs.pooled[n] for n in self.user_feats], -1)
+        return self._normalize(nn.mlp_apply(params["user"], u))
+
+    def item_vectors(self, params, item_embs):
+        """Item tower over [N, F*D] stacked item features."""
+        return self._normalize(nn.mlp_apply(params["item"], item_embs))
+
+    def score_items(self, params, user_vec, item_vecs):
+        """Score a user against N candidate items at once — the
+        sample-aware-compression pattern (user subgraph computed once per
+        <user, N items> group, docs/docs_en/Sample-awared-Graph-Compression.md).
+        user_vec [B, H], item_vecs [B, N, H] or [N, H]."""
+        if item_vecs.ndim == 2:
+            return user_vec @ item_vecs.T * params["temp"]  # [B, N]
+        return jnp.einsum("bh,bnh->bn", user_vec, item_vecs) * params["temp"]

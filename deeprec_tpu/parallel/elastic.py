@@ -1,0 +1,40 @@
+"""Elastic re-scaling: move live training state between topologies.
+
+DeepRec's elastic training re-partitions PS-resident EVs through a gRPC
+scaling protocol (core/protobuf/elastic_training.proto, ElasticGrpcServer —
+SURVEY.md §2.5). Here the equivalent is a structural property plus one
+helper: checkpoints restore by re-probing keys, so ANY saved state loads
+onto ANY mesh size or capacity; `reshard` packages that as a single in-memory
+move for scale-up/scale-down events, and the file-coordinated WorkQueue
+(`data/work_queue.py`) re-balances the data stream automatically because
+workers pull items dynamically.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from deeprec_tpu.training.checkpoint import CheckpointManager
+from deeprec_tpu.training.trainer import TrainState, Trainer
+
+
+def reshard(
+    src_trainer: Trainer,
+    src_state: TrainState,
+    dst_trainer: Trainer,
+    scratch_dir: Optional[str] = None,
+) -> TrainState:
+    """Re-partition `src_state` onto `dst_trainer`'s topology (different mesh
+    size, different capacities, sharded<->single-device — anything whose
+    model/features match).
+
+    Goes through the checkpoint container (host RAM-disk scratch) so the
+    exact same tested export/import path handles the move; keys re-probe into
+    their new owners' shards.
+    """
+    d = scratch_dir or tempfile.mkdtemp(prefix="reshard_")
+    src_ck = CheckpointManager(d, src_trainer, keep=1)
+    _, path = src_ck.save(src_state)
+    dst_state = CheckpointManager(d, dst_trainer, keep=1).restore()
+    return dst_state

@@ -1,0 +1,56 @@
+"""Profiling/tracing — the timeline analog.
+
+DeepRec exposes per-step timelines via RunOptions.trace_level +
+StepStatsCollector and modelzoo --timeline flags (SURVEY.md §5). On TPU the
+native equivalent is the XLA/JAX profiler: traces capture HLO-level device
+timelines viewable in TensorBoard/Perfetto. One context manager + a
+step-windowed helper matching the reference's "--timeline N" UX.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/deeprec_tpu_trace") -> Iterator[str]:
+    """Capture a device trace for the enclosed block."""
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepWindowTracer:
+    """Trace steps [start, stop) of a training loop — the
+    START/STOP_NODE_STATS_STEP pattern (Executor-Optimization.md) without a
+    cost-model executor to feed: the trace goes to the human/profiler."""
+
+    def __init__(self, start_step: int, stop_step: int,
+                 logdir: str = "/tmp/deeprec_tpu_trace"):
+        self.start = start_step
+        self.stop = stop_step
+        self.logdir = logdir
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        """Call BEFORE dispatching step `step`; traces steps in
+        [start, stop). Range-based so a run resuming past `start` (e.g. from
+        a checkpoint) still enters the window if any of it remains."""
+        if self.start <= step < self.stop and not self._active:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

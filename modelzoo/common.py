@@ -48,6 +48,9 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
                    help="TTL eviction in steps (0 = off)")
     p.add_argument("--bf16", action="store_true", default=True)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeline", type=int, default=0,
+                   help="trace steps [N, N+10) to --timeline_dir")
+    p.add_argument("--timeline_dir", default="/tmp/deeprec_tpu_trace")
     return p
 
 
@@ -139,6 +142,13 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
     data = make_data(args, data_kind)
     eval_batches = [put(next(iter(data))) for _ in range(args.eval_batches)]
 
+    tracer = None
+    if args.timeline:
+        from deeprec_tpu.training.profiler import StepWindowTracer
+
+        tracer = StepWindowTracer(args.timeline, args.timeline + 10,
+                                  args.timeline_dir)
+
     t0 = time.perf_counter()
     window_start = int(state.step)
     last_metrics = {}
@@ -146,6 +156,8 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
         step = int(state.step)
         if step >= args.steps:
             break
+        if tracer:
+            tracer.on_step(step)
         state, mets = trainer.train_step(state, put(batch))
         step += 1
         if step % args.log_every == 0:
@@ -178,6 +190,8 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             state, path = ck.save_incremental(state)
             print(f"saved incremental checkpoint: {path}", flush=True)
 
+    if tracer:
+        tracer.close()
     ev = trainer.evaluate(state, eval_batches)
     for k, v in ev.items():
         if k.startswith("auc"):
