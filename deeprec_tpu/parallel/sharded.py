@@ -49,19 +49,42 @@ class ShardedLookup:
     valid: jnp.ndarray  # [U]
     embeddings: jnp.ndarray  # [U, D] local unique embeddings
     owner_res: UniqueLookup  # owner-side lookup (slot ids on the local shard)
-    o_inverse: jnp.ndarray  # [G] gathered-position -> owner-unique index
-    owned: jnp.ndarray  # [G] bool — rows this shard owns
+    o_inverse: jnp.ndarray  # [G] exchanged-position -> owner-unique index
+    owned: jnp.ndarray  # [G] bool — valid rows this shard received/owns
+    # a2a path only: [U] position of each local unique id in the [N*Bd] send
+    # buffer (-1 = overflow, served default this step); empty for allgather.
+    send_slot: jnp.ndarray = struct.field(default_factory=lambda: jnp.zeros((0,), jnp.int32))
 
 
 class ShardedTable:
     """Collective lookup/apply for one table sharded over `axis` (call the
     methods from inside a shard_map over that axis; state is the LOCAL shard's
-    TableState with capacity = global_capacity / num_shards)."""
+    TableState with capacity = global_capacity / num_shards).
 
-    def __init__(self, table: EmbeddingTable, num_shards: int, axis: str = "data"):
+    Two exchange strategies:
+      * comm="allgather" (default): all_gather ids + psum_scatter embeddings.
+        Exact for any skew; comm volume ~ U·D·(N−1) per device.
+      * comm="a2a": budgeted id all2all → owner lookup → embedding all2all —
+        the SOK lookup_sparse design (SURVEY.md §3.5). Comm volume
+        ~ slack·U·D, an ~N/2× reduction. Ids are bucketed by owner with a
+        per-destination budget of slack·U/N; overflow beyond the budget
+        (astronomically unlikely under a uniform hash at slack=2) serves the
+        default value for that step and is counted in state.insert_fails.
+    """
+
+    def __init__(
+        self,
+        table: EmbeddingTable,
+        num_shards: int,
+        axis: str = "data",
+        comm: str = "allgather",
+        a2a_slack: float = 2.0,
+    ):
         self.table = table
         self.num_shards = num_shards
         self.axis = axis
+        self.comm = comm
+        self.a2a_slack = a2a_slack
 
     def lookup_unique(
         self,
@@ -72,6 +95,18 @@ class ShardedTable:
         train: bool = True,
         pad_value: int = -1,
         salt=None,
+    ) -> Tuple[TableState, ShardedLookup]:
+        if self.comm == "a2a":
+            return self._lookup_a2a(
+                state, ids, step=step, train=train, pad_value=pad_value,
+                salt=salt,
+            )
+        return self._lookup_allgather(
+            state, ids, step=step, train=train, pad_value=pad_value, salt=salt
+        )
+
+    def _lookup_allgather(
+        self, state, ids, *, step, train, pad_value, salt
     ) -> Tuple[TableState, ShardedLookup]:
         cfg = self.table.cfg
         N = self.num_shards
@@ -129,6 +164,145 @@ class ShardedTable:
             owned=owned,
         )
 
+    # ------------------------------------------------------------- a2a path
+
+    def _a2a_budget(self, U: int) -> int:
+        import math
+
+        per_dest = math.ceil(U * self.a2a_slack / self.num_shards)
+        return max(8, ((per_dest + 7) // 8) * 8)  # pad to VPU-friendly size
+
+    def _lookup_a2a(
+        self, state, ids, *, step, train, pad_value, salt
+    ) -> Tuple[TableState, ShardedLookup]:
+        cfg = self.table.cfg
+        N = self.num_shards
+        axis = self.axis
+        sentinel = jnp.asarray(empty_key(cfg), ids.dtype)
+
+        flat = ids.reshape(-1)
+        U = flat.shape[0]
+        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
+        uids, inverse, counts = jnp.unique(
+            flat, size=U, fill_value=sentinel, return_inverse=True,
+            return_counts=True,
+        )
+        valid = uids != sentinel
+        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+
+        # Bucket by owner with a per-destination budget.
+        Bd = self._a2a_budget(U)
+        owner = jnp.where(
+            valid, hashing.hash_shard(uids, N), jnp.int32(N)
+        )  # invalid sort last
+        sort_ix = jnp.argsort(owner, stable=True)
+        sorted_owner = owner[sort_ix]
+        start = jnp.searchsorted(sorted_owner, jnp.arange(N, dtype=owner.dtype))
+        rank = jnp.arange(U, dtype=jnp.int32) - start[
+            jnp.clip(sorted_owner, 0, N - 1)
+        ].astype(jnp.int32)
+        slot_sorted = jnp.where(
+            (sorted_owner < N) & (rank < Bd), sorted_owner * Bd + rank, -1
+        )
+        send_slot = jnp.zeros((U,), jnp.int32).at[sort_ix].set(slot_sorted)
+        overflow = (send_slot < 0) & valid
+        sslot_safe = jnp.where(send_slot >= 0, send_slot, N * Bd)
+
+        buf_ids = jnp.full((N * Bd,), sentinel, uids.dtype).at[sslot_safe].set(
+            uids, mode="drop"
+        )
+        buf_counts = jnp.zeros((N * Bd,), jnp.int32).at[sslot_safe].set(
+            counts, mode="drop"
+        )
+        # Exchange: row j of the receive buffer = the bucket peer j sent us.
+        recv_ids = jax.lax.all_to_all(
+            buf_ids.reshape(N, Bd), axis, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1)
+        recv_counts = jax.lax.all_to_all(
+            buf_counts.reshape(N, Bd), axis, split_axis=0, concat_axis=0,
+            tiled=True,
+        ).reshape(-1)
+
+        recv_valid = recv_ids != sentinel
+        G2 = N * Bd
+        o_uids, o_inverse, _ = jnp.unique(
+            jnp.where(recv_valid, recv_ids, sentinel), size=G2,
+            fill_value=sentinel, return_inverse=True, return_counts=True,
+        )
+        o_valid = o_uids != sentinel
+        o_counts = (
+            jnp.zeros((G2,), jnp.int32)
+            .at[o_inverse]
+            .add(jnp.where(recv_valid, recv_counts, 0))
+        )
+        o_counts = jnp.where(o_valid, o_counts, 0)
+
+        state, res = self.table._lookup_resolved(
+            state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
+        )
+
+        e_out = res.embeddings[o_inverse].astype(jnp.float32)
+        e_out = e_out * recv_valid[:, None].astype(jnp.float32)
+        e_back = jax.lax.all_to_all(
+            e_out.reshape(N, Bd, -1), axis, split_axis=0, concat_axis=0,
+            tiled=True,
+        ).reshape(G2, -1)
+        # e_back[send_slot[u]] is u's embedding; overflow/invalid -> default.
+        emb_local = e_back.at[jnp.where(send_slot >= 0, send_slot, 0)].get(
+            mode="clip"
+        )
+        blocked = jnp.asarray(
+            cfg.ev.init.default_value_no_permission, jnp.float32
+        )
+        emb_local = jnp.where((send_slot >= 0)[:, None], emb_local, blocked)
+
+        if train:
+            state = state.replace(
+                insert_fails=state.insert_fails
+                + jnp.sum(overflow).astype(jnp.int32)
+            )
+        return state, ShardedLookup(
+            inverse=inverse.reshape(ids.shape),
+            counts=counts,
+            valid=valid,
+            embeddings=emb_local,
+            owner_res=res,
+            o_inverse=o_inverse,
+            owned=recv_valid,
+            send_slot=send_slot,
+        )
+
+    def _apply_a2a(
+        self, state, opt, sl, grad_u, *, step, lr, grad_averaging
+    ) -> TableState:
+        N = self.num_shards
+        G2 = sl.o_inverse.shape[0]
+        Bd = G2 // N
+        D = grad_u.shape[1]
+        sslot_safe = jnp.where(sl.send_slot >= 0, sl.send_slot, G2)
+        g_buf = (
+            jnp.zeros((G2, D), jnp.float32)
+            .at[sslot_safe]
+            .set(grad_u.astype(jnp.float32), mode="drop")
+        )
+        g_recv = jax.lax.all_to_all(
+            g_buf.reshape(N, Bd, D), self.axis, split_axis=0, concat_axis=0,
+            tiled=True,
+        ).reshape(G2, D)
+        o_grad = (
+            jnp.zeros((G2, D), jnp.float32)
+            .at[sl.o_inverse]
+            .add(g_recv * sl.owned[:, None].astype(jnp.float32))
+        )
+        # Same local-mean-loss rescale as the allgather path.
+        o_grad = o_grad / jnp.float32(N)
+        return optim_apply.apply_gradients(
+            self.table, state, opt, sl.owner_res, o_grad, step=step, lr=lr,
+            grad_averaging=grad_averaging,
+        )
+
+    # ------------------------------------------------------------- backward
+
     def apply_gradients(
         self,
         state: TableState,
@@ -140,6 +314,11 @@ class ShardedTable:
         lr=None,
         grad_averaging: bool = False,
     ) -> TableState:
+        if self.comm == "a2a":
+            return self._apply_a2a(
+                state, opt, sl, grad_u, step=step, lr=lr,
+                grad_averaging=grad_averaging,
+            )
         g_g = jax.lax.all_gather(
             grad_u.astype(jnp.float32), self.axis, tiled=True
         )  # [G, D]

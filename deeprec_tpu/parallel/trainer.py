@@ -65,6 +65,7 @@ class ShardedTrainer(Trainer):
         mesh: Optional[Mesh] = None,
         axis: str = "data",
         grad_averaging: bool = False,
+        comm: str = "allgather",  # or "a2a": budgeted all2all (SOK path)
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
@@ -76,7 +77,7 @@ class ShardedTrainer(Trainer):
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
         self.sharded = {
-            bname: ShardedTable(b.table, self.num_shards, axis)
+            bname: ShardedTable(b.table, self.num_shards, axis, comm=comm)
             for bname, b in self.bundles.items()
         }
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)

@@ -1,0 +1,73 @@
+"""All2all (SOK-style) exchange path must match the exact allgather path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad, GradientDescent
+from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+from deeprec_tpu.training import Trainer
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def small():
+    return WDL(emb_dim=8, capacity=1 << 13, hidden=(32,), num_cat=4, num_dense=2)
+
+
+def test_a2a_matches_allgather_and_local(mesh):
+    gen = SyntheticCriteo(batch_size=256, num_cat=4, num_dense=2, vocab=3000, seed=11)
+    batches = [J(gen.batch()) for _ in range(4)]
+
+    t_local = Trainer(small(), GradientDescent(lr=0.1), optax.sgd(0.01))
+    s_local = t_local.init(0)
+    t_ag = ShardedTrainer(small(), GradientDescent(lr=0.1), optax.sgd(0.01),
+                          mesh=mesh, comm="allgather")
+    s_ag = t_ag.init(0)
+    t_a2a = ShardedTrainer(small(), GradientDescent(lr=0.1), optax.sgd(0.01),
+                           mesh=mesh, comm="a2a")
+    s_a2a = t_a2a.init(0)
+
+    for b in batches:
+        s_local, ml = t_local.train_step(s_local, b)
+        sb = shard_batch(mesh, b)
+        s_ag, mag = t_ag.train_step(s_ag, sb)
+        s_a2a, ma2a = t_a2a.train_step(s_a2a, sb)
+        # a2a vs allgather: identical routing math, tiny fp-order differences
+        np.testing.assert_allclose(
+            float(mag["loss"]), float(ma2a["loss"]), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(ml["loss"]), float(ma2a["loss"]), rtol=2e-2
+        )
+
+
+def test_a2a_learns_with_skewed_ids(mesh):
+    """Zipf-skewed ids stress the per-destination budget; training must stay
+    healthy and overflow must be (near) zero at slack=2."""
+    model = small()
+    tr = ShardedTrainer(model, Adagrad(lr=0.2), optax.adam(5e-3), mesh=mesh,
+                        comm="a2a")
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=512, num_cat=4, num_dense=2, vocab=2000,
+                          zipf_a=1.6, seed=13)
+    losses = []
+    for _ in range(30):
+        st, m = tr.train_step(st, shard_batch(mesh, J(gen.batch())))
+        losses.append(float(m["loss"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    # overflow counter: sum across shards/groups
+    total_overflow = 0
+    for bname, ts in st.tables.items():
+        total_overflow += int(np.asarray(ts.insert_fails).sum())
+    assert total_overflow == 0, total_overflow
