@@ -71,6 +71,52 @@ class MultiHashTable:
         return jnp.concatenate([eq, er], axis=-1)
 
 
+class DynamicDimEmbedding:
+    """Frequency-tiered embedding dimension.
+
+    Parity: tf.get_dynamic_dimension_embedding_variable
+    (variable_scope.py:2372, dynamic_dim_feature_descriptor_impl.h): rare
+    keys train only a prefix of the embedding vector; the dimension steps up
+    with observed frequency. TPU translation: storage stays the full [C, D]
+    array (static shapes), but lookups MASK the tail dims of low-frequency
+    keys to zero — gradients to masked dims are zeroed by the same mask in
+    the backward (chain rule through the multiply), so those dims neither
+    train nor serve until the key graduates. The statistical effect (tail
+    keys get low-capacity vectors) is preserved; HBM savings come from
+    pairing with multi-tier demotion rather than ragged rows.
+    """
+
+    def __init__(self, table: EmbeddingTable, dim_tiers, freq_tiers):
+        """dim_tiers: ascending dims, e.g. (8, 16, 32) with full dim last;
+        freq_tiers: thresholds, len = len(dim_tiers) - 1: keys with
+        freq < freq_tiers[0] use dim_tiers[0], etc."""
+        assert len(dim_tiers) == len(freq_tiers) + 1
+        assert dim_tiers[-1] == table.cfg.dim
+        self.table = table
+        self.dim_tiers = tuple(dim_tiers)
+        self.freq_tiers = tuple(freq_tiers)
+
+    def effective_dim(self, state: TableState, res) -> jnp.ndarray:
+        present = res.slot_ix >= 0
+        safe_ix = jnp.where(present, res.slot_ix, 0)
+        # absent/blocked keys must not inherit slot 0's frequency: tier 0
+        freq = jnp.where(present, state.freq.at[safe_ix].get(mode="clip"), 0)
+        dim = jnp.full(freq.shape, self.dim_tiers[0], jnp.int32)
+        for d, thr in zip(self.dim_tiers[1:], self.freq_tiers):
+            dim = jnp.where(freq >= thr, d, dim)
+        return dim
+
+    def lookup_unique(self, state: TableState, ids, *, step=0, train=True,
+                      pad_value=-1):
+        state, res = self.table.lookup_unique(
+            state, ids, step=step, train=train, pad_value=pad_value
+        )
+        eff = self.effective_dim(state, res)  # [U]
+        col = jax.lax.broadcasted_iota(jnp.int32, res.embeddings.shape, 1)
+        masked = jnp.where(col < eff[:, None], res.embeddings, 0.0)
+        return state, res.replace(embeddings=masked)
+
+
 class AdaptiveEmbedding:
     """Frequency-adaptive routing between a static bucketed table and the
     exact hash table.

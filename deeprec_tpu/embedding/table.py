@@ -57,6 +57,12 @@ class TableState:
     bloom: Optional[jnp.ndarray]  # [M] int32 counting-Bloom sketch (CBF filter)
     dirty: jnp.ndarray  # [C] bool — touched since last incremental save
     insert_fails: jnp.ndarray  # [] int32 — ids that found no slot (grow signal)
+    # [] int32 — ids past the all2all per-destination budget (the knob is
+    # a2a_slack, NOT capacity — kept separate from insert_fails). Transient;
+    # not checkpointed, resets on rebuild.
+    a2a_overflow: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
 
     @property
     def capacity(self) -> int:

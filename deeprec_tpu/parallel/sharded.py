@@ -105,43 +105,53 @@ class ShardedTable:
             state, ids, step=step, train=train, pad_value=pad_value, salt=salt
         )
 
-    def _lookup_allgather(
-        self, state, ids, *, step, train, pad_value, salt
-    ) -> Tuple[TableState, ShardedLookup]:
-        cfg = self.table.cfg
-        N = self.num_shards
-        axis = self.axis
-        sentinel = jnp.asarray(empty_key(cfg), ids.dtype)
+    # ------------------------------------------------------- shared helpers
 
+    def _local_unique(self, ids, pad_value):
+        """Flatten + pad-collapse + dedup the local batch (both paths)."""
+        sentinel = jnp.asarray(empty_key(self.table.cfg), ids.dtype)
         flat = ids.reshape(-1)
         U = flat.shape[0]
         flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
         uids, inverse, counts = jnp.unique(
-            flat, size=U, fill_value=sentinel, return_inverse=True, return_counts=True
+            flat, size=U, fill_value=sentinel, return_inverse=True,
+            return_counts=True,
         )
         valid = uids != sentinel
         counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+        return sentinel, uids, inverse.reshape(ids.shape), counts, valid
 
-        # Exchange unique ids (cheap: ints) so every shard sees all candidates.
-        g_uids = jax.lax.all_gather(uids, axis, tiled=True)  # [G]
-        g_counts = jax.lax.all_gather(counts, axis, tiled=True)  # [G]
-        G = g_uids.shape[0]
-        me = jax.lax.axis_index(axis)
-        owned = (hashing.hash_shard(g_uids, N) == me) & (g_uids != sentinel)
-
-        # Owner-side global dedup: the same id may arrive from many replicas.
-        o_ids = jnp.where(owned, g_uids, sentinel)
+    def _owner_dedup(self, g_ids, g_counts, include, sentinel):
+        """Dedup exchanged ids on the owner side (the same id may arrive from
+        many peers) and segment-sum their counts."""
+        G = g_ids.shape[0]
         o_uids, o_inverse, _ = jnp.unique(
-            o_ids, size=G, fill_value=sentinel, return_inverse=True,
-            return_counts=True,
+            jnp.where(include, g_ids, sentinel), size=G, fill_value=sentinel,
+            return_inverse=True, return_counts=True,
         )
         o_valid = o_uids != sentinel
         o_counts = (
             jnp.zeros((G,), jnp.int32)
             .at[o_inverse]
-            .add(jnp.where(owned, g_counts, 0))
+            .add(jnp.where(include, g_counts, 0))
         )
-        o_counts = jnp.where(o_valid, o_counts, 0)
+        return o_uids, o_inverse, jnp.where(o_valid, o_counts, 0), o_valid
+
+    def _lookup_allgather(
+        self, state, ids, *, step, train, pad_value, salt
+    ) -> Tuple[TableState, ShardedLookup]:
+        N = self.num_shards
+        axis = self.axis
+        sentinel, uids, inverse, counts, valid = self._local_unique(ids, pad_value)
+
+        # Exchange unique ids (cheap: ints) so every shard sees all candidates.
+        g_uids = jax.lax.all_gather(uids, axis, tiled=True)  # [G]
+        g_counts = jax.lax.all_gather(counts, axis, tiled=True)  # [G]
+        me = jax.lax.axis_index(axis)
+        owned = (hashing.hash_shard(g_uids, N) == me) & (g_uids != sentinel)
+        o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
+            g_uids, g_counts, owned, sentinel
+        )
 
         state, res = self.table._lookup_resolved(
             state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
@@ -155,7 +165,7 @@ class ShardedTable:
         )  # [U, D]
 
         return state, ShardedLookup(
-            inverse=inverse.reshape(ids.shape),
+            inverse=inverse,
             counts=counts,
             valid=valid,
             embeddings=emb_local,
@@ -178,17 +188,8 @@ class ShardedTable:
         cfg = self.table.cfg
         N = self.num_shards
         axis = self.axis
-        sentinel = jnp.asarray(empty_key(cfg), ids.dtype)
-
-        flat = ids.reshape(-1)
-        U = flat.shape[0]
-        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
-        uids, inverse, counts = jnp.unique(
-            flat, size=U, fill_value=sentinel, return_inverse=True,
-            return_counts=True,
-        )
-        valid = uids != sentinel
-        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+        sentinel, uids, inverse, counts, valid = self._local_unique(ids, pad_value)
+        U = uids.shape[0]
 
         # Bucket by owner with a per-destination budget.
         Bd = self._a2a_budget(U)
@@ -225,17 +226,9 @@ class ShardedTable:
 
         recv_valid = recv_ids != sentinel
         G2 = N * Bd
-        o_uids, o_inverse, _ = jnp.unique(
-            jnp.where(recv_valid, recv_ids, sentinel), size=G2,
-            fill_value=sentinel, return_inverse=True, return_counts=True,
+        o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
+            recv_ids, recv_counts, recv_valid, sentinel
         )
-        o_valid = o_uids != sentinel
-        o_counts = (
-            jnp.zeros((G2,), jnp.int32)
-            .at[o_inverse]
-            .add(jnp.where(recv_valid, recv_counts, 0))
-        )
-        o_counts = jnp.where(o_valid, o_counts, 0)
 
         state, res = self.table._lookup_resolved(
             state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
@@ -258,11 +251,11 @@ class ShardedTable:
 
         if train:
             state = state.replace(
-                insert_fails=state.insert_fails
+                a2a_overflow=state.a2a_overflow
                 + jnp.sum(overflow).astype(jnp.int32)
             )
         return state, ShardedLookup(
-            inverse=inverse.reshape(ids.shape),
+            inverse=inverse,
             counts=counts,
             valid=valid,
             embeddings=emb_local,

@@ -1,0 +1,50 @@
+"""Training metrics logging — the summaries/observability analog.
+
+DeepRec relies on TF summaries + log scraping (SURVEY.md §5). Here: a JSONL
+metrics stream any dashboard can tail, plus the WorkQueue/table gauges the
+reference exposes (queue size via WorkQueue.add_summary, EV size via
+EVGetSize)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics: one record per call, wall-clock stamped."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def log(self, step: int, **scalars: Any) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        for k, v in scalars.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def table_gauges(trainer, state) -> Dict[str, int]:
+    """Live table sizes + insert-failure counters (EVGetSize parity)."""
+    out = {}
+    for name, t in trainer.tables.items():
+        ts = trainer.table_state(state, name)
+        # sharded states carry a leading shard dim; sum over it
+        occ = t.occupied(ts) if ts.keys.ndim == 1 else None
+        if occ is not None:
+            out[f"table_size/{name}"] = int(t.size(ts))
+        else:
+            import jax
+            import jax.numpy as jnp
+
+            sizes = jax.vmap(t.size)(ts)
+            out[f"table_size/{name}"] = int(jnp.sum(sizes))
+    return out

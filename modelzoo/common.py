@@ -35,6 +35,9 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
                    help="'synthetic', a criteo .tsv glob, or a .parquet glob")
     p.add_argument("--sharded", action="store_true",
                    help="shard tables + batch over all local devices")
+    p.add_argument("--comm", default="allgather", choices=["allgather", "a2a"],
+                   help="sharded embedding exchange: exact allgather or "
+                        "budgeted all2all (SOK path)")
     p.add_argument("--checkpoint", default="",
                    help="checkpoint directory (enables save/restore)")
     p.add_argument("--save_steps", type=int, default=1000)
@@ -51,6 +54,8 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
     p.add_argument("--timeline", type=int, default=0,
                    help="trace steps [N, N+10) to --timeline_dir")
     p.add_argument("--timeline_dir", default="/tmp/deeprec_tpu_trace")
+    p.add_argument("--metrics_file", default="",
+                   help="append JSONL metrics records here")
     return p
 
 
@@ -123,7 +128,8 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
         from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
 
         mesh = make_mesh()
-        trainer = ShardedTrainer(model, sparse_opt, dense_opt, mesh=mesh)
+        trainer = ShardedTrainer(model, sparse_opt, dense_opt, mesh=mesh,
+                                 comm=args.comm)
         put = lambda b: shard_batch(mesh, {k: jnp.asarray(v) for k, v in b.items()})
     else:
         trainer = Trainer(model, sparse_opt, dense_opt)
@@ -148,6 +154,11 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
 
         tracer = StepWindowTracer(args.timeline, args.timeline + 10,
                                   args.timeline_dir)
+    mlog = None
+    if args.metrics_file:
+        from deeprec_tpu.training.logging import MetricsLogger
+
+        mlog = MetricsLogger(args.metrics_file)
 
     t0 = time.perf_counter()
     window_start = int(state.step)
@@ -169,6 +180,8 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
                 f"global_step/sec: {sps:.2f}",
                 flush=True,
             )
+            if mlog:
+                mlog.log(step, loss=mets["loss"], steps_per_sec=sps)
             t0 = time.perf_counter()
             window_start = step
         if args.eval_every and step % args.eval_every == 0:

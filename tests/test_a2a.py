@@ -66,8 +66,8 @@ def test_a2a_learns_with_skewed_ids(mesh):
         st, m = tr.train_step(st, shard_batch(mesh, J(gen.batch())))
         losses.append(float(m["loss"]))
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
-    # overflow counter: sum across shards/groups
+    # overflow counter (separate from insert_fails): sum across shards/groups
     total_overflow = 0
     for bname, ts in st.tables.items():
-        total_overflow += int(np.asarray(ts.insert_fails).sum())
+        total_overflow += int(np.asarray(ts.a2a_overflow).sum())
     assert total_overflow == 0, total_overflow

@@ -1,0 +1,63 @@
+"""Dynamic-dimension embeddings + checkpoint shrink tool."""
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeprec_tpu import EmbeddingTable, TableConfig
+from deeprec_tpu.embedding.compose import DynamicDimEmbedding
+
+
+def test_dynamic_dim_masks_by_frequency():
+    t = EmbeddingTable(TableConfig(name="dd", dim=16, capacity=256))
+    dd = DynamicDimEmbedding(t, dim_tiers=(4, 8, 16), freq_tiers=(3, 6))
+    s = t.create()
+    hot, cold = jnp.array([1], jnp.int32), jnp.array([2], jnp.int32)
+    for i in range(7):
+        s, _ = dd.lookup_unique(s, hot, step=i)
+    s, res = dd.lookup_unique(s, jnp.array([1, 2], jnp.int32), step=8)
+    by_id = {int(u): i for i, u in enumerate(np.asarray(res.uids))}
+    e_hot = np.asarray(res.embeddings)[by_id[1]]
+    e_cold = np.asarray(res.embeddings)[by_id[2]]
+    assert np.abs(e_hot[8:]).max() > 0  # freq 8 >= 6 -> full 16 dims
+    assert np.abs(e_cold[:4]).max() > 0  # fresh key: first tier active
+    np.testing.assert_allclose(e_cold[4:], 0.0)  # tail masked
+
+
+def test_shrink_ckpt_tool(tmp_path):
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=3, num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=256, num_cat=3, num_dense=2, vocab=800, seed=1)
+    for _ in range(3):
+        st, _ = tr.train_step(st, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+    st, path = CheckpointManager(str(tmp_path), tr).save(st)
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "shrink_ckpt.py"),
+         path, "--min_freq", "3"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    out_dir = path.rstrip("/") + "-shrunk"
+    # shrunk tables are strict subsets and still load
+    import glob
+
+    for f in glob.glob(os.path.join(out_dir, "table_*.npz")):
+        d = dict(np.load(f))
+        assert (d["freqs"] >= 3).all()
+        orig = dict(np.load(os.path.join(path, os.path.basename(f))))
+        assert d["keys"].shape[0] <= orig["keys"].shape[0]

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Shrink a checkpoint by dropping filtered (non-admitted) keys.
+
+Parity: the shrink_ckpt_with_filtered_features tool referenced by
+docs/docs_en/Embedding-Variable.md — full checkpoints keep sub-threshold
+keys so admission counters survive training restarts, but serving-bound
+checkpoints don't need them. This rewrites table npz files keeping only rows
+with freq >= --min_freq (and optionally versions >= --min_version).
+
+Usage: python tools/shrink_ckpt.py <ckpt_dir>/full-<N> --min_freq 5 [--out DIR]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+def shrink_table(path: str, out_path: str, min_freq: int, min_version: int):
+    data = dict(np.load(path))
+    n = data["keys"].shape[0]
+    keep = data["freqs"] >= min_freq
+    if min_version > 0:
+        keep &= data["versions"] >= min_version
+    out = {}
+    for k, v in data.items():
+        if k == "partition_offset":
+            continue  # offsets are invalid after filtering; restore re-probes
+        out[k] = v[keep] if v.shape[:1] == (n,) else v
+    np.savez(out_path, **out)
+    return n, int(keep.sum())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("ckpt", help="a full-<step> checkpoint directory")
+    p.add_argument("--min_freq", type=int, default=1)
+    p.add_argument("--min_version", type=int, default=0)
+    p.add_argument("--out", default="", help="output dir (default: <ckpt>-shrunk)")
+    args = p.parse_args(argv)
+
+    out_dir = args.out or args.ckpt.rstrip("/") + "-shrunk"
+    os.makedirs(out_dir, exist_ok=True)
+    total_before = total_after = 0
+    for f in sorted(os.listdir(args.ckpt)):
+        src = os.path.join(args.ckpt, f)
+        dst = os.path.join(out_dir, f)
+        if f.startswith("table_") and f.endswith(".npz"):
+            b, a = shrink_table(src, dst, args.min_freq, args.min_version)
+            total_before += b
+            total_after += a
+            print(f"{f}: {b} -> {a} rows")
+        else:
+            shutil.copy(src, dst)
+    print(f"total: {total_before} -> {total_after} rows "
+          f"({out_dir})")
+
+
+if __name__ == "__main__":
+    main()
