@@ -83,6 +83,13 @@ class ShardedTrainer(Trainer):
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
         self._eval_step = jax.jit(self._sharded_eval)
 
+    def train_step_accum(self, state, batch, accum_steps, lr=None):
+        raise NotImplementedError(
+            "micro-batch accumulation on the sharded trainer: shard the batch "
+            "instead (per-device batches are already 1/N) or run the base "
+            "Trainer; in-shard_map scan accumulation lands in a later round"
+        )
+
     # ------------------------------------------------------------------ init
 
     def init(self, seed: int = 0) -> TrainState:

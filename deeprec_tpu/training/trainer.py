@@ -137,6 +137,7 @@ class Trainer:
         self.dense_specs = fcol.dense_features(model.features)
         self.bundles = build_bundles(model.features)
         self._train_step = jax.jit(self._step_impl, donate_argnums=0)
+        self._train_step_accum = jax.jit(self._accum_impl, donate_argnums=0)
         self._eval_step = jax.jit(self._eval_impl)
 
     # Back-compat/introspection: table object + state accessor per table name.
@@ -273,9 +274,9 @@ class Trainer:
             return sum(losses.values()), out
         return M.bce_loss(out, batch["label"]), out
 
-    def _step_impl(self, state: TrainState, batch, lr):
-        step = state.step
-        tables = dict(state.tables)
+    def _micro_step(self, tables, dense, batch, step, lr):
+        """Forward + backward + SPARSE applies for one (micro-)batch; returns
+        updated tables, the dense-grad pytree (NOT applied) and metrics."""
         tables, views, bundle_res = self._lookup_all(tables, batch, step, True)
         embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
 
@@ -287,21 +288,56 @@ class Trainer:
 
         (loss, out), (g_dense, g_embs) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
-        )(state.dense, embs)
-
-        updates, opt_state = self.dense_opt.update(g_dense, state.opt_state,
-                                                   state.dense)
-        dense = optax.apply_updates(state.dense, updates)
+        )(dense, embs)
         tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
-
-        new_state = TrainState(
-            step=step + 1, tables=tables, dense=dense, opt_state=opt_state
-        )
         mets = {"loss": loss}
         if not isinstance(out, dict):
             probs = jax.nn.sigmoid(out)
             mets["accuracy"] = M.accuracy(probs, batch["label"])
-        return new_state, mets
+        else:
+            mets["accuracy"] = jnp.zeros(())
+        return tables, g_dense, mets
+
+    def _step_impl(self, state: TrainState, batch, lr):
+        step = state.step
+        tables, g_dense, mets = self._micro_step(
+            dict(state.tables), state.dense, batch, step, lr
+        )
+        updates, opt_state = self.dense_opt.update(g_dense, state.opt_state,
+                                                   state.dense)
+        dense = optax.apply_updates(state.dense, updates)
+        return TrainState(
+            step=step + 1, tables=tables, dense=dense, opt_state=opt_state
+        ), mets
+
+    def _accum_impl(self, state: TrainState, batch, lr):
+        """Gradient micro-batching — the Auto-Micro-Batch analog
+        (reference graph_execution_state.cc:635 PipelineGraph duplicates the
+        compute graph N×; here it's a lax.scan over micro-batches): sparse
+        tables apply per micro-batch (the reference's semantics), dense grads
+        accumulate and apply once."""
+        step = state.step
+        A = next(iter(batch.values())).shape[0]
+
+        def micro(carry, mb):
+            tables, g_acc = carry
+            tables, g_dense, mets = self._micro_step(
+                tables, state.dense, mb, step, lr
+            )
+            g_acc = jax.tree.map(jnp.add, g_acc, g_dense)
+            return (tables, g_acc), mets
+
+        g0 = jax.tree.map(jnp.zeros_like, state.dense)
+        (tables, g_acc), mets = jax.lax.scan(
+            micro, (dict(state.tables), g0), batch
+        )
+        g_mean = jax.tree.map(lambda g: g / jnp.float32(A), g_acc)
+        updates, opt_state = self.dense_opt.update(g_mean, state.opt_state,
+                                                   state.dense)
+        dense = optax.apply_updates(state.dense, updates)
+        return TrainState(
+            step=step + 1, tables=tables, dense=dense, opt_state=opt_state
+        ), jax.tree.map(jnp.mean, mets)
 
     def _eval_impl(self, state: TrainState, batch):
         tables = dict(state.tables)
@@ -322,6 +358,19 @@ class Trainer:
         # lr always rides as a traced scalar so schedules never recompile.
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
         return self._train_step(state, batch, lr)
+
+    def train_step_accum(self, state: TrainState, batch, accum_steps: int,
+                         lr: Optional[float] = None):
+        """Micro-batched step: batch leaves [A*B, ...] are split into A
+        micro-batches; sparse tables update per micro-batch, dense params
+        once — DeepRec's micro_batch_num semantics with scan instead of graph
+        duplication. Cuts activation memory A× for large effective batches."""
+        def split(x):
+            return x.reshape(accum_steps, x.shape[0] // accum_steps,
+                             *x.shape[1:])
+
+        lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
+        return self._train_step_accum(state, jax.tree.map(split, batch), lr)
 
     def eval_step(self, state: TrainState, batch):
         return self._eval_step(state, batch)

@@ -1,0 +1,56 @@
+"""Gradient micro-batching (Auto-Micro-Batch parity)."""
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad, GradientDescent
+from deeprec_tpu.training import Trainer
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def model():
+    return WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=4, num_dense=2)
+
+
+def test_accum_learns_and_counts_one_step():
+    tr = Trainer(model(), Adagrad(lr=0.1), optax.adam(2e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=512, num_cat=4, num_dense=2, vocab=1000, seed=3)
+    b = J(gen.batch())
+    losses = []
+    for _ in range(10):
+        st, m = tr.train_step_accum(st, b, accum_steps=4)
+        losses.append(float(m["loss"]))
+    assert int(st.step) == 10  # one global step per accum call
+    assert losses[-1] < losses[0]
+
+
+def test_accum_dense_grads_match_full_batch():
+    """With plain SGD and a single pass, accumulated dense grads must equal
+    the full-batch gradient (sparse applies differ by design: per-micro)."""
+    gen = SyntheticCriteo(batch_size=256, num_cat=4, num_dense=2, vocab=500, seed=5)
+    b = J(gen.batch())
+
+    tr1 = Trainer(model(), GradientDescent(lr=0.0), optax.sgd(0.5))
+    s1 = tr1.init(0)
+    s1, _ = tr1.train_step(s1, b)
+
+    tr2 = Trainer(model(), GradientDescent(lr=0.0), optax.sgd(0.5))
+    s2 = tr2.init(0)
+    s2, _ = tr2.train_step_accum(s2, b, accum_steps=4)
+
+    # sparse lr=0 -> embeddings identical; dense updates must match because
+    # mean of micro-grads == full-batch grad for a mean loss
+    d1 = jnp.concatenate([x.reshape(-1) for x in
+                          (s1.dense["deep"]["layers"][0]["w"],
+                           s1.dense["wide_w"])])
+    d2 = jnp.concatenate([x.reshape(-1) for x in
+                          (s2.dense["deep"]["layers"][0]["w"],
+                           s2.dense["wide_w"])])
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=2e-4)
