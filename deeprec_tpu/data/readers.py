@@ -68,7 +68,57 @@ class CriteoCSVReader:
                 )
             yield out
 
+    def _iter_native(self) -> Optional[Iterator[Dict[str, np.ndarray]]]:
+        """Stream batches through the C++ parser (native/csv_parser.cpp) —
+        one pass over raw bytes, no DataFrame. Falls back to pandas when the
+        native library is unavailable. Id hashing is identical either way."""
+        from deeprec_tpu.native import criteo_parse_native, load_library
+
+        if load_library() is None:
+            return None
+
+        def gen():
+            CHUNK = max(1 << 20, self.B * 512)
+            for path in self.paths:
+                with open(path, "rb") as f:
+                    pending = b""
+                    while True:
+                        data = pending + f.read(CHUNK)
+                        if not data:
+                            break
+                        out = criteo_parse_native(
+                            data, self.B, self.num_dense, self.num_cat
+                        )
+                        if out is None:
+                            return
+                        rows, labels, dense, cats, consumed = out
+                        at_eof = len(data) < len(pending) + CHUNK
+                        if rows < self.B and not at_eof:
+                            pending = data  # need more bytes for a full batch
+                            continue
+                        pending = data[consumed:]
+                        if rows == 0:
+                            if at_eof:
+                                break
+                            continue
+                        if rows < self.B and self.drop_remainder:
+                            break
+                        batch: Dict[str, np.ndarray] = {
+                            "label": labels[:rows]
+                        }
+                        for i in range(self.num_dense):
+                            batch[f"I{i+1}"] = dense[:rows, i : i + 1]
+                        for i in range(self.num_cat):
+                            batch[f"C{i+1}"] = cats[:rows, i]
+                        yield batch
+
+        return gen()
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        native = self._iter_native()
+        if native is not None:
+            yield from native
+            return
         import pandas as pd
 
         for path in self.paths:

@@ -71,6 +71,16 @@ def _configure(lib):
     lib.hkv_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.hkv_load.restype = ctypes.c_int
     lib.hkv_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    if hasattr(lib, "criteo_parse"):
+        lib.criteo_parse.restype = ctypes.c_int64
+        lib.criteo_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C"),
+            np.ctypeslib.ndpointer(np.float32, flags="C"),
+            np.ctypeslib.ndpointer(np.int32, flags="C"),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
 
 
 class HostKV:
@@ -179,3 +189,27 @@ class HostKV:
                 self._lib.hkv_destroy(self._h)
             except Exception:
                 pass
+
+
+def criteo_parse_native(
+    buf: bytes, max_rows: int, num_dense: int = 13, num_cat: int = 26
+):
+    """Parse Criteo TSV bytes with the native parser.
+
+    Returns (rows, labels, dense, cats, consumed_bytes) or None when the
+    native library is unavailable. The id hashing matches
+    data/readers._hash_strings exactly, so outputs are interchangeable.
+    """
+    lib = load_library()
+    if lib is None or not hasattr(lib, "criteo_parse"):
+        return None
+    _configure(lib)
+    labels = np.zeros(max_rows, np.float32)
+    dense = np.zeros((max_rows, num_dense), np.float32)
+    cats = np.zeros((max_rows, num_cat), np.int32)
+    consumed = ctypes.c_int64(0)
+    rows = lib.criteo_parse(
+        buf, len(buf), max_rows, num_dense, num_cat, labels,
+        dense.reshape(-1), cats.reshape(-1), ctypes.byref(consumed),
+    )
+    return int(rows), labels, dense, cats, int(consumed.value)

@@ -40,6 +40,42 @@ def test_criteo_csv_reader(tmp_path):
     assert (b["C1"] >= 0).all()  # hashed to non-negative id space
 
 
+def test_native_csv_parser_matches_pandas(tmp_path):
+    """The C++ parser (native/csv_parser.cpp) must be bit-identical to the
+    pandas path, including missing-field handling and id hashing."""
+    import deeprec_tpu.native as N
+
+    if N.load_library() is None:
+        pytest.skip("native library not built")
+    rng = np.random.default_rng(3)
+    p = str(tmp_path / "day.tsv")
+    with open(p, "w") as f:
+        for _ in range(3000):
+            label = rng.integers(0, 2)
+            dense = "\t".join(
+                str(rng.integers(0, 100)) if rng.random() > 0.1 else ""
+                for _ in range(13)
+            )
+            cats = "\t".join(
+                f"{rng.integers(0, 1 << 20):x}" if rng.random() > 0.1 else ""
+                for _ in range(26)
+            )
+            f.write(f"{label}\t{dense}\t{cats}\n")
+    native = list(CriteoCSVReader([p], batch_size=512)._iter_native())
+    orig = N.load_library
+    N.load_library = lambda: None
+    try:
+        pandas = list(CriteoCSVReader([p], batch_size=512))
+    finally:
+        N.load_library = orig
+    assert len(native) == len(pandas) == 5
+    for nb, pb in zip(native, pandas):
+        np.testing.assert_array_equal(nb["label"], pb["label"])
+        np.testing.assert_allclose(nb["I7"], pb["I7"], rtol=1e-6)
+        for c in ("C1", "C13", "C26"):
+            np.testing.assert_array_equal(nb[c], pb[c])
+
+
 def test_parquet_reader(tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq
