@@ -81,14 +81,8 @@ class ShardedTrainer(Trainer):
             for bname, b in self.bundles.items()
         }
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
+        self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)
         self._eval_step = jax.jit(self._sharded_eval)
-
-    def train_step_accum(self, state, batch, accum_steps, lr=None):
-        raise NotImplementedError(
-            "micro-batch accumulation on the sharded trainer: shard the batch "
-            "instead (per-device batches are already 1/N) or run the base "
-            "Trainer; in-shard_map scan accumulation lands in a later round"
-        )
 
     # ------------------------------------------------------------------ init
 
@@ -159,6 +153,35 @@ class ShardedTrainer(Trainer):
 
     # ------------------------------------------------------------------ steps
 
+    def _sharded_micro(self, tables, dense, batch, step, lr):
+        """One (micro-)batch inside shard_map: lookups, fwd/bwd, sparse
+        applies; returns tables, pmean'd dense grads (unapplied), metrics."""
+        tables, views, bundle_res = self._lookup_all(tables, batch, step, True)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+        def loss_fn(dense, embs):
+            inputs = self._build_inputs(embs, views, batch)
+            out = self.model.apply(dense, inputs, train=True)
+            loss, out = self._loss_from_logits(out, batch)
+            return loss, out
+
+        (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(dense, embs)
+        # Data-parallel dense grads: mean over replicas via ICI allreduce.
+        g_dense = jax.lax.pmean(g_dense, self.axis)
+        tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
+
+        mets = {"loss": jax.lax.pmean(loss, self.axis)}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = jax.lax.pmean(
+                M.accuracy(probs, batch["label"]), self.axis
+            )
+        else:
+            mets["accuracy"] = jnp.zeros(())
+        return tables, g_dense, mets
+
     def _sharded_step(self, state: TrainState, batch, lr):
         state_spec, batch_spec = self._specs_for(state, batch)
         out_metric_spec = {"loss": P(), "accuracy": P()}
@@ -176,38 +199,13 @@ class ShardedTrainer(Trainer):
                 bname: self._squeeze(bname, ts)
                 for bname, ts in state.tables.items()
             }
-            tables, views, bundle_res = self._lookup_all(
-                tables, batch, step, True
+            tables, g_dense, mets = self._sharded_micro(
+                tables, state.dense, batch, step, lr
             )
-            embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
-
-            def loss_fn(dense, embs):
-                inputs = self._build_inputs(embs, views, batch)
-                out = self.model.apply(dense, inputs, train=True)
-                loss, out = self._loss_from_logits(out, batch)
-                return loss, out
-
-            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True
-            )(state.dense, embs)
-
-            # Data-parallel dense grads: mean over replicas via ICI allreduce.
-            g_dense = jax.lax.pmean(g_dense, self.axis)
             updates, opt_state = self.dense_opt.update(
                 g_dense, state.opt_state, state.dense
             )
             dense = optax.apply_updates(state.dense, updates)
-
-            tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
-
-            mets = {"loss": jax.lax.pmean(loss, self.axis)}
-            if not isinstance(out, dict):
-                probs = jax.nn.sigmoid(out)
-                mets["accuracy"] = jax.lax.pmean(
-                    M.accuracy(probs, batch["label"]), self.axis
-                )
-            else:
-                mets["accuracy"] = jnp.zeros(())
             new_state = TrainState(
                 step=step + 1,
                 tables={
@@ -218,6 +216,56 @@ class ShardedTrainer(Trainer):
                 opt_state=opt_state,
             )
             return new_state, mets
+
+        return run(state, batch, lr)
+
+    def _sharded_accum(self, state: TrainState, batch, lr):
+        """Micro-batched sharded step: batch leaves [A, B_local*N, ...] — the
+        accumulation axis is unsharded, the batch axis splits across the
+        mesh; lax.scan over micro-batches inside the shard_map."""
+        state_spec, _ = self._specs_for(state, {})
+        batch_spec = jax.tree.map(lambda _: P(None, self.axis), batch)
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec, P()),
+            out_specs=(state_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(state, batch, lr):
+            step = state.step
+            A = next(iter(batch.values())).shape[0]
+            tables0 = {
+                bname: self._squeeze(bname, ts)
+                for bname, ts in state.tables.items()
+            }
+
+            def micro(carry, mb):
+                tables, g_acc = carry
+                tables, g_dense, mets = self._sharded_micro(
+                    tables, state.dense, mb, step, lr
+                )
+                return (tables, jax.tree.map(jnp.add, g_acc, g_dense)), mets
+
+            g0 = jax.tree.map(jnp.zeros_like, state.dense)
+            (tables, g_acc), mets = jax.lax.scan(micro, (tables0, g0), batch)
+            g_mean = jax.tree.map(lambda g: g / jnp.float32(A), g_acc)
+            updates, opt_state = self.dense_opt.update(
+                g_mean, state.opt_state, state.dense
+            )
+            dense = optax.apply_updates(state.dense, updates)
+            new_state = TrainState(
+                step=step + 1,
+                tables={
+                    bname: self._unsqueeze(bname, ts)
+                    for bname, ts in tables.items()
+                },
+                dense=dense,
+                opt_state=opt_state,
+            )
+            return new_state, jax.tree.map(jnp.mean, mets)
 
         return run(state, batch, lr)
 

@@ -31,6 +31,22 @@ def test_accum_learns_and_counts_one_step():
     assert losses[-1] < losses[0]
 
 
+def test_sharded_accum_learns():
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+
+    mesh = make_mesh(8)
+    tr = ShardedTrainer(model(), Adagrad(lr=0.1), optax.adam(2e-3), mesh=mesh)
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=512, num_cat=4, num_dense=2, vocab=1000, seed=4)
+    b = shard_batch(mesh, J(gen.batch()))
+    losses = []
+    for _ in range(8):
+        st, m = tr.train_step_accum(st, b, accum_steps=2)
+        losses.append(float(m["loss"]))
+    assert int(st.step) == 8
+    assert losses[-1] < losses[0]
+
+
 def test_accum_dense_grads_match_full_batch():
     """With plain SGD and a single pass, accumulated dense grads must equal
     the full-batch gradient (sparse applies differ by design: per-micro)."""
