@@ -1,0 +1,80 @@
+"""Pallas embedding-row gather: DMA-pipelined random-row fetch from HBM.
+
+The TPU shape of DeepRec's KvResourceGather hot loop (SURVEY.md §3.1 —
+per-key memcpy from table storage into the output batch): row indices ride
+scalar prefetch (SMEM, available before the kernel body), the value table
+stays in HBM, and rows stream through a double-buffered VMEM scratch so the
+next row's DMA overlaps the current row's store — the classic embedding-bag
+DMA pattern from the Pallas guide.
+
+Status: experimental alternative to XLA's native gather for serving-path
+lookups of wide rows (D >= 128, where per-row DMA amortizes); correctness is
+oracle-tested in interpret mode, selection is explicit (use_pallas_gather).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_rows(values, ix, *, block: int = 8, interpret: bool = False):
+    """values [C, D] (HBM), ix [n] int32 -> [n, D]. n must divide by block.
+
+    Out-of-range indices are clamped (mode='clip' semantics, matching the
+    jnp fallback used on non-TPU backends).
+    """
+    n = ix.shape[0]
+    C, D = values.shape
+    if n % block:
+        raise ValueError(f"n={n} not a multiple of block={block}")
+    if not interpret and jax.default_backend() != "tpu":
+        return values.at[jnp.clip(ix, 0, C - 1)].get(mode="clip")
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(ix_ref, values_ref, out_ref, scratch, sems):
+        base = pl.program_id(0) * block
+
+        def row_dma(slot, i):
+            idx = jnp.clip(ix_ref[base + i], 0, C - 1)
+            return pltpu.make_async_copy(
+                values_ref.at[idx], scratch.at[slot], sems.at[slot]
+            )
+
+        row_dma(0, 0).start()
+
+        def body(i, _):
+            cur = i % 2
+            nxt = (i + 1) % 2
+
+            @pl.when(i + 1 < block)
+            def _():
+                row_dma(nxt, i + 1).start()
+
+            row_dma(cur, i).wait()
+            out_ref[i, :] = scratch[cur]
+            return 0
+
+        jax.lax.fori_loop(0, block, body, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(
+            (block, D), lambda i, ix_ref: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, D), values.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, D), values.dtype),
+        interpret=interpret,
+    )(ix.astype(jnp.int32), values)
