@@ -1,0 +1,133 @@
+"""Streaming input: follow an append-only log with resumable offsets.
+
+The Kafka-analog (reference core/kernels/data/kafka_dataset_op.cc): DeepRec
+consumes record streams with consumer offsets so training resumes where it
+stopped. On a TPU pod the pragmatic stand-in is an append-only file (or a
+directory of them) fed by a log shipper; this reader tails it, parses
+complete newline-terminated lines into batches, and exposes offset
+save/restore with Kafka-offset semantics: the offset only advances past rows
+that have been YIELDED, so a checkpoint/crash/restore cycle is exactly-once
+with respect to delivered batches.
+
+Records must be '\n'-terminated; an incomplete trailing line is left
+unconsumed until its newline arrives (or ignored at stop_at_eof).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class FileTailReader:
+    """Tail `path`, yielding batches of parsed lines.
+
+    parser(lines: list[str]) -> batch dict (defaults to Criteo TSV with the
+    same id hashing as data/readers.py). `poll_secs` controls the wait when
+    caught up; `stop_at_eof` makes it behave like a bounded dataset."""
+
+    def __init__(
+        self,
+        path: str,
+        batch_size: int = 2048,
+        parser: Optional[Callable] = None,
+        poll_secs: float = 0.5,
+        stop_at_eof: bool = False,
+        num_dense: int = 13,
+        num_cat: int = 26,
+    ):
+        self.path = path
+        self.B = batch_size
+        self.parser = parser or self._default_parser
+        self.poll_secs = poll_secs
+        self.stop_at_eof = stop_at_eof
+        self.num_dense = num_dense
+        self.num_cat = num_cat
+        self.offset = 0  # byte offset of the next un-YIELDED record
+
+    # ------------------------------------------------------------- offsets
+
+    def save(self) -> dict:
+        """Checkpointable consumer position (Kafka offset analog)."""
+        return {"path": self.path, "offset": self.offset}
+
+    def restore(self, state: dict, allow_path_mismatch: bool = False) -> None:
+        if not allow_path_mismatch and state.get("path") not in (None, self.path):
+            raise ValueError(
+                f"offset checkpoint is for {state['path']!r}, reader tails "
+                f"{self.path!r}; a byte offset is meaningless across files "
+                "(pass allow_path_mismatch=True to force)"
+            )
+        self.offset = int(state["offset"])
+
+    # -------------------------------------------------------------- parser
+
+    def _default_parser(self, lines):
+        from deeprec_tpu.data.readers import _hash_strings
+
+        n = len(lines)
+        labels = np.zeros(n, np.float32)
+        dense = np.zeros((n, self.num_dense), np.float32)
+        cat_cols = [np.empty(n, object) for _ in range(self.num_cat)]
+        for r, line in enumerate(lines):
+            parts = line.split("\t")
+            labels[r] = float(parts[0] or 0)
+            for i in range(self.num_dense):
+                v = parts[1 + i] if len(parts) > 1 + i else ""
+                dense[r, i] = float(v) if v else 0.0
+            for i in range(self.num_cat):
+                j = 1 + self.num_dense + i
+                cat_cols[i][r] = parts[j] if len(parts) > j else ""
+        out: Dict[str, np.ndarray] = {"label": labels}
+        for i in range(self.num_dense):
+            out[f"I{i+1}"] = dense[:, i : i + 1]
+        for i in range(self.num_cat):
+            # same hash as the batch readers: ids stay interchangeable
+            out[f"C{i+1}"] = _hash_strings(
+                cat_cols[i], salt=(i + 1) * 0x9E3779B9 & 0x7FFFFFFF
+            )
+        return out
+
+    # ------------------------------------------------------------- iterate
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        CHUNK = max(1 << 20, self.B * 512)
+        while True:
+            size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
+            made_progress = False
+            if size > self.offset:
+                with open(self.path, "rb") as f:
+                    f.seek(self.offset)
+                    data = f.read(min(CHUNK, size - self.offset))
+                last_nl = data.rfind(b"\n")
+                if last_nl >= 0:
+                    rows = data[: last_nl + 1].split(b"\n")[:-1]
+                    at_end = self.offset + len(data) >= size
+                    i = 0
+                    while i < len(rows):
+                        batch_rows = rows[i : i + self.B]
+                        full = len(batch_rows) == self.B
+                        final_flush = (
+                            self.stop_at_eof and at_end and i + self.B >= len(rows)
+                        )
+                        if not full and not final_flush:
+                            break  # wait for more data; offset stays put
+                        nbytes = sum(len(r) + 1 for r in batch_rows)
+                        # Advance BEFORE yielding (generator suspension would
+                        # otherwise leave save() not covering a batch the
+                        # consumer already holds): offsets mean "everything
+                        # handed out so far", Kafka consumer semantics.
+                        self.offset += nbytes
+                        made_progress = True
+                        i += len(batch_rows)
+                        yield self.parser(
+                            [r.decode(errors="replace") for r in batch_rows]
+                        )
+            if self.stop_at_eof and not made_progress:
+                # nothing (more) consumable: either fully drained or only an
+                # unterminated partial line remains — stop either way.
+                return
+            if not made_progress:
+                time.sleep(self.poll_secs)  # no busy loop on partial lines

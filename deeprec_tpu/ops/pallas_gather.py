@@ -9,11 +9,10 @@ DMA pattern from the Pallas guide.
 
 Status: experimental alternative to XLA's native gather for serving-path
 lookups of wide rows (D >= 128, where per-row DMA amortizes); correctness is
-oracle-tested in interpret mode, selection is explicit (use_pallas_gather).
+oracle-tested in interpret mode. Callers opt in explicitly by calling
+gather_rows — it is not wired into the default lookup path yet.
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +29,7 @@ def gather_rows(values, ix, *, block: int = 8, interpret: bool = False):
     if n % block:
         raise ValueError(f"n={n} not a multiple of block={block}")
     if not interpret and jax.default_backend() != "tpu":
-        return values.at[jnp.clip(ix, 0, C - 1)].get(mode="clip")
+        return values.at[ix].get(mode="clip")
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
