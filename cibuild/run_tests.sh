@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI entry (the cibuild/*.sh analog): native build, full test suite on the
+# virtual 8-device CPU mesh, driver entry checks, CPU bench smoke.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C deeprec_tpu/native
+
+echo "== tests (virtual 8-device CPU mesh) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/ -q
+
+echo "== driver entries =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== bench (CPU smoke; real numbers come from TPU) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 python bench.py

@@ -66,13 +66,14 @@ class ShardedTrainer(Trainer):
         axis: str = "data",
         grad_averaging: bool = False,
         comm: str = "allgather",  # or "a2a": budgeted all2all (SOK path)
+        remat: bool = False,
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
         self.mesh = mesh or make_mesh(axis=axis)
         self.axis = axis
         self.num_shards = self.mesh.devices.size
-        super().__init__(model, sparse_opt, dense_opt, grad_averaging)
+        super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat)
         # Re-point bundles at per-shard capacities + collective wrappers.
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))

@@ -104,9 +104,10 @@ def build_bundles(specs) -> Dict[str, Bundle]:
     return bundles
 
 
-@dataclasses.dataclass
+@struct.dataclass
 class ModelInputs:
-    """What the model's apply() receives each step."""
+    """What the model's apply() receives each step (a pytree, so it can
+    cross transform boundaries like jax.checkpoint)."""
 
     pooled: Dict[str, jnp.ndarray]  # feature -> [B, D]
     seq: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]  # feature -> ([B,L,D], [B,L] mask)
@@ -128,11 +129,16 @@ class Trainer:
         sparse_opt: SparseOptimizer,
         dense_opt: Optional[optax.GradientTransformation] = None,
         grad_averaging: bool = False,
+        remat: bool = False,
     ):
         self.model = model
         self.sparse_opt = sparse_opt
         self.dense_opt = dense_opt or optax.adam(1e-3)
         self.grad_averaging = grad_averaging
+        # remat=True recomputes the dense forward in the backward pass
+        # (jax.checkpoint): trades MXU FLOPs for HBM — the rematerialisation
+        # lever for big towers / long sequences.
+        self.remat = remat
         self.sparse_specs = fcol.sparse_features(model.features)
         self.dense_specs = fcol.dense_features(model.features)
         self.bundles = build_bundles(model.features)
@@ -282,7 +288,12 @@ class Trainer:
 
         def loss_fn(dense, embs):
             inputs = self._build_inputs(embs, views, batch)
-            out = self.model.apply(dense, inputs, train=True)
+            apply = (
+                jax.checkpoint(self.model.apply, static_argnums=(2,))
+                if self.remat
+                else self.model.apply
+            )
+            out = apply(dense, inputs, True)
             loss, out = self._loss_from_logits(out, batch)
             return loss, out
 
