@@ -1,2 +1,9 @@
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup
 from deeprec_tpu.embedding.combiners import combine
+from deeprec_tpu.embedding.compose import (
+    AdaptiveEmbedding,
+    DynamicDimEmbedding,
+    MultiHashConfig,
+    MultiHashTable,
+)
+from deeprec_tpu.embedding.multi_tier import MultiTierTable, TierStats
