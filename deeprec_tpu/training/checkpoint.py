@@ -204,9 +204,11 @@ class CheckpointManager:
                     if is_per_row(k):
                         merged[k] = np.concatenate([p[k] for p in parts])
                     elif k == "bloom":
-                        # counting sketches are additive: the sum is a valid
-                        # (conservative) global sketch
-                        merged[k] = np.sum([p[k] for p in parts], axis=0)
+                        # keep each shard's sketch: restoring onto the SAME
+                        # shard count is then exact (sub-threshold admission
+                        # counts survive); re-sharding falls back to a
+                        # rebuild from row freqs (see _import_local)
+                        merged["bloom_parts"] = np.stack([p[k] for p in parts])
                     else:  # per-table scalar slot: identical on all shards
                         merged[k] = parts[0][k]
                 merged["partition_offset"] = np.asarray(offsets, np.int64)
@@ -348,21 +350,32 @@ class CheckpointManager:
             N = self.trainer.num_shards
             owner = np.asarray(hashing.hash_shard(jnp.asarray(rows["keys"]), N))
             shards = []
+            bloom_parts = rows.get("bloom_parts")
+            same_topology = (
+                bloom_parts is not None and bloom_parts.shape[0] == N
+            )
             for s in range(N):
                 sel = owner == s
                 shard_rows = {
-                    k: (v[sel] if is_per_row(k) else v) for k, v in rows.items()
+                    k: (v[sel] if is_per_row(k) else v)
+                    for k, v in rows.items()
+                    if k != "bloom_parts"
                 }
-                # The saved bloom is a GLOBAL (summed) sketch; handing it to
-                # every shard would inflate ~N× on the next save cycle.
-                # Rebuild each shard's sketch from its owned rows' freqs
-                # instead — exact for admitted keys; sub-threshold-only keys
-                # restart their admission count (documented semantic).
-                shard_rows.pop("bloom", None)
+                # Same shard count: each shard gets its own saved sketch back
+                # (exact, sub-threshold counts included). Re-shard: rebuild
+                # from owned rows' freqs — exact for admitted keys,
+                # sub-threshold-only keys restart (documented semantic).
+                # Never hand a summed global sketch to every shard: that
+                # would inflate ~N× per save/restore cycle.
+                shard_rows.pop("bloom", None)  # legacy merged-sketch files
                 local = jax.tree.map(lambda a: a[s], sub)
                 local = import_rows(table, local, shard_rows)
                 cbf = table.cfg.ev.cbf_filter
-                if cbf is not None and local.bloom is not None:
+                if cbf is not None and local.bloom is not None and same_topology:
+                    local = local.replace(
+                        bloom=jnp.asarray(bloom_parts[s], jnp.int32)
+                    )
+                elif cbf is not None and local.bloom is not None:
                     from deeprec_tpu.embedding import filters as _filters
 
                     bloom = jnp.zeros_like(local.bloom)

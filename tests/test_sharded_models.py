@@ -93,6 +93,57 @@ def test_sharded_incremental_checkpoint(tmp_path, mesh):
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-5)
 
 
+def test_sharded_cbf_checkpoint_no_sketch_inflation(tmp_path, mesh):
+    """CBF admission under sharding must survive save/restore WITHOUT the
+    summed global sketch being handed back to every shard (which would
+    inflate counts ~Nx per cycle and spuriously admit cold keys)."""
+    import optax
+
+    from deeprec_tpu import CBFFilter, EmbeddingVariableOption
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+
+    ev = EmbeddingVariableOption(
+        cbf_filter=CBFFilter(filter_freq=50, max_element_size=1 << 12)
+    )
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=3,
+                num_dense=2, ev=ev)
+    tr = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=256, num_cat=3, num_dense=2, vocab=5000,
+                          seed=3)
+    for _ in range(2):
+        st, _ = tr.train_step(st, shard_batch(mesh, J(gen.batch())))
+
+    def total_bloom(state):
+        tot = 0
+        for ts in state.tables.values():
+            if ts.bloom is not None:
+                tot += int(np.asarray(ts.bloom).sum())
+        return tot
+
+    before = total_bloom(st)
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+    tr2 = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
+    st2 = CheckpointManager(str(tmp_path), tr2).restore()
+    after = total_bloom(st2)
+    # same shard count -> per-shard sketches restored EXACTLY (sub-threshold
+    # admission progress survives), definitely no Nx inflation
+    assert after == before, (before, after)
+    # and a second save/restore cycle must not grow the sketch either
+    st2, _ = CheckpointManager(str(tmp_path / "2"), tr2).save(st2)
+    st3 = CheckpointManager(str(tmp_path / "2"), tr2).restore()
+    assert total_bloom(st3) == after
+
+    # re-shard (8 -> 4): sketches rebuild from admitted rows' freqs — with
+    # nothing admitted at filter_freq=50, they come back empty, never inflated
+    mesh4 = make_mesh(4)
+    tr4 = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh4)
+    st4 = CheckpointManager(str(tmp_path), tr4).restore()
+    assert total_bloom(st4) <= before
+
+
 def test_bfloat16_table_values():
     t = EmbeddingTable(TableConfig(name="b", dim=8, capacity=256,
                                    value_dtype="bfloat16"))
