@@ -451,6 +451,7 @@ class EmbeddingTable:
     def rebuild(
         self, state: TableState, keep: Optional[jnp.ndarray] = None,
         new_capacity: Optional[int] = None,
+        slot_fills: Optional[Tuple[Tuple[str, float], ...]] = None,
     ) -> TableState:
         """Re-insert surviving entries into a fresh table.
 
@@ -486,8 +487,15 @@ class EmbeddingTable:
             version=move(state.version, -1),
             slots={
                 # Per-table scalar slots (e.g. AdamAsync beta powers, shape
-                # [1, 1]) are not per-key rows — pass them through.
-                k: (move(v, 0) if v.shape[0] == state.capacity else v)
+                # [1, 1]) are not per-key rows — pass them through. Freed
+                # per-key rows reset to the optimizer's slot INIT value
+                # (slot_fills), not 0 — an Adagrad accumulator reborn at 0
+                # would rsqrt(0) into NaN on a zero-grad dim.
+                k: (
+                    move(v, dict(slot_fills or ()).get(k, 0))
+                    if v.shape[0] == state.capacity
+                    else v
+                )
                 for k, v in state.slots.items()
             },
             bloom=state.bloom,
@@ -495,8 +503,10 @@ class EmbeddingTable:
             insert_fails=jnp.sum(failed).astype(jnp.int32),
         )
 
-    def evict(self, state: TableState, step: jnp.ndarray | int) -> TableState:
-        return _evict_jit(self, state, jnp.asarray(step, jnp.int32))
+    def evict(self, state: TableState, step: jnp.ndarray | int,
+              slot_fills: Optional[Tuple[Tuple[str, float], ...]] = None
+              ) -> TableState:
+        return _evict_jit(self, state, jnp.asarray(step, jnp.int32), slot_fills)
 
     def grow(self, state: TableState, new_capacity: int) -> TableState:
         """Host-orchestrated growth (recompiles downstream jits once per
@@ -522,7 +532,7 @@ def _lookup_readonly_jit(table, state, ids, pad_value, salt):
     return table._lookup_readonly_impl(state, ids, pad_value, salt)
 
 
-@_functools.partial(jax.jit, static_argnums=(0,))
-def _evict_jit(table, state, step):
+@_functools.partial(jax.jit, static_argnums=(0, 3))
+def _evict_jit(table, state, step, slot_fills):
     drop = table.evict_mask(state, step)
-    return table.rebuild(state, keep=~drop)
+    return table.rebuild(state, keep=~drop, slot_fills=slot_fills)

@@ -75,7 +75,9 @@ class Adagrad(SparseOptimizer):
 
     def update(self, value, slots, grad, counts, step, lr):
         acc = slots["accum"] + grad * grad
-        new_value = value - lr * grad * jax.lax.rsqrt(acc)
+        # guard acc==0 (possible after external slot resets + zero grad):
+        # rsqrt(0) would turn a zero update into NaN
+        new_value = value - lr * grad * jax.lax.rsqrt(jnp.maximum(acc, 1e-30))
         return new_value, {"accum": acc}
 
 
@@ -110,7 +112,7 @@ class AdagradDecay(SparseOptimizer):
         scale = jnp.power(self.accumulator_decay_rate, elapsed)[:, None]
         acc = jnp.maximum(slots["accum"] * scale, self.accumulator_baseline)
         acc = acc + grad * grad
-        new_value = value - lr * grad * jax.lax.rsqrt(acc)
+        new_value = value - lr * grad * jax.lax.rsqrt(jnp.maximum(acc, 1e-30))
         new_period = jnp.full_like(slots["decay_period"], 0.0) + period + 1.0
         return new_value, {"accum": acc, "decay_period": new_period}
 

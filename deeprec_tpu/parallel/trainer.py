@@ -139,6 +139,15 @@ class ShardedTrainer(Trainer):
         ax = 1 if self.bundles[bname].stacked else 0
         return jax.tree.map(lambda a: jnp.expand_dims(a, axis=ax), ts)
 
+    def _evict_bundle(self, b, ts, step):
+        # leading dims: [T?, N, C]; evict each shard's local table
+        fills = self._slot_fills(b)
+        fn = lambda s: b.table.evict(s, step, slot_fills=fills)
+        fn = jax.vmap(fn)  # over shards
+        if b.stacked:
+            fn = jax.vmap(fn)  # over grouped tables
+        return fn(ts)
+
     # Per-bundle primitives: the only thing that differs from the base
     # Trainer is that lookup/apply go through the collective ShardedTable.
     def _lookup_one(self, b, state, ids, pad, salt, step, train):

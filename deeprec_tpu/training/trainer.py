@@ -386,6 +386,35 @@ class Trainer:
     def eval_step(self, state: TrainState, batch):
         return self._eval_step(state, batch)
 
+    def evict_tables(self, state: TrainState, step=None) -> TrainState:
+        """Apply each table's eviction policies (TTL / L2) and rebuild —
+        run at checkpoint cadence like the reference
+        (docs/docs_en/Feature-Eviction.md). No-op for tables without
+        eviction options."""
+        step = jnp.asarray(int(state.step) if step is None else step, jnp.int32)
+        tables = dict(state.tables)
+        for bname, b in self.bundles.items():
+            ev = b.table.cfg.ev
+            if ev.global_step_evict is None and ev.l2_weight_evict is None:
+                continue
+            tables[bname] = self._evict_bundle(b, tables[bname], step)
+        return TrainState(step=state.step, tables=tables, dense=state.dense,
+                          opt_state=state.opt_state)
+
+    def _slot_fills(self, b: Bundle):
+        """Optimizer slot init values, so evicted rows are reborn correctly."""
+        return tuple(
+            (name, init)
+            for name, (_, init) in self.sparse_opt.slot_specs(b.table.cfg.dim).items()
+        )
+
+    def _evict_bundle(self, b: Bundle, ts, step):
+        fills = self._slot_fills(b)
+        fn = lambda s: b.table.evict(s, step, slot_fills=fills)
+        if b.stacked:
+            return jax.vmap(fn)(ts)
+        return fn(ts)
+
     def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
         """Streamed AUC/loss over an iterable of batches. Multi-task models
         report one AUC per task (labels under 'label_<task>')."""

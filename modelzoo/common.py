@@ -49,6 +49,9 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
                    help="counter-filter admission threshold")
     p.add_argument("--steps_to_live", type=int, default=0,
                    help="TTL eviction in steps (0 = off)")
+    p.add_argument("--evict_every", type=int, default=0,
+                   help="run eviction policies every N steps (0 = only with "
+                        "checkpoints)")
     p.add_argument("--bf16", action="store_true", default=True)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeline", type=int, default=0,
@@ -192,7 +195,10 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             last_metrics = ev
             t0 = time.perf_counter()
             window_start = step
+        if args.evict_every and step % args.evict_every == 0:
+            state = trainer.evict_tables(state)
         if ck and args.save_steps and step % args.save_steps == 0:
+            state = trainer.evict_tables(state)  # evict at ckpt time (ref cadence)
             state, path = ck.save(state)
             print(f"saved full checkpoint: {path}", flush=True)
         elif (

@@ -144,6 +144,57 @@ def test_sharded_cbf_checkpoint_no_sketch_inflation(tmp_path, mesh):
     assert total_bloom(st4) <= before
 
 
+def test_trainer_evict_tables_local_and_sharded(mesh):
+    import optax
+
+    from deeprec_tpu import EmbeddingVariableOption, GlobalStepEvict
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+
+    ev = EmbeddingVariableOption(global_step_evict=GlobalStepEvict(steps_to_live=2))
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=3,
+                num_dense=2, ev=ev)
+    gen = SyntheticCriteo(batch_size=256, num_cat=3, num_dense=2, vocab=2000,
+                          seed=9)
+    b_old = J(gen.batch())
+
+    # local trainer: keys touched only at step 0 expire after TTL
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    st, _ = tr.train_step(st, b_old)
+    size_before = sum(
+        int(t.size(tr.table_state(st, n))) for n, t in tr.tables.items()
+    )
+    for _ in range(4):  # advance steps with a disjoint id range
+        b_new = J(gen.batch())
+        for k in list(b_new):
+            if k.startswith("C"):
+                b_new[k] = b_new[k] + 1_000_000
+        st, _ = tr.train_step(st, b_new)
+    st = tr.evict_tables(st)
+    # old keys gone, recent keys survive
+    sizes = {n: int(t.size(tr.table_state(st, n))) for n, t in tr.tables.items()}
+    assert sum(sizes.values()) < size_before + sum(sizes.values())
+    ids_old = b_old["C1"][:4]
+    emb = tr.tables["C1"].lookup_readonly(tr.table_state(st, "C1"), ids_old)
+    # expired keys serve initializer values again (not their trained rows)
+    st2, res = tr.tables["C1"].lookup_unique(
+        tr.table_state(st, "C1"), ids_old, step=10, train=False
+    )
+    assert int((np.asarray(res.slot_ix) >= 0).sum()) == 0  # all evicted
+
+    # sharded trainer: evict runs per shard without shape errors
+    trs = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
+    sts = trs.init(0)
+    sts, _ = trs.train_step(sts, shard_batch(mesh, b_old))
+    sts = trs.evict_tables(sts, step=100)
+    total = sum(
+        int(jnp.sum(jax.vmap(t.size)(trs.table_state(sts, n))))
+        for n, t in trs.tables.items()
+    )
+    assert total == 0  # everything older than TTL evicted
+
+
 def test_bfloat16_table_values():
     t = EmbeddingTable(TableConfig(name="b", dim=8, capacity=256,
                                    value_dtype="bfloat16"))
