@@ -86,13 +86,17 @@ class CriteoCSVReader:
                         data = pending + f.read(CHUNK)
                         if not data:
                             break
+                        at_eof = len(data) < len(pending) + CHUNK
+                        if at_eof and not data.endswith(b"\n"):
+                            # Terminate the final line so the native parser
+                            # consumes it, matching the pandas fallback.
+                            data += b"\n"
                         out = criteo_parse_native(
                             data, self.B, self.num_dense, self.num_cat
                         )
                         if out is None:
                             return
                         rows, labels, dense, cats, consumed = out
-                        at_eof = len(data) < len(pending) + CHUNK
                         if rows < self.B and not at_eof:
                             pending = data  # need more bytes for a full batch
                             continue

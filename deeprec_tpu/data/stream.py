@@ -94,13 +94,14 @@ class FileTailReader:
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         CHUNK = max(1 << 20, self.B * 512)
+        chunk = CHUNK
         while True:
             size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
             made_progress = False
             if size > self.offset:
                 with open(self.path, "rb") as f:
                     f.seek(self.offset)
-                    data = f.read(min(CHUNK, size - self.offset))
+                    data = f.read(min(chunk, size - self.offset))
                 last_nl = data.rfind(b"\n")
                 if last_nl >= 0:
                     rows = data[: last_nl + 1].split(b"\n")[:-1]
@@ -125,6 +126,15 @@ class FileTailReader:
                         yield self.parser(
                             [r.decode(errors="replace") for r in batch_rows]
                         )
+                if made_progress:
+                    chunk = CHUNK
+                elif self.offset + len(data) < size:
+                    # Window exhausted without yielding a batch while more
+                    # bytes already sit on disk — a record (or whole batch)
+                    # longer than the window. Widen and retry instead of
+                    # re-reading the same bytes forever.
+                    chunk *= 2
+                    continue
             if self.stop_at_eof and not made_progress:
                 # nothing (more) consumable: either fully drained or only an
                 # unterminated partial line remains — stop either way.

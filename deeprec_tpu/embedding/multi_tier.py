@@ -53,6 +53,7 @@ class MultiTierTable:
         high_watermark: float = 0.8,
         low_watermark: float = 0.6,
         storage_path: Optional[str] = None,
+        slot_fills: Optional[tuple] = None,
     ):
         cfg = table.cfg
         self.table = table
@@ -61,10 +62,15 @@ class MultiTierTable:
         self.host = HostKV(dim=cfg.dim, initial_capacity=cfg.capacity)
         self.cache_strategy = cfg.ev.storage.cache_strategy
         self.storage_path = storage_path or cfg.ev.storage.storage_path
+        # Optimizer slot init values ((name, fill), ...) threaded into every
+        # rebuild so rows reborn in freed slots restart from the optimizer's
+        # init (e.g. Adagrad initial accumulator), never a raw 0.
+        self.slot_fills = tuple(slot_fills or ())
 
     # ------------------------------------------------------------------ sync
 
-    def sync(self, state: TableState, step: int) -> tuple[TableState, TierStats]:
+    def sync(self, state: TableState, step: int,
+             slot_fills: Optional[tuple] = None) -> tuple[TableState, TierStats]:
         stats = TierStats()
         keys = np.asarray(state.keys)
         occ = keys != empty_key(self.table.cfg)
@@ -117,7 +123,10 @@ class MultiTierTable:
             )
             keep = np.ones(C, bool)
             keep[out_ix] = False
-            state = self.table.rebuild(state, keep=jnp.asarray(keep))
+            state = self.table.rebuild(
+                state, keep=jnp.asarray(keep),
+                slot_fills=tuple(slot_fills) if slot_fills else self.slot_fills,
+            )
             stats.demoted = int(n_out)
 
         stats.host_size = len(self.host)

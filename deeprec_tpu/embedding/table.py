@@ -508,10 +508,15 @@ class EmbeddingTable:
               ) -> TableState:
         return _evict_jit(self, state, jnp.asarray(step, jnp.int32), slot_fills)
 
-    def grow(self, state: TableState, new_capacity: int) -> TableState:
+    def grow(self, state: TableState, new_capacity: int,
+             slot_fills: Optional[Tuple[Tuple[str, float], ...]] = None
+             ) -> TableState:
         """Host-orchestrated growth (recompiles downstream jits once per
-        capacity — the price of dynamic tables in a static-shape world)."""
-        return self.rebuild(state, new_capacity=new_capacity)
+        capacity — the price of dynamic tables in a static-shape world).
+        Pass the optimizer's slot_fills so rows later created in the new
+        empty slots start from the slot INIT value, not 0."""
+        return self.rebuild(state, new_capacity=new_capacity,
+                            slot_fills=slot_fills)
 
 
 # --------------------------------------------------------------------------

@@ -69,7 +69,9 @@ class ShardedTable:
         ~ slack·U·D, an ~N/2× reduction. Ids are bucketed by owner with a
         per-destination budget of slack·U/N; overflow beyond the budget
         (astronomically unlikely under a uniform hash at slack=2) serves the
-        default value for that step and is counted in state.insert_fails.
+        default value for that step and is counted in state.a2a_overflow —
+        the knob for it is a2a_slack, NOT capacity (insert_fails is the
+        separate capacity/grow signal).
     """
 
     def __init__(

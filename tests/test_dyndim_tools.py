@@ -27,6 +27,39 @@ def test_dynamic_dim_masks_by_frequency():
     np.testing.assert_allclose(e_cold[4:], 0.0)  # tail masked
 
 
+def test_shrink_ckpt_routes_by_name_not_shape(tmp_path):
+    """A per-table array (bloom sketch) whose length coincidentally equals
+    the row count must pass through unfiltered — routing is by NAME via
+    checkpoint.is_per_row, never by shape."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "shrink_ckpt",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "shrink_ckpt.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    n = 4
+    src = str(tmp_path / "table_t.npz")
+    dst = str(tmp_path / "out.npz")
+    np.savez(
+        src,
+        keys=np.arange(n, dtype=np.int64),
+        values=np.ones((n, 2), np.float32),
+        freqs=np.array([1, 5, 5, 5], np.int32),
+        versions=np.zeros(n, np.int32),
+        bloom=np.arange(n, dtype=np.int32),  # length == n by coincidence
+        **{"slot:accum": np.full((n, 2), 0.1, np.float32)},
+    )
+    before, after = mod.shrink_table(src, dst, min_freq=3, min_version=0)
+    assert (before, after) == (4, 3)
+    d = dict(np.load(dst))
+    assert d["keys"].shape[0] == 3
+    assert d["slot:accum"].shape[0] == 3
+    np.testing.assert_array_equal(d["bloom"], np.arange(n))  # untouched
+
+
 def test_shrink_ckpt_tool(tmp_path):
     import optax
 

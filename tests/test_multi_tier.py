@@ -73,6 +73,49 @@ def test_promotion_restores_values():
     assert stats2.host_size < host_before  # host copy dropped after promote
 
 
+def test_demote_rebuild_restores_slot_init_values():
+    """Freed per-key optimizer slots after a demotion rebuild hold the
+    optimizer's INIT value (Adagrad 0.1), not 0 — same defect class the
+    evict() path guards against (a 0 accumulator rsqrt's to a wrong-scale
+    first update for keys later born in that slot)."""
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.optim.apply import ensure_slots
+
+    t, _ = make()
+    opt = Adagrad(lr=0.1, initial_accumulator_value=0.1)
+    fills = tuple(
+        (name, init) for name, (_, init) in opt.slot_specs(t.cfg.dim).items()
+    )
+    mt = MultiTierTable(t, high_watermark=0.75, low_watermark=0.5,
+                        slot_fills=fills)
+    s = ensure_slots(t, t.create(), opt)
+    s, _ = t.lookup_unique(s, jnp.arange(52, dtype=jnp.int32), step=0)
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0
+    occ = np.asarray(t.occupied(s))
+    acc = np.asarray(s.slots["accum"])
+    assert (~occ).any()
+    np.testing.assert_allclose(acc[~occ], 0.1)
+
+
+def test_grow_restores_slot_init_values():
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.optim.apply import ensure_slots
+
+    t, _ = make(capacity=32)
+    opt = Adagrad(lr=0.1, initial_accumulator_value=0.1)
+    fills = tuple(
+        (name, init) for name, (_, init) in opt.slot_specs(t.cfg.dim).items()
+    )
+    s = ensure_slots(t, t.create(), opt)
+    s, _ = t.lookup_unique(s, jnp.arange(20, dtype=jnp.int32), step=0)
+    s2 = t.grow(s, 128, slot_fills=fills)
+    occ = np.asarray(t.occupied(s2))
+    acc = np.asarray(s2.slots["accum"])
+    np.testing.assert_allclose(acc[~occ], 0.1)
+    assert int(t.size(s2)) == 20
+
+
 def test_spill_and_load(tmp_path):
     t, mt = make()
     s = t.create()

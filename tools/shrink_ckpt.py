@@ -16,8 +16,13 @@ import glob
 import json
 import os
 import shutil
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeprec_tpu.training.checkpoint import is_per_row  # noqa: E402
 
 
 def shrink_table(path: str, out_path: str, min_freq: int, min_version: int):
@@ -30,7 +35,10 @@ def shrink_table(path: str, out_path: str, min_freq: int, min_version: int):
     for k, v in data.items():
         if k == "partition_offset":
             continue  # offsets are invalid after filtering; restore re-probes
-        out[k] = v[keep] if v.shape[:1] == (n,) else v
+        # Route by NAME (checkpoint.is_per_row), never by shape: a bloom
+        # sketch or scalar slot whose length happens to equal the row count
+        # must pass through untouched.
+        out[k] = v[keep] if is_per_row(k) else v
     np.savez(out_path, **out)
     return n, int(keep.sum())
 
