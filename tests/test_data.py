@@ -76,6 +76,60 @@ def test_native_csv_parser_matches_pandas(tmp_path):
             np.testing.assert_array_equal(nb[c], pb[c])
 
 
+def test_native_parser_keeps_unterminated_final_line(tmp_path):
+    """A file whose last line lacks a trailing newline must parse identically
+    through the native and pandas paths (the native parser only consumes
+    complete lines; the reader now terminates the residual at EOF)."""
+    import deeprec_tpu.native as N
+
+    if N.load_library() is None:
+        pytest.skip("native library not built")
+    p = str(tmp_path / "day.tsv")
+    _write_criteo_tsv(p, rows=10)
+    with open(p, "rb") as f:
+        data = f.read()
+    with open(p, "wb") as f:
+        f.write(data.rstrip(b"\n"))  # strip the final newline
+    native = list(
+        CriteoCSVReader([p], batch_size=4, drop_remainder=False)._iter_native()
+    )
+    orig = N.load_library
+    N.load_library = lambda: None
+    try:
+        pandas = list(CriteoCSVReader([p], batch_size=4, drop_remainder=False))
+    finally:
+        N.load_library = orig
+    assert sum(len(b["label"]) for b in native) == 10
+    assert len(native) == len(pandas)
+    for nb, pb in zip(native, pandas):
+        np.testing.assert_array_equal(nb["label"], pb["label"])
+        np.testing.assert_array_equal(nb["C26"], pb["C26"])
+
+
+def test_file_tail_reader_grows_window_past_giant_record(tmp_path):
+    """One record longer than the read window must not wedge the reader
+    (it widens the window instead of re-reading the same newline-free
+    bytes forever)."""
+    from deeprec_tpu.data import FileTailReader
+
+    giant = "x" * (3 << 20)  # 3 MiB, far beyond the 1 MiB default window
+    parser = lambda lines: {"n": np.array([len(l) for l in lines])}
+    # Case 1: giant record first. Case 2: a complete short line precedes the
+    # giant record, so the first window DOES contain a newline but can never
+    # fill a batch — the widen must fire on window exhaustion, not only on
+    # "no newline found".
+    for case, lines in enumerate((
+        [giant, "short"], ["short", giant]
+    )):
+        p = str(tmp_path / f"log{case}.tsv")
+        with open(p, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        r = FileTailReader(p, batch_size=2, stop_at_eof=True, parser=parser)
+        batches = list(r)
+        lens = np.concatenate([b["n"] for b in batches])
+        assert sorted(lens.tolist()) == [5, 3 << 20], case
+
+
 def test_parquet_reader(tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq
