@@ -164,6 +164,12 @@ class TableConfig:
     value_dtype: str = "float32"  # float32 | bfloat16
     combiner: str = "mean"  # mean | sum | sqrtn
     max_probes: int = 64
+    # Hot-path kernel choice: "xla" = plain gather/scatter ops, "pallas" =
+    # the fused DMA kernels in ops/fused_lookup.py (row gather + stochastic-
+    # rounded scatter), "auto" = whichever tools/bench_lookup.py crowned on
+    # this hardware (currently xla; pallas is opt-in until measured faster).
+    # Off-TPU every choice falls back to identical-semantics XLA.
+    kernel: str = "auto"  # auto | xla | pallas
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
     def __post_init__(self):
@@ -171,6 +177,8 @@ class TableConfig:
             raise ValueError(f"capacity must be a power of two, got {self.capacity}")
         if self.dim <= 0:
             raise ValueError("dim must be positive")
+        if self.kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
 
 
 @dataclasses.dataclass(frozen=True)

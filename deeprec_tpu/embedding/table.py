@@ -97,6 +97,21 @@ class EmbeddingTable:
     def __init__(self, cfg: TableConfig):
         self.cfg = cfg
 
+    @property
+    def use_pallas(self) -> bool:
+        """Fused Pallas kernels for the row gather/scatter hot path.
+        "auto" stays on XLA until tools/bench_lookup.py proves the fused
+        path faster on the target hardware; off-TPU both are XLA anyway."""
+        return self.cfg.kernel == "pallas"
+
+    def _gather(self, values: jnp.ndarray, ix: jnp.ndarray) -> jnp.ndarray:
+        """values[ix] with clip semantics through the configured kernel."""
+        if self.use_pallas:
+            from deeprec_tpu.ops.fused_lookup import gather_rows
+
+            return gather_rows(values, ix)
+        return values.at[ix].get(mode="clip")
+
     # Hashable-by-config so EmbeddingTable can ride through jit as a static
     # argument (the jitted public methods below rely on this).
     def __hash__(self):
@@ -336,7 +351,7 @@ class EmbeddingTable:
             version = version.at[upd_ix].set(step, mode="drop")
             dirty = dirty.at[upd_ix].set(True, mode="drop")
 
-        emb = values.at[safe_ix].get(mode="clip")
+        emb = self._gather(values, safe_ix)
 
         # Admission: counter filter gates on the (just updated) frequency.
         admitted = present
@@ -395,7 +410,7 @@ class EmbeddingTable:
         )
         del keys  # unchanged: no creation
         present = slot_ix >= 0
-        emb = state.values.at[jnp.where(present, slot_ix, 0)].get(mode="clip")
+        emb = self._gather(state.values, jnp.where(present, slot_ix, 0))
         emb = jnp.where(present[:, None], emb, self._init_rows(flat, salt))
         emb = jnp.where(is_pad[:, None], 0.0, emb)
         return emb.reshape(*shape, cfg.dim)

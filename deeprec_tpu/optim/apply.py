@@ -59,7 +59,7 @@ def apply_gradients(
     if grad_averaging:
         grad = grad / jnp.maximum(res.counts.astype(jnp.float32), 1.0)[:, None]
 
-    value = state.values.at[safe_ix].get(mode="clip").astype(jnp.float32)
+    value = table._gather(state.values, safe_ix).astype(jnp.float32)
     row_slots: Dict[str, jnp.ndarray] = {}
     for name, arr in state.slots.items():
         if name.startswith(SCALAR_PREFIX):
@@ -69,8 +69,15 @@ def apply_gradients(
 
     new_value, new_slots = opt.update(value, row_slots, grad, res.counts, step, lr)
 
-    values = state.values.at[drop_ix].set(
-        new_value.astype(state.values.dtype), mode="drop"
+    # The values write-back goes through apply_rows_sr: bf16 tables get
+    # stochastic rounding (plain round-to-nearest silently drops updates
+    # smaller than ulp/2), f32 tables an exact masked scatter; the Pallas
+    # DMA kernel serves tables opted into it.
+    from deeprec_tpu.ops.fused_lookup import apply_rows_sr
+
+    values = apply_rows_sr(
+        state.values, jnp.where(ok, res.slot_ix, -1), new_value, step,
+        use_pallas=table.use_pallas,
     )
     slots = dict(state.slots)
     for name, rows in new_slots.items():

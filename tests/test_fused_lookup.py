@@ -1,0 +1,169 @@
+"""Fused Pallas lookup kernels vs XLA oracles (interpret mode on CPU).
+
+Covers the three kernels in ops/fused_lookup.py — DMA gather, fused
+gather+combine, stochastic-rounded scatter-apply — plus the XLA
+stochastic_round utility's statistical contract.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeprec_tpu.ops.fused_lookup import (
+    apply_rows_sr,
+    fused_gather_combine,
+    gather_rows,
+    stochastic_round,
+)
+
+
+def test_gather_rows_matches_oracle():
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.normal(0, 1, (512, 128)).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, 512, 128), jnp.int32)
+    out = gather_rows(vals, ix, block=16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(vals)[np.asarray(ix)], rtol=1e-6
+    )
+
+
+def test_gather_rows_clamps_and_pads():
+    vals = jnp.arange(64, dtype=jnp.float32).reshape(8, 8) * jnp.ones((8, 8))
+    # n=6 is NOT a multiple of block=8: exercises the pad-and-slice path.
+    ix = jnp.array([-5, 100, 3, 0, 7, 2], jnp.int32)
+    out = gather_rows(vals, ix, block=8, interpret=True)
+    expect = np.asarray(vals)[np.clip(np.asarray(ix), 0, 7)]
+    assert out.shape == (6, 8)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_fused_gather_combine_matches_oracle(combiner):
+    rng = np.random.default_rng(1)
+    C, D, B, L = 256, 16, 12, 5  # B=12 not a multiple of block_b=8
+    vals = jnp.asarray(rng.normal(0, 1, (C, D)).astype(np.float32))
+    row_ix = rng.integers(-1, C, (B, L)).astype(np.int32)  # -1 = pad
+    n = np.maximum((row_ix >= 0).sum(1, keepdims=True), 1)
+    w = np.where(row_ix >= 0, 1.0 if combiner == "sum" else 1.0 / n, 0.0)
+    out = fused_gather_combine(
+        vals, jnp.asarray(row_ix), jnp.asarray(w, jnp.float32),
+        block_b=8, interpret=True,
+    )
+    e = np.asarray(vals)[np.clip(row_ix, 0, C - 1)]
+    expect = (e * w[..., None]).sum(1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_apply_rows_f32_matches_oracle_interpret():
+    rng = np.random.default_rng(2)
+    C, D, U = 64, 8, 10  # U=10 pads to 16
+    vals = jnp.asarray(rng.normal(0, 1, (C, D)).astype(np.float32))
+    slot_ix = jnp.asarray([3, -1, 7, 0, 63, 5, -1, 9, 11, 2], jnp.int32)
+    new_rows = jnp.asarray(rng.normal(0, 1, (U, D)).astype(np.float32))
+    out = apply_rows_sr(vals, slot_ix, new_rows, jnp.int32(0),
+                        block=8, interpret=True)
+    expect = np.asarray(vals).copy()
+    for u, s in enumerate(np.asarray(slot_ix)):
+        if s >= 0:
+            expect[s] = np.asarray(new_rows)[u]
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_apply_rows_bf16_rounds_to_neighbors_interpret():
+    """bf16 writes must land on one of the two bf16 neighbors of the f32
+    value (stochastic rounding), and skipped rows stay untouched."""
+    C, D, U = 32, 8, 8
+    vals = jnp.zeros((C, D), jnp.bfloat16)
+    slot_ix = jnp.asarray([0, 1, 2, 3, -1, 5, 6, 7], jnp.int32)
+    x = np.float32(1.0 + 1e-3)  # not bf16-representable
+    new_rows = jnp.full((U, D), x, jnp.float32)
+    out = apply_rows_sr(vals, slot_ix, new_rows, jnp.int32(7),
+                        block=8, interpret=True)
+    out = np.asarray(out, np.float32)
+    lo = np.float32(jnp.bfloat16(1.0))
+    hi = np.float32(np.nextafter(np.float32(lo), np.float32(2)))  # next bf16
+    hi = np.float32(jnp.asarray(lo, jnp.float32) + 2.0 ** -7)
+    written = out[[0, 1, 2, 3, 5, 6, 7]]
+    assert np.isin(written, [lo, hi]).all(), np.unique(written)
+    np.testing.assert_allclose(out[4], 0.0)
+
+
+def test_stochastic_round_is_unbiased_and_exact_on_representable():
+    key = jax.random.PRNGKey(0)
+    # Exactly-representable values never move.
+    x = jnp.asarray([0.0, 1.0, -2.5, 0.15625], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(stochastic_round(x, key), np.float32), np.asarray(x)
+    )
+    # Unrepresentable values round to a neighbor, unbiased in expectation.
+    v = np.float32(1.0 + 2.0 ** -9)  # 1/4 of the way between 1.0 and 1+2^-7
+    xs = jnp.full((200_000,), v, jnp.float32)
+    r = np.asarray(stochastic_round(xs, key), np.float32)
+    assert set(np.unique(r)) <= {np.float32(1.0), np.float32(1.0 + 2.0 ** -7)}
+    mean = r.mean()
+    np.testing.assert_allclose(mean, v, rtol=3e-4)
+
+
+def test_kernel_config_wiring_end_to_end():
+    """kernel="pallas" tables train identically to kernel="xla" off-TPU
+    (the fallback is the same XLA program); exercises the full wiring
+    through lookup_unique + apply_gradients."""
+    import dataclasses
+
+    from deeprec_tpu import EmbeddingTable, TableConfig
+    from deeprec_tpu.optim import Adagrad, apply_gradients, ensure_slots
+
+    res_by_kernel = {}
+    for kernel in ("xla", "pallas"):
+        cfg = TableConfig(name="k", dim=8, capacity=128, kernel=kernel)
+        t = EmbeddingTable(cfg)
+        opt = Adagrad(lr=0.5)
+        s = ensure_slots(t, t.create(), opt)
+        ids = jnp.asarray([5, 9, 5, 13], jnp.int32)
+        for step in range(3):
+            s, res = t.lookup_unique(s, ids, step=step)
+            s = apply_gradients(t, s, opt, res,
+                                jnp.ones_like(res.embeddings), step=step)
+        res_by_kernel[kernel] = np.asarray(
+            t.lookup_readonly(s, jnp.asarray([5, 9, 13], jnp.int32))
+        )
+    np.testing.assert_allclose(
+        res_by_kernel["xla"], res_by_kernel["pallas"], rtol=1e-6
+    )
+
+
+def test_bf16_table_sr_preserves_small_updates_in_expectation():
+    """A bf16 table with updates far below ulp/2 must still drift: SR keeps
+    E[stored] == target where round-to-nearest would freeze at 1.0."""
+    from deeprec_tpu import EmbeddingTable, TableConfig
+    from deeprec_tpu.optim import GradientDescent, apply_gradients, ensure_slots
+
+    cfg = TableConfig(name="sr", dim=128, capacity=1024,
+                      value_dtype="bfloat16",
+                      ev=__import__("deeprec_tpu").EmbeddingVariableOption(
+                          init=__import__("deeprec_tpu").InitializerOption(
+                              kind="constant", constant=1.0)))
+    t = EmbeddingTable(cfg)
+    opt = GradientDescent(lr=1.0)
+    s = ensure_slots(t, t.create(), opt)
+    ids = jnp.arange(256, dtype=jnp.int32)
+    # each step subtracts 1e-4 — ulp(1.0) in bf16 is 2^-7 ≈ 7.8e-3, so RTN
+    # would never move off 1.0; SR moves the mean by ~1e-4 per step.
+    g = jnp.full((256, 128), 1e-4, jnp.float32)
+    for step in range(200):
+        s, res = t.lookup_unique(s, ids, step=step)
+        s = apply_gradients(t, s, opt, res, g, step=step)
+    mean = float(jnp.mean(s.values[:].astype(jnp.float32)
+                          [np.asarray(t.occupied(s))]))
+    expect = 1.0 - 200 * 1e-4  # 0.98
+    assert abs(mean - expect) < 4e-3, mean
+
+
+def test_gather_rows_xla_fallback_identical():
+    """Off-TPU the public entry points use XLA with identical semantics."""
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(rng.normal(0, 1, (128, 32)).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, 128, 24), jnp.int32)
+    a = gather_rows(vals, ix)  # XLA path on CPU
+    b = gather_rows(vals, ix, interpret=True)  # Pallas interpreter
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Benchmark the embedding hot path: XLA gather/scatter vs fused Pallas.
+
+Answers the VERDICT round-1 question "does op-composed lookup reach the
+roofline on TPU, or does the fused kernel win?" — the reference spent 5.5k
+LoC of CUDA on this exact question for GPUs (fused_embedding_ops.cc).
+
+Run ON HARDWARE (falls back to CPU with a warning — CPU numbers say nothing
+about the TPU answer):
+
+    python tools/bench_lookup.py [--dim 64] [--capacity 20] [--batch 16384]
+
+Prints per-op bandwidth + a verdict line. Whichever path wins becomes the
+TableConfig.kernel="auto" default.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(fn, *args, iters=50, warmup=5):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--capacity", type=int, default=20, help="log2 table slots")
+    p.add_argument("--batch", type=int, default=16384, help="unique rows/step")
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeprec_tpu.ops.fused_lookup import apply_rows_sr, gather_rows
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"WARNING: running on {backend}; TPU is the question", file=sys.stderr)
+
+    C, D, U = 1 << args.capacity, args.dim, args.batch
+    dt = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(rng.normal(0, 0.05, (C, D)), dt)
+    ix = jnp.asarray(rng.integers(0, C, U), jnp.int32)
+    rows = jnp.asarray(rng.normal(0, 0.05, (U, D)), jnp.float32)
+    seed = jnp.int32(0)
+
+    xla_gather = jax.jit(lambda v, i: v.at[i].get(mode="clip"))
+    pallas_gather = jax.jit(lambda v, i: gather_rows(v, i))
+    xla_scatter = jax.jit(
+        lambda v, i, r: apply_rows_sr(v, i, r, seed, use_pallas=False)
+    )
+    pallas_scatter = jax.jit(
+        lambda v, i, r: apply_rows_sr(v, i, r, seed, use_pallas=True)
+    )
+
+    bytes_g = U * D * dt.itemsize  # rows read
+    bytes_s = U * D * (dt.itemsize + 4)  # f32 rows in, dt rows out
+
+    results = {}
+    for name, fn, fargs, nbytes in (
+        ("gather/xla", xla_gather, (values, ix), bytes_g),
+        ("gather/pallas", pallas_gather, (values, ix), bytes_g),
+        ("scatter/xla", xla_scatter, (values, ix, rows), bytes_s),
+        ("scatter/pallas", pallas_scatter, (values, ix, rows), bytes_s),
+    ):
+        dt_s = bench(fn, *fargs)
+        gbps = nbytes / dt_s / 1e9
+        results[name] = gbps
+        print(f"{name:16s} {dt_s * 1e6:9.1f} us   {gbps:8.1f} GB/s")
+
+    for op in ("gather", "scatter"):
+        x, pl_ = results[f"{op}/xla"], results[f"{op}/pallas"]
+        winner = "pallas" if pl_ > x * 1.05 else ("xla" if x > pl_ * 1.05 else "tie")
+        print(f"verdict[{op}]: {winner} (xla {x:.1f} vs pallas {pl_:.1f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()
