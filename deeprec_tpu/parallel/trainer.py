@@ -161,6 +161,45 @@ class ShardedTrainer(Trainer):
             grad_averaging=self.grad_averaging,
         )
 
+    # --------------------------------------------- capacity management
+
+    def _bundle_lead_dims(self, b):
+        # [T?, N, C_local]: members iterate grouped tables × shards.
+        T = (len(b.features),) if b.stacked else ()
+        return T + (self.num_shards,)
+
+    def _set_bundle_capacity(self, b, new_c):
+        super()._set_bundle_capacity(b, new_c)
+        # Re-point the collective wrapper at the grown local table.
+        old = self.sharded[b.name]
+        self.sharded[b.name] = ShardedTable(
+            b.table, old.num_shards, old.axis, comm=old.comm,
+            a2a_slack=old.a2a_slack,
+        )
+
+    def maintain(self, state, **kw):
+        # max_capacity is the GLOBAL cap; the base loop compares against
+        # per-shard local capacities.
+        if kw.get("max_capacity"):
+            kw["max_capacity"] = max(1, kw["max_capacity"] // self.num_shards)
+        state, report = super().maintain(state, **kw)
+        # Growth changed per-shard shapes: restore the mesh sharding the
+        # step functions expect (host-side stacking produced unsharded
+        # arrays).
+        from jax.sharding import NamedSharding
+
+        tables = {}
+        for bname, ts in state.tables.items():
+            spec = self._table_spec(bname)
+            tables[bname] = jax.device_put(
+                ts, NamedSharding(self.mesh, spec)
+            )
+        return (
+            TrainState(step=state.step, tables=tables, dense=state.dense,
+                       opt_state=state.opt_state),
+            report,
+        )
+
     # ------------------------------------------------------------------ steps
 
     def _sharded_micro(self, tables, dense, batch, step, lr):

@@ -415,6 +415,130 @@ class Trainer:
             return jax.vmap(fn)(ts)
         return fn(ts)
 
+    # --------------------------------------------- capacity management
+
+    def _bundle_lead_dims(self, b: Bundle) -> Tuple[int, ...]:
+        """Leading axes of this bundle's state arrays before [C, ...]:
+        (T,) for stacked groups, () for single tables. ShardedTrainer adds
+        the shard axis."""
+        return (len(b.features),) if b.stacked else ()
+
+    def _multi_tier_for(self, b: Bundle, idx: Tuple[int, ...]):
+        """Lazily build one MultiTierTable per (bundle, member/shard) —
+        each holds its own host KV store."""
+        from deeprec_tpu.embedding.multi_tier import MultiTierTable
+
+        if not hasattr(self, "_tiers"):
+            self._tiers = {}
+        key = (b.name, idx)
+        if key not in self._tiers:
+            self._tiers[key] = MultiTierTable(
+                b.table, slot_fills=self._slot_fills(b)
+            )
+        return self._tiers[key]
+
+    def maintain(
+        self,
+        state: TrainState,
+        *,
+        grow_threshold: float = 0.85,
+        max_capacity: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
+        """Close the capacity loop DeepRec's tables close implicitly
+        (embedding_var.h:142 LookupOrCreateKey never refuses a key): consume
+        each table's insert_fails / occupancy signals and act — demote cold
+        rows to the host tier (storage_type=HBM_DRAM), else grow the table.
+        Host-side; call at log/checkpoint cadence, NOT per step. Growth
+        recompiles downstream jits once per new capacity.
+
+        Returns (new_state, report) where report[bundle] carries occupancy,
+        insert_fails, and what action was taken. max_capacity is the cap PER
+        TABLE as this trainer shards it (for ShardedTrainer: the global cap;
+        it is divided by the shard count internally); non-power-of-two caps
+        round down.
+        """
+        import numpy as np
+
+        step = int(state.step) if step is None else int(step)
+        if max_capacity:
+            # largest power of two <= cap (capacities must be powers of two)
+            max_capacity = 1 << (int(max_capacity).bit_length() - 1)
+        tables = dict(state.tables)
+        report: Dict[str, Dict[str, float]] = {}
+        for bname, b in self.bundles.items():
+            ts = tables[bname]
+            lead = self._bundle_lead_dims(b)
+            C = b.table.cfg.capacity
+            # Member states: iterate every leading index (tables × shards).
+            idxs = list(np.ndindex(*lead)) if lead else [()]
+            members = [
+                jax.tree.map(lambda a, i=i: a[i] if i else a, ts)
+                for i in idxs
+            ]
+            occ = max(int(b.table.size(m)) for m in members) / C
+            fails_each = [int(m.insert_fails) for m in members]
+            fails = sum(fails_each)
+            rep = {"occupancy": occ, "insert_fails": fails, "capacity": C}
+            multi_tier = (
+                b.table.cfg.ev.storage.storage_type.value == "hbm_dram"
+            )
+            if multi_tier:
+                demoted = promoted = 0
+                members = list(members)
+                for k, (i, m) in enumerate(zip(idxs, members)):
+                    mt = self._multi_tier_for(b, i)
+                    m, stats = mt.sync(m, step)
+                    members[k] = m
+                    demoted += stats.demoted
+                    promoted += stats.promoted
+                rep.update(demoted=demoted, promoted=promoted)
+                ts = self._restack(members, lead)
+            elif fails > 0 or occ > grow_threshold:
+                # Size by the WORST member (each member has its own slots);
+                # summing across shards would overprovision every shard.
+                worst = max(fails_each)
+                new_c = C * 2
+                while worst > 0 and new_c < (worst + occ * C) * 2:
+                    new_c *= 2
+                if max_capacity:
+                    new_c = min(new_c, max_capacity)
+                if new_c > C:
+                    fills = self._slot_fills(b)
+                    members = [
+                        b.table.grow(m, new_c, slot_fills=fills)
+                        for m in members
+                    ]
+                    self._set_bundle_capacity(b, new_c)
+                    rep["grew_to"] = new_c
+                    ts = self._restack(members, lead)
+            tables[bname] = ts
+            report[bname] = rep
+        return (
+            TrainState(step=state.step, tables=tables, dense=state.dense,
+                       opt_state=state.opt_state),
+            report,
+        )
+
+    def _restack(self, members, lead):
+        """Reassemble member states into the bundle's stacked layout."""
+        if not lead:
+            return members[0]
+        flat = [jax.tree.flatten(m)[0] for m in members]
+        treedef = jax.tree.structure(members[0])
+        stacked = []
+        for leaf_i in range(len(flat[0])):
+            arrs = jnp.stack([f[leaf_i] for f in flat])
+            stacked.append(arrs.reshape(lead + arrs.shape[1:]))
+        return jax.tree.unflatten(treedef, stacked)
+
+    def _set_bundle_capacity(self, b: Bundle, new_c: int) -> None:
+        """Point the bundle at the grown capacity (invalidates jit caches
+        keyed on the old config — one recompile per growth event)."""
+        b.table = EmbeddingTable(
+            dataclasses.replace(b.table.cfg, capacity=new_c)
+        )
+
     def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
         """Streamed AUC/loss over an iterable of batches. Multi-task models
         report one AUC per task (labels under 'label_<task>')."""

@@ -1,0 +1,112 @@
+"""Capacity-management loop: Trainer.maintain() consumes insert_fails /
+occupancy and grows tables or demotes to the host tier — closing the loop
+DeepRec closes implicitly (embedding_var.h:142 LookupOrCreateKey never
+refuses a key; multi_tier_storage.h:47 + eviction_manager.h:39 manage
+tiers in background threads).
+
+The VERDICT round-1 acceptance test: overfill a table DURING training and
+converge anyway — single-device and sharded.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeprec_tpu import EmbeddingVariableOption, StorageOption
+from deeprec_tpu.config import StorageType
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.training import Trainer
+
+
+def _model(capacity=256, ev=EmbeddingVariableOption()):
+    return WDL(emb_dim=4, capacity=capacity, hidden=(16,), num_cat=2,
+               num_dense=2, ev=ev)
+
+
+def _gen(vocab, seed=0, B=256):
+    return SyntheticCriteo(batch_size=B, num_cat=2, num_dense=2,
+                           vocab=vocab, seed=seed)
+
+
+def _batches(gen, n):
+    return [{k: jnp.asarray(v) for k, v in gen.batch().items()}
+            for _ in range(n)]
+
+
+def test_overfill_grows_and_converges_single_device():
+    model = _model(capacity=256)
+    tr = Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3))
+    st = tr.init(0)
+    gen = _gen(vocab=600)  # 600 uniques/table >> 256 slots: must overflow
+    saw_fails = False
+    for i in range(40):
+        st, mets = tr.train_step(st, _batches(gen, 1)[0])
+        if (i + 1) % 10 == 0:
+            fails = sum(
+                int(jnp.sum(ts.insert_fails)) for ts in st.tables.values()
+            )
+            saw_fails = saw_fails or fails > 0
+            st, report = tr.maintain(st)
+    assert saw_fails, "test not overfilling — raise vocab or lower capacity"
+    grown = [r for r in report.values() if r["capacity"] > 256]
+    assert grown, report
+    # after growth the table absorbs everything: keep training, no fails
+    for _ in range(25):
+        st, _ = tr.train_step(st, _batches(gen, 1)[0])
+    st2, report2 = tr.maintain(st)
+    assert all(r["insert_fails"] == 0 for r in report2.values()), report2
+    evals = tr.evaluate(st2, _batches(_gen(600, seed=9), 4))
+    assert np.isfinite(evals["loss"])
+    assert evals["auc"] > 0.55, evals
+
+
+def test_overfill_grows_sharded():
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+
+    mesh = make_mesh(8)
+    model = _model(capacity=512)  # 64 slots per shard
+    tr = ShardedTrainer(model, Adagrad(lr=0.2), optax.adam(5e-3), mesh=mesh)
+    st = tr.init(0)
+    gen = _gen(vocab=1200, B=512)
+    saw_fails = False
+    grew = []
+    for i in range(12):
+        st, mets = tr.train_step(st, shard_batch(mesh, _batches(gen, 1)[0]))
+        if (i + 1) % 6 == 0:
+            fails = sum(
+                int(jnp.sum(ts.insert_fails)) for ts in st.tables.values()
+            )
+            saw_fails = saw_fails or fails > 0
+            st, report = tr.maintain(st)
+            grew += [r["grew_to"] for r in report.values() if "grew_to" in r]
+    assert saw_fails
+    assert grew, report
+    # training continues, finite, and fails stay cleared
+    st, mets = tr.train_step(st, shard_batch(mesh, _batches(gen, 1)[0]))
+    assert np.isfinite(float(mets["loss"]))
+    st, report2 = tr.maintain(st)
+    assert all(r["insert_fails"] == 0 for r in report2.values()), report2
+
+
+def test_multi_tier_demotes_inside_trainer():
+    """HBM_DRAM tables demote cold rows at maintain() instead of growing;
+    capacity stays fixed and training stays finite."""
+    ev = EmbeddingVariableOption(
+        storage=StorageOption(storage_type=StorageType.HBM_DRAM)
+    )
+    model = _model(capacity=256, ev=ev)
+    tr = Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3))
+    st = tr.init(0)
+    gen = _gen(vocab=280)  # drives occupancy over the 0.8 watermark
+    for _ in range(8):
+        st, _ = tr.train_step(st, _batches(gen, 1)[0])
+    st, report = tr.maintain(st)
+    assert all(r["capacity"] == 256 for r in report.values()), report
+    demoted = sum(r.get("demoted", 0) for r in report.values())
+    assert demoted > 0, report
+    # demoted rows live in the host tier now
+    assert any(len(mt.host) for mt in tr._tiers.values())
+    st, mets = tr.train_step(st, _batches(gen, 1)[0])
+    assert np.isfinite(float(mets["loss"]))
