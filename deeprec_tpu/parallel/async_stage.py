@@ -1,0 +1,221 @@
+"""Async embedding stage: stale-by-one decoupling of the embedding exchange
+from dense compute.
+
+DeepRec's AsyncEmbeddingStage (reference
+tensorflow/python/training/async_embedding_stage.py, enabled by
+config.proto:328 do_async_embedding) splits the graph at the embedding
+boundary and runs the lookup subgraph in a pipeline stage, so the PS
+round-trip for batch t+1 overlaps the dense compute of batch t; the model
+consumes embeddings that are one step stale.
+
+The TPU translation keeps the pipeline INSIDE one jitted step instead of
+splitting the graph across threads. Each async step, in data-flow order:
+
+  1. dense fwd/bwd on the CARRIED embeddings of batch t-1 (from AsyncState)
+  2. collective lookup/exchange for batch t against the step-start tables
+     — data-independent of (1), so XLA overlaps the all2all/allgather with
+     the dense matmuls; this is the latency hiding the reference buys with
+     its stage thread
+  3. sparse-apply of batch t-1's gradients (after (1) and (2))
+  4. dense optimizer update
+
+Semantics (documented staleness, matching the reference):
+  * the model sees embeddings fetched one step earlier;
+  * sparse gradients are applied one step late, after the next batch's
+    inserts (safe: inserts only claim empty slots, so the carried slot_ix
+    stay valid — eviction/maintain() invalidates pending state and must be
+    followed by `bootstrap()` on the next batch).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deeprec_tpu.parallel.trainer import ShardedTrainer
+from deeprec_tpu.training import metrics as M
+from deeprec_tpu.training.trainer import TrainState
+
+
+@struct.dataclass
+class AsyncState:
+    """TrainState + the pipelined lookahead (batch t-1's lookup results)."""
+
+    inner: TrainState
+    batch: Dict[str, jnp.ndarray]  # the previous batch (ids/dense/labels)
+    views: Dict[str, Any]  # feature -> (embeddings, inverse, mask)
+    bundle_res: Dict[str, Any]  # bundle -> lookup result for the backward
+
+
+class AsyncShardedTrainer(ShardedTrainer):
+    """ShardedTrainer with the stale-by-one async embedding stage.
+
+    Usage:
+        astate = trainer.bootstrap(trainer.init(0), first_batch)
+        for batch in batches:                    # feed batch t
+            astate, mets = trainer.train_step_async(astate, batch)
+        # mets at step t refer to batch t-1 (pipeline latency of one step)
+
+    After maintain()/evict_tables() on astate.inner, call bootstrap() again:
+    those rebuild tables and invalidate the carried slot indices.
+    """
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._bootstrap_jit = jax.jit(self._bootstrap_impl)
+        self._async_step = jax.jit(self._async_impl, donate_argnums=0)
+
+    # ------------------------------------------------------------- specs
+
+    def _pending_specs(self):
+        """Prefix spec trees (shard_map broadcasts a spec over a subtree):
+        views/batch leaves shard the leading local axis; stacked bundles
+        carry their table axis first."""
+        ax = self.axis
+        views_spec = P(ax)
+        res_spec = {
+            bname: P(None, ax) if b.stacked else P(ax)
+            for bname, b in self.bundles.items()
+        }
+        batch_spec = P(ax)
+        return views_spec, res_spec, batch_spec
+
+    # --------------------------------------------------------- bootstrap
+
+    def bootstrap(self, state: TrainState, first_batch) -> AsyncState:
+        """Fill the pipeline: lookup/exchange first_batch with no dense
+        compute. The first train_step_async then consumes it."""
+        return self._bootstrap_jit(state, first_batch)
+
+    def _bootstrap_impl(self, state: TrainState, batch):
+        state_spec, batch_spec = self._specs_for(state, batch)
+        views_spec, res_spec, _ = self._pending_specs()
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, views_spec, res_spec),
+            check_vma=False,
+        )
+        def run(state, batch):
+            tables = {
+                bname: self._squeeze(bname, ts)
+                for bname, ts in state.tables.items()
+            }
+            tables, views, bundle_res = self._lookup_all(
+                tables, batch, state.step, True
+            )
+            new_state = TrainState(
+                step=state.step,
+                tables={
+                    bname: self._unsqueeze(bname, ts)
+                    for bname, ts in tables.items()
+                },
+                dense=state.dense,
+                opt_state=state.opt_state,
+            )
+            return new_state, views, bundle_res
+
+        new_state, views, bundle_res = run(state, batch)
+        return AsyncState(
+            inner=new_state, batch=batch, views=views, bundle_res=bundle_res
+        )
+
+    # ------------------------------------------------------------- step
+
+    def train_step_async(self, astate: AsyncState, batch, lr=None):
+        lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
+        return self._async_step(astate, batch, lr)
+
+    def _async_impl(self, astate: AsyncState, batch_t, lr):
+        state = astate.inner
+        state_spec, batch_spec = self._specs_for(state, batch_t)
+        views_spec, res_spec, prev_batch_spec = self._pending_specs()
+        astate_spec = AsyncState(
+            inner=state_spec, batch=prev_batch_spec, views=views_spec,
+            bundle_res=res_spec,
+        )
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(astate_spec, batch_spec, P()),
+            out_specs=(astate_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(astate, batch_t, lr):
+            state = astate.inner
+            step = state.step
+            views = astate.views
+            prev_batch = astate.batch
+
+            # (1) dense fwd/bwd on the STALE embeddings (batch t-1)
+            embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+            def loss_fn(dense, embs):
+                inputs = self._build_inputs(embs, views, prev_batch)
+                out = self.model.apply(dense, inputs, train=True)
+                loss, out = self._loss_from_logits(out, prev_batch)
+                return loss, out
+
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.dense, embs)
+            g_dense = jax.lax.pmean(g_dense, self.axis)
+
+            # (2) exchange/lookup for batch t — reads the step-start tables,
+            # no data dependency on (1): XLA overlaps it with the matmuls.
+            tables = {
+                bname: self._squeeze(bname, ts)
+                for bname, ts in state.tables.items()
+            }
+            tables, views_t, res_t = self._lookup_all(
+                tables, batch_t, step, True
+            )
+
+            # (3) stale-apply batch t-1's sparse grads
+            tables = self._apply_all(tables, astate.bundle_res, g_embs, step, lr)
+
+            # (4) dense update
+            updates, opt_state = self.dense_opt.update(
+                g_dense, state.opt_state, state.dense
+            )
+            dense = optax.apply_updates(state.dense, updates)
+
+            mets = {"loss": jax.lax.pmean(loss, self.axis)}
+            if not isinstance(out, dict):
+                probs = jax.nn.sigmoid(out)
+                mets["accuracy"] = jax.lax.pmean(
+                    M.accuracy(probs, prev_batch["label"]), self.axis
+                )
+            else:
+                mets["accuracy"] = jnp.zeros(())
+
+            new_inner = TrainState(
+                step=step + 1,
+                tables={
+                    bname: self._unsqueeze(bname, ts)
+                    for bname, ts in tables.items()
+                },
+                dense=dense,
+                opt_state=opt_state,
+            )
+            return (
+                AsyncState(inner=new_inner, batch=batch_t, views=views_t,
+                           bundle_res=res_t),
+                mets,
+            )
+
+        return run(astate, batch_t, lr)

@@ -1,0 +1,77 @@
+"""Async embedding stage: stale-by-one semantics + training health.
+
+Reference parity: async_embedding_stage.py / config.proto:328
+do_async_embedding — the model consumes embeddings one step stale and
+sparse grads apply one step late; training still converges.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.parallel import AsyncShardedTrainer, ShardedTrainer, make_mesh, shard_batch
+
+
+def _setup(comm="allgather", lr=0.2):
+    mesh = make_mesh(8)
+    model = WDL(emb_dim=4, capacity=1 << 10, hidden=(16,), num_cat=3,
+                num_dense=2)
+    tr = AsyncShardedTrainer(model, Adagrad(lr=lr), optax.adam(5e-3),
+                             mesh=mesh, comm=comm)
+    gen = SyntheticCriteo(batch_size=256, num_cat=3, num_dense=2,
+                          vocab=800, seed=0)
+    batches = [
+        shard_batch(mesh, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+        for _ in range(8)
+    ]
+    return mesh, model, tr, batches
+
+
+def test_async_step_is_stale_by_one():
+    """With lr=0 everywhere (no updates), the loss reported by async step t
+    must equal the SYNC eval loss of batch t-1 — i.e. the dense compute
+    really consumes the previous batch's embeddings."""
+    mesh, model, tr, batches = _setup(lr=0.0)
+    zero_dense = optax.sgd(0.0)
+    tr_async = AsyncShardedTrainer(model, Adagrad(lr=0.0), zero_dense,
+                                   mesh=mesh)
+    tr_sync = ShardedTrainer(model, Adagrad(lr=0.0), zero_dense, mesh=mesh)
+    st = tr_async.init(0)
+    ast = tr_async.bootstrap(st, batches[0])
+    for t in range(1, 4):
+        ast, mets = tr_async.train_step_async(ast, batches[t])
+        # sync eval of batch t-1 against equivalent (lr=0) tables
+        st_sync = tr_sync.init(0)
+        for b in batches[:t]:  # populate the same keys (initializer values)
+            st_sync, _ = tr_sync.train_step(st_sync, b)
+        loss_ref, _ = tr_sync.eval_step(st_sync, batches[t - 1])
+        np.testing.assert_allclose(
+            float(mets["loss"]), float(loss_ref), rtol=2e-5
+        )
+
+
+def test_async_training_converges():
+    mesh, model, tr, batches = _setup()
+    st = tr.init(0)
+    ast = tr.bootstrap(st, batches[0])
+    losses = []
+    for t in range(1, 40):
+        ast, mets = tr.train_step_async(ast, batches[t % len(batches)])
+        losses.append(float(mets["loss"]))
+    assert np.isfinite(losses).all()
+    # learning signal: the tail is clearly below the head
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) - 0.01, (
+        np.mean(losses[:8]), np.mean(losses[-8:])
+    )
+
+
+def test_async_a2a_path():
+    mesh, model, tr, batches = _setup(comm="a2a")
+    st = tr.init(0)
+    ast = tr.bootstrap(st, batches[0])
+    for t in range(1, 6):
+        ast, mets = tr.train_step_async(ast, batches[t % len(batches)])
+        assert np.isfinite(float(mets["loss"]))

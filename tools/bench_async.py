@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Measure the async embedding stage's overlap win: sync vs stale-by-one
+sharded step time on the available mesh.
+
+The async step issues the embedding exchange for batch t with no data
+dependency on batch t-1's dense compute, so XLA can overlap the collective
+with the matmuls (reference: async_embedding_stage.py). This tool measures
+whether it does on the target hardware.
+
+    python tools/bench_async.py [--devices 8] [--batch 4096] [--steps 30]
+
+On a CPU host-platform mesh the absolute numbers mean little; the TPU run
+is the answer recorded in docs/perf notes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=0, help="0 = all available")
+    p.add_argument("--batch", type=int, default=4096)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--emb_dim", type=int, default=32)
+    p.add_argument("--comm", default="a2a", choices=["a2a", "allgather"])
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import DLRM
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.parallel import (
+        AsyncShardedTrainer,
+        ShardedTrainer,
+        make_mesh,
+        shard_batch,
+    )
+
+    n = args.devices or len(jax.devices())
+    mesh = make_mesh(n)
+    model = DLRM(emb_dim=args.emb_dim, capacity=1 << 20,
+                 bottom=(128, 64, args.emb_dim))
+    gen = SyntheticCriteo(batch_size=args.batch, vocab=500_000, seed=0)
+    batches = [
+        shard_batch(mesh, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+        for _ in range(8)
+    ]
+
+    def timed(step, state, tag):
+        for i in range(3):
+            state, mets = step(state, batches[i % len(batches)])
+        jax.block_until_ready(mets["loss"])
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, mets = step(state, batches[i % len(batches)])
+        jax.block_until_ready(mets["loss"])
+        dt = (time.perf_counter() - t0) / args.steps
+        print(f"{tag:12s} {dt * 1e3:8.2f} ms/step "
+              f"({args.batch / dt:,.0f} ex/s)")
+        return dt
+
+    sync = ShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
+                          mesh=mesh, comm=args.comm)
+    dt_sync = timed(sync.train_step, sync.init(0), "sync")
+
+    asy = AsyncShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
+                              mesh=mesh, comm=args.comm)
+    ast = asy.bootstrap(asy.init(0), batches[0])
+    dt_async = timed(asy.train_step_async, ast, "async")
+
+    print(f"speedup: {dt_sync / dt_async:.3f}x "
+          f"({'async wins' if dt_async < dt_sync else 'sync wins'}, "
+          f"{n} devices, comm={args.comm})")
+
+
+if __name__ == "__main__":
+    main()
