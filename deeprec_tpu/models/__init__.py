@@ -8,3 +8,4 @@ from deeprec_tpu.models.bst import BST
 from deeprec_tpu.models.dssm import DSSM
 from deeprec_tpu.models.masknet import MaskNet
 from deeprec_tpu.models.multitask import DBMTL, ESMM, MMoE, PLE, SimpleMultiTask
+from deeprec_tpu.models.registry import REGISTRY, build_model

@@ -1,0 +1,47 @@
+"""Name -> model constructor registry (modelzoo CLI + serving frontend).
+
+The reference resolves models by directory (modelzoo/<name>/train.py); here
+one registry serves the python -m entry points. Constructor kwargs mirror
+each model's dataclass fields.
+"""
+from __future__ import annotations
+
+from deeprec_tpu.models.bst import BST
+from deeprec_tpu.models.dcn import DCNv2
+from deeprec_tpu.models.deepfm import DeepFM
+from deeprec_tpu.models.dien import DIEN
+from deeprec_tpu.models.din import DIN
+from deeprec_tpu.models.dlrm import DLRM
+from deeprec_tpu.models.dssm import DSSM
+from deeprec_tpu.models.masknet import MaskNet
+from deeprec_tpu.models.multitask import DBMTL, ESMM, MMoE, PLE, SimpleMultiTask
+from deeprec_tpu.models.wdl import WDL
+
+REGISTRY = {
+    "wdl": WDL,
+    "wide_and_deep": WDL,
+    "dlrm": DLRM,
+    "deepfm": DeepFM,
+    "dcn": DCNv2,
+    "dcnv2": DCNv2,
+    "din": DIN,
+    "dien": DIEN,
+    "bst": BST,
+    "dssm": DSSM,
+    "masknet": MaskNet,
+    "mmoe": MMoE,
+    "ple": PLE,
+    "esmm": ESMM,
+    "dbmtl": DBMTL,
+    "simple_multitask": SimpleMultiTask,
+}
+
+
+def build_model(name: str, **kwargs):
+    try:
+        cls = REGISTRY[name.lower()]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; choose from {sorted(REGISTRY)}"
+        ) from None
+    return cls(**kwargs)

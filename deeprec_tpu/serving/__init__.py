@@ -1,1 +1,2 @@
 from deeprec_tpu.serving.predictor import ModelServer, Predictor
+from deeprec_tpu.serving.http_server import HttpServer

@@ -1,0 +1,158 @@
+"""HTTP serving frontend over the ModelServer.
+
+The network-facing surface of the serving stack — the role of the
+reference's processor C ABI + gRPC glue (serving/processor/serving/
+processor.h: initialize/process) re-cut as a dependency-free JSON/HTTP
+server (stdlib http.server; a threading server whose request threads block
+on the ModelServer's coalescing queue, so concurrent requests batch into
+full device batches automatically).
+
+Protocol:
+  POST /v1/predict   {"features": {"C1": [..ids..], "I1": [[..]], ...}}
+                  -> {"predictions": [...]} (or {"task": [...]} for MTL)
+  GET  /v1/model_info -> {"step": N, "table_sizes": {...}}
+  POST /v1/reload    -> {"updated": bool}   (poll full/delta updates now)
+  GET  /healthz      -> 200 "ok"
+
+Run: python -m deeprec_tpu.serving.http_server --model wdl --ckpt DIR
+or embed: ``HttpServer(server, port=8500).start()``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeprec_tpu.serving.predictor import ModelServer, Predictor
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "deeprec-tpu-serving/1.0"
+
+    # set by HttpServer
+    model_server: ModelServer = None
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, "ok")
+        elif self.path == "/v1/model_info":
+            self._send(200, self.model_server.predictor.model_info())
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except Exception as e:
+            return self._send(400, {"error": f"bad json: {e}"})
+        if self.path == "/v1/reload":
+            try:
+                updated = bool(self.model_server.predictor.poll_updates())
+            except Exception as e:  # corrupt/partial checkpoint: report it
+                return self._send(500, {"error": str(e)})
+            return self._send(200, {"updated": updated})
+        if self.path != "/v1/predict":
+            return self._send(404, {"error": f"unknown path {self.path}"})
+        feats = payload.get("features")
+        if not isinstance(feats, dict) or not feats:
+            return self._send(400, {"error": "missing 'features' object"})
+        try:
+            dtypes = self.model_server.predictor.feature_dtypes
+            batch = {}
+            for k, v in feats.items():
+                arr = np.asarray(v)
+                want = dtypes.get(k)
+                if want is not None and want.kind in "iu":
+                    arr = arr.astype(want)  # table key dtype (no truncation)
+                elif arr.dtype.kind in "iu" and want is None:
+                    arr = arr.astype(np.int64)
+                else:
+                    arr = arr.astype(np.float32)
+                    if arr.ndim == 1:
+                        arr = arr[:, None]  # dense features are [B, W]
+                batch[k] = arr
+            probs = self.model_server.request(batch)
+            if isinstance(probs, dict):
+                out = {k: np.asarray(v).tolist() for k, v in probs.items()}
+            else:
+                out = np.asarray(probs).tolist()
+            self._send(200, {"predictions": out})
+        except Exception as e:  # request-level failure, keep serving
+            self._send(500, {"error": str(e)})
+
+
+class HttpServer:
+    """Bind a ModelServer to a TCP port. start() is non-blocking."""
+
+    def __init__(self, model_server: ModelServer, port: int = 8500,
+                 host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,),
+                       {"model_server": model_server})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]  # resolved if port=0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()  # release the listening socket
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt", required=True, help="checkpoint directory")
+    p.add_argument("--model", default="wdl",
+                   help="modelzoo model name (see deeprec_tpu.models)")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--max_batch", type=int, default=256)
+    p.add_argument("--poll_secs", type=float, default=10.0)
+    p.add_argument("--emb_dim", type=int, default=16)
+    p.add_argument("--capacity", type=int, default=1 << 20,
+                   help="must match the trained checkpoint's table capacity")
+    args = p.parse_args(argv)
+
+    from deeprec_tpu.models.registry import build_model
+
+    model = build_model(args.model, emb_dim=args.emb_dim,
+                        capacity=args.capacity)
+    pred = Predictor(model, args.ckpt)
+    ms = ModelServer(pred, max_batch=args.max_batch,
+                     poll_updates_secs=args.poll_secs)
+    srv = HttpServer(ms, port=args.port, host=args.host)
+    print(f"serving {args.model} from {args.ckpt} on "
+          f"http://{args.host}:{srv.port}")
+    srv.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,9 +32,17 @@ from deeprec_tpu.training.trainer import Trainer, TrainState
 
 
 class Predictor:
-    """Load-latest-and-serve. Thread-safe; updates swap atomically."""
+    """Load-latest-and-serve. Thread-safe; updates swap atomically.
 
-    def __init__(self, model, ckpt_dir: str):
+    `stores` optionally maps table names to a feature-store object with
+    ``get(keys) -> (values, freq, version, found)`` (HostKV signature) —
+    the read-through analog of the reference's Redis feature store
+    (serving/processor/storage/redis_feature_store.h:18): keys missing
+    from the device table serve the store's row instead of the
+    initializer value.
+    """
+
+    def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None):
         self.model = model
         # Serving needs no optimizer; slot-less sparse opt keeps restore lean
         # (checkpointed slot arrays are skipped when the template has none).
@@ -42,7 +50,14 @@ class Predictor:
         self._ck = CheckpointManager(ckpt_dir, self._trainer)
         self._state: Optional[TrainState] = None
         self._applied: set = set()
-        self._lock = threading.Lock()
+        # Reentrant: poll_updates holds it across its check-then-act (a
+        # concurrent full reload must not interleave with a delta replay)
+        # and may call reload() which takes it again.
+        self._lock = threading.RLock()
+        self.stores = dict(stores or {})
+        self._predict_step = jax.jit(self._predict_impl)
+        self._forward_step = jax.jit(self._forward_impl)
+        self._lookup_step = jax.jit(self._lookup_views)
         self.reload()
 
     # ------------------------------------------------------------- updates
@@ -69,14 +84,17 @@ class Predictor:
     def poll_updates(self) -> bool:
         """Apply anything new: a newer full checkpoint triggers a full
         reload; new deltas replay onto the live state (DeltaModelUpdate).
-        Returns True if the model changed."""
-        new = [d for d in self._dirs() if d not in self._applied]
-        if not new:
-            return False
-        if any(d.startswith("full-") for d in new):
-            self.reload()
-            return True
+        Returns True if the model changed. Safe to call concurrently (HTTP
+        /v1/reload + background poller): the whole check-then-act runs
+        under the lock, so a stale delta can never replay over a newer
+        full reload."""
         with self._lock:
+            new = [d for d in self._dirs() if d not in self._applied]
+            if not new:
+                return False
+            if any(d.startswith("full-") for d in new):
+                self.reload()
+                return True
             state = self._state
             last_step = int(state.step)
             for d in sorted(new, key=lambda s: int(s.split("-")[1])):
@@ -96,24 +114,93 @@ class Predictor:
     # ------------------------------------------------------------- predict
 
     def predict(self, batch: Dict[str, np.ndarray]):
-        """Probabilities for one batch (dict keyed per task for MTL)."""
+        """Probabilities for one batch (dict keyed per task for MTL).
+        Label-free: the serving path runs lookup + forward + sigmoid only —
+        no loss, no dummy labels, no training machinery."""
         state = self._state  # atomic reference read
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        _, probs = self._trainer.eval_step(state, self._with_dummy_labels(batch))
+        if self.stores:
+            probs = self._predict_with_stores(state, batch)
+        else:
+            probs = self._predict_step(state, batch)
         return jax.tree.map(np.asarray, probs)
 
-    def _with_dummy_labels(self, batch):
-        # eval_step computes a loss; serve requests carry no labels. The
-        # model declares its tasks (label_tasks); single-task models use
-        # plain 'label'.
-        b = next(iter(batch.values())).shape[0]
-        out = dict(batch)
-        tasks = getattr(self.model, "label_tasks", None)
-        if tasks:
-            for task in tasks:
-                out.setdefault(f"label_{task}", jnp.zeros((b,), jnp.float32))
-        else:
-            out.setdefault("label", jnp.zeros((b,), jnp.float32))
+    def _lookup_views(self, state, batch):
+        """Readonly lookup pass: feature -> (unique embs, inverse, mask)
+        plus per-bundle results (slot_ix/uids for the store fallback)."""
+        tables = dict(state.tables)
+        _, views, bundle_res = self._trainer._lookup_all(
+            tables, batch, state.step, False
+        )
+        return views, bundle_res
+
+    def _forward_from_views(self, state, views, batch):
+        tr = self._trainer
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+        inputs = tr._build_inputs(embs, views, batch)
+        out = self.model.apply(state.dense, inputs, train=False)
+        if isinstance(out, dict):
+            return {k: jax.nn.sigmoid(v) for k, v in out.items()}
+        return jax.nn.sigmoid(out)
+
+    def _predict_impl(self, state, batch):
+        views, _ = self._lookup_views(state, batch)
+        return self._forward_from_views(state, views, batch)
+
+    def _forward_impl(self, state, views, batch):
+        return self._forward_from_views(state, views, batch)
+
+    def _predict_with_stores(self, state, batch):
+        """Read-through path: jitted lookup, host-side store correction of
+        missing keys, jitted forward. Two dispatches instead of one — the
+        price of consulting an external store, paid only when configured."""
+        views, bundle_res = self._lookup_step(state, batch)
+        views = dict(views)
+        for bname, b in self._trainer.bundles.items():
+            res = bundle_res[bname]
+            for k, f in enumerate(b.features):
+                tname = self._resolve_table_name(f)
+                store = self.stores.get(tname)
+                if store is None:
+                    continue
+                r = (
+                    jax.tree.map(lambda a: a[k], res)
+                    if b.stacked
+                    else res[f.name]
+                )
+                emb, inverse, mask = views[f.name]
+                missing = np.asarray(r.slot_ix < 0) & np.asarray(r.valid)
+                if not missing.any():
+                    continue
+                keys = np.asarray(r.uids)[missing].astype(np.int64)
+                rows, _, _, found = store.get(keys)
+                if not found.any():
+                    continue
+                emb = np.asarray(emb).copy()
+                mix = np.nonzero(missing)[0][found]
+                emb[mix] = rows[found].astype(emb.dtype)
+                views[f.name] = (jnp.asarray(emb), inverse, mask)
+        return self._forward_step(state, views, batch)
+
+    @staticmethod
+    def _resolve_table_name(f):
+        from deeprec_tpu.features import resolve_table_name
+
+        return resolve_table_name(f)
+
+    @property
+    def feature_dtypes(self) -> Dict[str, "np.dtype"]:
+        """Expected numpy dtype per input feature (sparse ids use their
+        table's key_dtype; dense features are float32) — lets frontends
+        coerce JSON payloads without truncating 64-bit ids."""
+        from deeprec_tpu import features as fcol
+
+        out = {}
+        cfgs = {n: t.cfg for n, t in self._trainer.tables.items()}
+        for f in self._trainer.sparse_specs:
+            out[f.name] = np.dtype(cfgs[fcol.resolve_table_name(f)].key_dtype)
+        for f in self._trainer.dense_specs:
+            out[f.name] = np.dtype(np.float32)
         return out
 
     @property

@@ -75,6 +75,99 @@ def test_full_model_update_supersedes(tmp_path):
     assert p.step == 7
 
 
+def test_feature_store_read_through(tmp_path):
+    """Keys missing from the device table serve the store's row instead of
+    the initializer — Redis feature-store read-through parity
+    (redis_feature_store.h:18)."""
+    from deeprec_tpu.native import HostKV
+
+    model, tr, st, ck, batches, gen = make_trained(tmp_path)
+    # pick an id that was never trained: it misses in every table
+    novel = 999_999
+    req = strip_labels(batches[0])
+    # stores keyed by table name; fill one table's store with a marked row
+    tname = sorted(tr.tables)[0]
+    dim = tr.tables[tname].cfg.dim
+    kv = HostKV(dim=dim, initial_capacity=64)
+    kv.put(np.asarray([novel], np.int64),
+           np.full((1, dim), 2.5, np.float32),
+           np.asarray([1], np.int32), np.asarray([1], np.int32))
+
+    p_plain = Predictor(model, str(tmp_path))
+    p_store = Predictor(model, str(tmp_path), stores={tname: kv})
+    req_novel = dict(req)
+    req_novel[tname] = np.full_like(req[tname], novel)
+    out_plain = p_plain.predict(req_novel)
+    out_store = p_store.predict(req_novel)
+    # the store row changes the served prediction
+    assert np.abs(np.asarray(out_store) - np.asarray(out_plain)).max() > 1e-6
+    # and known keys predict identically through both paths
+    np.testing.assert_allclose(
+        np.asarray(p_store.predict(req)), np.asarray(p_plain.predict(req)),
+        atol=1e-6,
+    )
+
+
+def test_http_server_end_to_end(tmp_path):
+    """train -> save -> serve over HTTP -> delta-update -> prediction shifts
+    (the VERDICT round-1 acceptance flow for the serving frontend)."""
+    import json
+    import urllib.request
+
+    from deeprec_tpu.serving import HttpServer
+
+    model, tr, st, ck, batches, gen = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=64,
+                         max_wait_ms=2)
+    http = HttpServer(server, port=0).start()  # ephemeral port
+    base = f"http://127.0.0.1:{http.port}"
+
+    def call(path, payload=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="GET" if payload is None else "POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        info = call("/v1/model_info")
+        assert info["step"] == 5
+
+        feats = {
+            k: np.asarray(v)[:4].tolist()
+            for k, v in strip_labels(batches[0]).items()
+        }
+        out1 = call("/v1/predict", {"features": feats})["predictions"]
+        assert len(out1) == 4 and all(0.0 <= p <= 1.0 for p in out1)
+
+        # delta-update: train on, save incremental, tell the server to poll
+        for _ in range(3):
+            st, _ = tr.train_step(st, batches[0])
+        st, _ = ck.save_incremental(st)
+        assert call("/v1/reload", {})["updated"] is True
+        assert call("/v1/model_info")["step"] == 8
+        out2 = call("/v1/predict", {"features": feats})["predictions"]
+        assert np.abs(np.asarray(out2) - np.asarray(out1)).max() > 1e-6
+
+        # malformed request -> 400, server stays alive
+        req = urllib.request.Request(
+            base + "/v1/predict", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        assert call("/healthz") == "ok"
+    finally:
+        http.stop()
+        server.close()
+
+
 def test_model_server_batches_concurrent_requests(tmp_path):
     model, tr, st, ck, batches, gen = make_trained(tmp_path)
     server = ModelServer(Predictor(model, str(tmp_path)), max_batch=64,
