@@ -1,0 +1,91 @@
+"""Multi-host launcher: `python -m deeprec_tpu.launch [...] -- script.py args`.
+
+The counterpart of the reference's distributed launcher
+(tensorflow/python/distribute/launch.py:55-97), which reads the cluster
+layout from env vars, exports TF_CONFIG and execs the training script. The
+JAX/TPU shape of the same job:
+
+  * wire jax.distributed.initialize(coordinator, num_processes, process_id)
+    BEFORE any jax import in the user script — after that, jax.devices()
+    spans the whole pod and every shard_map/psum in this framework rides
+    the global mesh (DCN between hosts, ICI within);
+  * then run the target script in-process (runpy), so the user code needs
+    zero changes to go multi-host.
+
+Cluster layout comes from flags or, like the reference, from environment
+variables: DEEPREC_COORDINATOR (host:port), DEEPREC_NUM_PROCESSES,
+DEEPREC_PROCESS_ID. On TPU pods all three are optional —
+jax.distributed.initialize() autodetects the pod topology.
+
+Single-host multi-process CPU testing works the same way (the 2-process CI
+test in tests/test_launch.py drives a psum and a file-coordinated WorkQueue
+across processes).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import Optional
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Wire the DCN control plane (idempotent — a no-op when already
+    initialized, so scripts may call it defensively even under the CLI).
+    Call before creating any arrays."""
+    import jax
+
+    try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:  # older jax without the predicate
+        pass
+
+    kw = {}
+    coordinator = coordinator or os.environ.get("DEEPREC_COORDINATOR")
+    if num_processes is None and os.environ.get("DEEPREC_NUM_PROCESSES"):
+        num_processes = int(os.environ["DEEPREC_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("DEEPREC_PROCESS_ID"):
+        process_id = int(os.environ["DEEPREC_PROCESS_ID"])
+    if coordinator:
+        kw["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="deeprec_tpu multi-host launcher",
+        usage="python -m deeprec_tpu.launch [flags] -- script.py [args...]",
+    )
+    p.add_argument("--coordinator", default=None, help="host:port of proc 0")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("script", help="training script to run after init")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    initialize(args.coordinator, args.num_processes, args.process_id)
+
+    import jax
+
+    print(
+        f"deeprec_tpu.launch: process {jax.process_index()}/"
+        f"{jax.process_count()} up, {len(jax.local_devices())} local / "
+        f"{len(jax.devices())} global devices",
+        flush=True,
+    )
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

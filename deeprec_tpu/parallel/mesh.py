@@ -22,7 +22,53 @@ def make_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.asarray(devs[:n]), (axis,))
 
 
+def put_global(x, sharding: NamedSharding):
+    """device_put that also works when the sharding spans processes: the
+    host value (identical on every process) is placed shard-by-shard, each
+    process contributing only its addressable pieces."""
+    if jax.process_count() > 1:
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+    return jax.device_put(x, sharding)
+
+
+def put_tiled_global(local: "np.ndarray", lead: tuple, sharding: NamedSharding):
+    """Place an array whose content is `local` tiled identically along
+    `lead` leading axes (table-stack and shard axes) WITHOUT materializing
+    the full global value anywhere: each process's callback broadcasts the
+    shared per-shard template into just its addressable shards. This is
+    what lets multi-host init create pod-scale tables that no single host
+    could hold."""
+    local = np.asarray(local)
+    shape = tuple(lead) + local.shape
+
+    def cb(idx):
+        k = len(lead)
+        tile = local[tuple(idx[k:])]
+        lead_shape = tuple(
+            len(range(*s.indices(d))) for s, d in zip(idx[:k], lead)
+        )
+        return np.broadcast_to(tile, lead_shape + tile.shape)
+
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(shape, sharding, cb)
+    stacked = np.broadcast_to(local, shape)
+    return jax.device_put(stacked, sharding)
+
+
 def shard_batch(mesh: Mesh, batch: dict, axis: str = "data") -> dict:
-    """Place a host batch with batch-dim sharding over the mesh."""
+    """Place a host batch with batch-dim sharding over the mesh.
+
+    Multi-host aware: when the mesh spans processes (jax.distributed
+    initialized), each process passes its LOCAL slice of the batch — sized
+    B_global * local_devices / global_devices — and the global array is
+    assembled across hosts (data stays put; no DCN transfer)."""
     sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() > 1:
+        return {
+            k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+            for k, v in batch.items()
+        }
     return {k: jax.device_put(v, sharding) for k, v in batch.items()}

@@ -67,6 +67,7 @@ class ShardedTrainer(Trainer):
         grad_averaging: bool = False,
         comm: str = "allgather",  # or "a2a": budgeted all2all (SOK path)
         remat: bool = False,
+        a2a_slack: float = 2.0,
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
@@ -78,7 +79,8 @@ class ShardedTrainer(Trainer):
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
         self.sharded = {
-            bname: ShardedTable(b.table, self.num_shards, axis, comm=comm)
+            bname: ShardedTable(b.table, self.num_shards, axis, comm=comm,
+                                a2a_slack=a2a_slack)
             for bname, b in self.bundles.items()
         }
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
@@ -88,27 +90,35 @@ class ShardedTrainer(Trainer):
     # ------------------------------------------------------------------ init
 
     def init(self, seed: int = 0) -> TrainState:
+        from deeprec_tpu.parallel.mesh import put_global, put_tiled_global
+
         key = jax.random.PRNGKey(seed)
         dense = self.model.init(key)
         N = self.num_shards
         tables = {}
         for bname, b in self.bundles.items():
             local = ensure_slots(b.table, b.table.create(), self.sparse_opt)
-            # layout: [T?, N, C_local, ...] — shard axis right before capacity
-            local = jax.tree.map(lambda a: jnp.stack([a] * N), local)
+            # layout: [T?, N, C_local, ...] — shard axis right before
+            # capacity. The per-shard template tiles identically along the
+            # lead axes; put_tiled_global never materializes the pod-scale
+            # global value on one host.
             if b.stacked:
-                T = len(b.features)
-                local = jax.tree.map(lambda a: jnp.stack([a] * T), local)
+                lead = (len(b.features), N)
                 spec = P(None, self.axis)
             else:
+                lead = (N,)
                 spec = P(self.axis)
-            tables[bname] = jax.device_put(local, NamedSharding(self.mesh, spec))
+            sh = NamedSharding(self.mesh, spec)
+            tables[bname] = jax.tree.map(
+                lambda a, lead=lead, s=sh: put_tiled_global(a, lead, s), local
+            )
         repl = NamedSharding(self.mesh, P())
+        put_repl = lambda t: jax.tree.map(lambda a: put_global(a, repl), t)
         return TrainState(
-            step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+            step=put_global(jnp.zeros((), jnp.int32), repl),
             tables=tables,
-            dense=jax.device_put(dense, repl),
-            opt_state=jax.device_put(self.dense_opt.init(dense), repl),
+            dense=put_repl(dense),
+            opt_state=put_repl(self.dense_opt.init(dense)),
         )
 
     # -------------------------------------------------------------- internals
