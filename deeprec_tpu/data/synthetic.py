@@ -13,6 +13,20 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 
+def zipf_ids(rng: np.random.Generator, vocab: int, a: float, shape):
+    """Bounded zipf(a) via inverse-CDF over a fixed vocab: a=1 is the
+    log-uniform limit; larger a concentrates mass on head ids."""
+    u = rng.random(shape)
+    if abs(a - 1.0) < 1e-6:
+        ranks = np.floor(np.exp(u * np.log(vocab))).astype(np.int64)
+    else:
+        v = vocab ** (1.0 - a)
+        ranks = np.floor((u * (v - 1.0) + 1.0) ** (1.0 / (1.0 - a))).astype(
+            np.int64
+        )
+    return np.clip(ranks, 1, vocab) - 1
+
+
 class SyntheticCriteo:
     """Batches shaped like Criteo: I1-I13 floats [B,1], C1-C26 int ids [B],
     label [B]."""
@@ -40,18 +54,7 @@ class SyntheticCriteo:
         self.dense_weight = wrng.normal(0, 0.5, size=(num_dense,)).astype(np.float32)
 
     def _zipf_ids(self, shape):
-        # bounded zipf(a) via inverse-CDF over a fixed vocab: a=1 is the
-        # log-uniform limit; larger a concentrates mass on head ids.
-        u = self.rng.random(shape)
-        a = self.zipf_a
-        if abs(a - 1.0) < 1e-6:
-            ranks = np.floor(np.exp(u * np.log(self.vocab))).astype(np.int64)
-        else:
-            v = self.vocab ** (1.0 - a)
-            ranks = np.floor((u * (v - 1.0) + 1.0) ** (1.0 / (1.0 - a))).astype(
-                np.int64
-            )
-        return np.clip(ranks, 1, self.vocab) - 1
+        return zipf_ids(self.rng, self.vocab, self.zipf_a, shape)
 
     def batch(self) -> Dict[str, np.ndarray]:
         cats = self._zipf_ids((self.num_cat, self.B))
@@ -98,26 +101,43 @@ class SyntheticTwoTower:
     """User/item id features + label from hidden affinity, for DSSM."""
 
     def __init__(self, batch_size=512, num_user=4, num_item=4, vocab=10_000,
-                 seed=0, dtype=np.int32):
+                 zipf_a: float = 1.2, seed=0, dtype=np.int32):
         self.B = batch_size
         self.num_user = num_user
         self.num_item = num_item
         self.vocab = vocab
+        self.zipf_a = zipf_a
         self.rng = np.random.default_rng(seed)
         self.dtype = dtype
         wrng = np.random.default_rng(4242)
         self.vec = wrng.normal(0, 1, size=(num_user + num_item, vocab, 4)).astype(
             np.float32
         )
+        # Per-id popularity/propensity biases: real click logs are dominated
+        # by these first-order effects, and they give the towers a gradient
+        # signal learnable in O(100) steps — a PURELY bilinear label (the
+        # old workload) needs both towers aligned before any AUC moves,
+        # which is why DSSM smoke-tested at coin-flip.
+        self.bias = wrng.normal(0, 1.0, size=(num_user + num_item, vocab)).astype(
+            np.float32
+        )
 
     def batch(self) -> Dict[str, np.ndarray]:
-        ids = self.rng.integers(0, self.vocab, size=(self.num_user + self.num_item, self.B))
+        # zipf ids: real interaction logs are heavy-tailed, and head mass is
+        # what makes the workload learnable in a bounded smoke run — uniform
+        # ids gave each id ~6 observations total and DSSM smoke-tested at
+        # coin-flip.
+        ids = zipf_ids(self.rng, self.vocab, self.zipf_a,
+                       (self.num_user + self.num_item, self.B))
         u = sum(self.vec[i, ids[i]] for i in range(self.num_user))
         v = sum(
             self.vec[self.num_user + i, ids[self.num_user + i]]
             for i in range(self.num_item)
         )
-        logit = (u * v).sum(1) * 0.5
+        pop = sum(
+            self.bias[i, ids[i]] for i in range(self.num_user + self.num_item)
+        )
+        logit = (u * v).sum(1) * 0.5 + pop * 0.5
         prob = 1.0 / (1.0 + np.exp(-(logit - logit.mean())))
         label = (self.rng.random(self.B) < prob).astype(np.float32)
         out = {"label": label}
@@ -162,11 +182,14 @@ class SyntheticBehaviorSequence:
         self.item_vec = wrng.normal(0, 1, size=(vocab, 8)).astype(np.float32)
         # fixed item -> category mapping
         self.item_cat = wrng.integers(0, num_cats, size=(vocab,))
+        # first-order target-item/category propensity (see SyntheticTwoTower:
+        # makes the workload learnable fast; the history-affinity term still
+        # rewards attention over the sequence)
+        self.item_bias = wrng.normal(0, 1.0, size=(vocab,)).astype(np.float32)
+        self.cat_bias = wrng.normal(0, 1.0, size=(num_cats,)).astype(np.float32)
 
     def _zipf_ids(self, shape):
-        u = self.rng.random(shape)
-        ranks = np.floor(np.exp(u * np.log(self.vocab))).astype(np.int64)
-        return np.clip(ranks, 1, self.vocab) - 1
+        return zipf_ids(self.rng, self.vocab, 1.0, shape)
 
     def batch(self) -> Dict[str, np.ndarray]:
         B, L = self.B, self.seq_len
@@ -179,7 +202,11 @@ class SyntheticBehaviorSequence:
         hvec = (self.item_vec[hist] * mask[..., None]).sum(1) / np.maximum(
             lengths[:, None], 1
         )
-        logit = (hvec * self.item_vec[target]).sum(1) * 1.5
+        logit = (
+            (hvec * self.item_vec[target]).sum(1) * 1.5
+            + self.item_bias[target]
+            + self.cat_bias[self.item_cat[target]] * 0.5
+        )
         prob = 1.0 / (1.0 + np.exp(-(logit - logit.mean())))
         label = (self.rng.random(B) < prob).astype(np.float32)
         return {

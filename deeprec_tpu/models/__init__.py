@@ -1,7 +1,7 @@
 from deeprec_tpu.models.wdl import WDL
-from deeprec_tpu.models.dlrm import DLRM
+from deeprec_tpu.models.dlrm import DLRM, DLRMDCN
 from deeprec_tpu.models.deepfm import DeepFM
-from deeprec_tpu.models.dcn import DCNv2
+from deeprec_tpu.models.dcn import DCN, DCNv2
 from deeprec_tpu.models.din import DIN
 from deeprec_tpu.models.dien import DIEN
 from deeprec_tpu.models.bst import BST

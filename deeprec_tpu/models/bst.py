@@ -59,6 +59,9 @@ class BST:
             seq = nn.transformer_block_apply(blk, seq, m, self.heads,
                                              flash=self.use_flash)
         denom = jnp.sum(m, axis=1, keepdims=True).astype(jnp.float32)
-        pooled = jnp.sum(seq, axis=1) / jnp.maximum(denom, 1.0)
+        # Mask BEFORE pooling: padded positions still carry positional
+        # embedding + FF residuals through the encoder and would dilute the
+        # mean for short histories.
+        pooled = jnp.sum(seq * m[..., None], axis=1) / jnp.maximum(denom, 1.0)
         x = jnp.concatenate([inputs.pooled["user"], pooled], axis=-1)
         return nn.mlp_apply(params["mlp"], x)[:, 0]

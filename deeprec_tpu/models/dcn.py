@@ -32,6 +32,11 @@ class DCNv2:
         self._cats = [f.name for f in self.features if isinstance(f, SparseFeature)]
         self._dense = [f.name for f in self.features if isinstance(f, DenseFeature)]
 
+    # Cross-network flavor: v2 uses matrix weights; the DCN subclass swaps
+    # in the vector-weight originals.
+    _cross_init = staticmethod(nn.crossnet_init)
+    _cross_apply = staticmethod(nn.crossnet_apply)
+
     def _width(self):
         return self.num_cat * self.emb_dim + self.num_dense
 
@@ -39,7 +44,7 @@ class DCNv2:
         k1, k2, k3 = jax.random.split(key, 3)
         w = self._width()
         return {
-            "cross": nn.crossnet_init(k1, w, self.cross_depth),
+            "cross": self._cross_init(k1, w, self.cross_depth),
             "deep": nn.mlp_init(k2, w, list(self.hidden)),
             "head": nn.dense_init(k3, w + self.hidden[-1], 1),
         }
@@ -49,7 +54,16 @@ class DCNv2:
         dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
         dense = jnp.log1p(jnp.maximum(dense, 0.0))
         x0 = jnp.concatenate(embs + [dense], axis=-1)
-        cross = nn.crossnet_apply(params["cross"], x0)
+        cross = self._cross_apply(params["cross"], x0)
         deep = nn.mlp_apply(params["deep"], x0, final_activation=jax.nn.relu)
         out = nn.dense_apply(params["head"], jnp.concatenate([cross, deep], -1))
         return out[:, 0]
+
+
+@dataclasses.dataclass
+class DCN(DCNv2):
+    """Original DCN (vector-weight cross network) — the reference's
+    modelzoo/dcn/train.py model; v2 above is modelzoo/dcnv2."""
+
+    _cross_init = staticmethod(nn.crossnet_v1_init)
+    _cross_apply = staticmethod(nn.crossnet_v1_apply)

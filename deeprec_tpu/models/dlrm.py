@@ -52,3 +52,30 @@ class DLRM:
         inter = nn.dot_interaction(stack)
         top_in = jnp.concatenate([bottom, inter], axis=-1)
         return nn.mlp_apply(params["top"], top_in)[:, 0]
+
+
+@dataclasses.dataclass
+class DLRMDCN(DLRM):
+    """DLRM_DCN — the MLPerf 2022 configuration the reference ships as
+    modelzoo/mlperf/train.py: dot-product interactions replaced by a DCNv2
+    cross network over [bottom | field embeddings]."""
+
+    cross_depth: int = 3
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        w = (self.num_cat + 1) * self.emb_dim
+        return {
+            "bottom": nn.mlp_init(k1, self.num_dense, list(self.bottom)),
+            "cross": nn.crossnet_init(k2, w, self.cross_depth),
+            "top": nn.mlp_init(k3, w, list(self.top)),
+        }
+
+    def apply(self, params, inputs, train: bool):
+        dense = jnp.concatenate([inputs.dense[d] for d in self._dense], axis=-1)
+        dense = jnp.log1p(jnp.maximum(dense, 0.0))
+        bottom = nn.mlp_apply(params["bottom"], dense, final_activation=jax.nn.relu)
+        embs = [inputs.pooled[c] for c in self._cats]
+        x0 = jnp.concatenate([bottom] + embs, axis=-1)
+        cross = nn.crossnet_apply(params["cross"], x0)
+        return nn.mlp_apply(params["top"], cross)[:, 0]

@@ -7,11 +7,11 @@ each model's dataclass fields.
 from __future__ import annotations
 
 from deeprec_tpu.models.bst import BST
-from deeprec_tpu.models.dcn import DCNv2
+from deeprec_tpu.models.dcn import DCN, DCNv2
 from deeprec_tpu.models.deepfm import DeepFM
 from deeprec_tpu.models.dien import DIEN
 from deeprec_tpu.models.din import DIN
-from deeprec_tpu.models.dlrm import DLRM
+from deeprec_tpu.models.dlrm import DLRM, DLRMDCN
 from deeprec_tpu.models.dssm import DSSM
 from deeprec_tpu.models.masknet import MaskNet
 from deeprec_tpu.models.multitask import DBMTL, ESMM, MMoE, PLE, SimpleMultiTask
@@ -21,8 +21,10 @@ REGISTRY = {
     "wdl": WDL,
     "wide_and_deep": WDL,
     "dlrm": DLRM,
+    "dlrm_dcn": DLRMDCN,
+    "mlperf": DLRMDCN,
     "deepfm": DeepFM,
-    "dcn": DCNv2,
+    "dcn": DCN,
     "dcnv2": DCNv2,
     "din": DIN,
     "dien": DIEN,

@@ -14,10 +14,12 @@ def behavior_features(
     capacity: int = 1 << 16,
     ev: EmbeddingVariableOption = EmbeddingVariableOption(),
     key_dtype: str = "int32",
+    max_len: int = 200,
 ) -> List:
     """target_item/hist_items share one item table; target_cat/hist_cats share
     one category table (shared-embedding semantics, as in the reference
-    models)."""
+    models). `max_len` is the declared history length — serving frontends
+    pad/trim ragged histories to it so each feature has ONE compiled shape."""
 
     def tc(name):
         return TableConfig(name=name, dim=emb_dim, capacity=capacity, ev=ev,
@@ -26,7 +28,9 @@ def behavior_features(
     return [
         SparseFeature(name="user", table=tc("user"), pooling="mean"),
         SparseFeature(name="target_item", table=tc("target_item"), pooling="mean"),
-        SparseFeature(name="hist_items", shared_table="target_item", pooling="none"),
+        SparseFeature(name="hist_items", shared_table="target_item",
+                      pooling="none", max_len=max_len),
         SparseFeature(name="target_cat", table=tc("target_cat"), pooling="mean"),
-        SparseFeature(name="hist_cats", shared_table="target_cat", pooling="none"),
+        SparseFeature(name="hist_cats", shared_table="target_cat",
+                      pooling="none", max_len=max_len),
     ]

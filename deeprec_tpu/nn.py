@@ -233,6 +233,26 @@ def crossnet_apply(p: Params, x0):
     return x
 
 
+def crossnet_v1_init(key, dim: int, depth: int) -> Params:
+    keys = jax.random.split(key, depth)
+    return {
+        "layers": [
+            {"w": _glorot(k, (dim, 1))[:, 0], "b": jnp.zeros((dim,))}
+            for k in keys
+        ]
+    }
+
+
+def crossnet_v1_apply(p: Params, x0):
+    """Original DCN cross layer with VECTOR weights:
+    x_{l+1} = x0 * (x_l . w) + b + x_l  (modelzoo/dcn/train.py) —
+    rank-1 feature crossing, O(dim) params per layer vs v2's O(dim^2)."""
+    x = x0
+    for layer in p["layers"]:
+        x = x0 * (x @ layer["w"])[:, None] + layer["b"] + x
+    return x
+
+
 # ------------------------------------------------------------------- FM / dot
 
 

@@ -20,13 +20,13 @@ import sys
 
 ZOO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALL_MODELS = [
-    "wide_and_deep", "deepfm", "dlrm", "dcnv2", "masknet",
+    "wide_and_deep", "deepfm", "dlrm", "dcn", "dcnv2", "mlperf", "masknet",
     "din", "dien", "bst", "dssm",
     "esmm", "mmoe", "ple", "dbmtl", "simple_multitask",
 ]
 
 STEP_RE = re.compile(r"global_step/sec: ([0-9.]+)")
-AUC_RE = re.compile(r"Eval AUC: ([0-9.]+)")
+AUC_RE = re.compile(r"Eval AUC: ([0-9.]+) \((\w+)\)")
 
 
 def run_model(name: str, args) -> dict:
@@ -46,7 +46,15 @@ def run_model(name: str, args) -> dict:
     )
     log = proc.stdout + proc.stderr
     sps = [float(m) for m in STEP_RE.findall(log)]
-    aucs = [float(m) for m in AUC_RE.findall(log)]
+    # final per-task AUCs; the headline is the main/ctr task, NOT whichever
+    # task happened to print last (cvr/ctcvr are sparse-label tasks with
+    # structurally lower AUC — using them made MTL models look broken)
+    aucs = {}
+    for v, k in AUC_RE.findall(log):
+        aucs[k] = float(v)
+    headline = aucs.get("auc", aucs.get("auc_ctr"))
+    if headline is None and aucs:
+        headline = max(aucs.values())
     warm = sps[1:] if len(sps) > 1 else sps  # drop the compile window
     out = {
         "model": name,
@@ -55,7 +63,8 @@ def run_model(name: str, args) -> dict:
         "examples_per_sec": round(
             (sum(warm) / len(warm)) * args.batch_size, 1
         ) if warm else 0.0,
-        "auc": aucs[-1] if aucs else None,
+        "auc": headline,
+        "auc_tasks": aucs or None,
     }
     if not out["ok"]:
         out["log_tail"] = log[-800:]

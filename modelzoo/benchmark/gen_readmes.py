@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Generate per-model README.md files from the latest MODELZOO_SMOKE.json —
+the measured-numbers tables of the reference's modelzoo READMEs
+(modelzoo/wide_and_deep/README.md:195-215), kept honest by regenerating
+from the benchmark harness output instead of hand-editing.
+
+Usage: python modelzoo/benchmark/gen_readmes.py [--smoke MODELZOO_SMOKE.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+ZOO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PAPER = {
+    "wide_and_deep": ("WDL", "Wide & Deep Learning for Recommender Systems",
+                      "https://arxiv.org/abs/1606.07792"),
+    "deepfm": ("DeepFM", "DeepFM: A Factorization-Machine based Neural Network",
+               "https://arxiv.org/abs/1703.04247"),
+    "dlrm": ("DLRM", "Deep Learning Recommendation Model",
+             "https://arxiv.org/abs/1906.00091"),
+    "dcn": ("DCN", "Deep & Cross Network for Ad Click Predictions",
+            "https://arxiv.org/abs/1708.05123"),
+    "dcnv2": ("DCNv2", "DCN V2: Improved Deep & Cross Network",
+              "https://arxiv.org/abs/2008.13535"),
+    "mlperf": ("DLRM_DCN", "MLPerf 2022 DLRM with DCNv2 interactions",
+               "https://arxiv.org/abs/2008.13535"),
+    "masknet": ("MaskNet", "MaskNet: CTR Ranking with Instance-Guided Mask",
+                "https://arxiv.org/abs/2102.07619"),
+    "din": ("DIN", "Deep Interest Network for CTR Prediction",
+            "https://arxiv.org/abs/1706.06978"),
+    "dien": ("DIEN", "Deep Interest Evolution Network",
+             "https://arxiv.org/abs/1809.03672"),
+    "bst": ("BST", "Behavior Sequence Transformer",
+            "https://arxiv.org/abs/1905.06874"),
+    "dssm": ("DSSM", "Learning Deep Structured Semantic Models",
+             "https://dl.acm.org/doi/10.1145/2505515.2505665"),
+    "esmm": ("ESMM", "Entire Space Multi-Task Model",
+             "https://arxiv.org/abs/1804.07931"),
+    "mmoe": ("MMoE", "Multi-gate Mixture-of-Experts",
+             "https://dl.acm.org/doi/10.1145/3219819.3220007"),
+    "ple": ("PLE", "Progressive Layered Extraction",
+            "https://dl.acm.org/doi/10.1145/3383313.3412236"),
+    "dbmtl": ("DBMTL", "Deep Bayesian Multi-Target Learning",
+              "https://arxiv.org/abs/1902.09154"),
+    "simple_multitask": ("SimpleMultiTask", "Shared-bottom multi-task baseline",
+                         "https://arxiv.org/abs/1706.05098"),
+}
+
+TEMPLATE = """# {title}
+
+[{paper}]({url})
+
+TPU-native implementation (`deeprec_tpu.models`); reference implementation:
+DeepRec `modelzoo/{name}/train.py`.
+
+## Usage
+
+Stand-alone training (synthetic data by default; pass a Criteo TSV or
+parquet glob via `--data` for the real dataset):
+
+```bash
+python train.py [--steps 2000] [--batch_size 2048] [--data 'day_*.tsv']
+```
+
+Mesh-sharded training over all local devices (tables hash-sharded,
+batch split; `--comm a2a` selects the budgeted all2all exchange):
+
+```bash
+python train.py --sharded [--comm a2a]
+```
+
+Feature flags shared by every model (see `../common.py`): `--optimizer
+{{sgd,adagrad,adagrad_decay,adam,adam_async,adamw,ftrl}}`, admission
+filtering `--filter_freq`, TTL eviction `--steps_to_live`, checkpoints
+`--checkpoint DIR --save_steps N --incremental_save_steps M`.
+
+## Benchmark
+
+Measured by `modelzoo/benchmark/benchmark.py` (single device, synthetic
+workload, batch {batch}, {steps} steps — the smoke protocol; TPU numbers
+land in BENCH_r*.json via the top-level bench.py):
+
+| Model | Device | Throughput (examples/sec) | global_step/sec | AUC |
+|---|---|---|---|---|
+| {title} | {device} | {eps:,.0f} | {sps:.2f} | {auc} |
+{task_rows}
+Regenerate after changes: `python ../benchmark/gen_readmes.py`.
+"""
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke",
+                   default=os.path.join(ZOO, "..", "MODELZOO_SMOKE.json"))
+    p.add_argument("--device", default="CPU (virtual mesh host)")
+    args = p.parse_args(argv)
+
+    with open(args.smoke) as f:
+        report = json.load(f)
+    by_model = {r["model"]: r for r in report["results"]}
+    for name, (title, paper, url) in PAPER.items():
+        r = by_model.get(name)
+        if r is None or not r.get("ok"):
+            continue
+        tasks = r.get("auc_tasks") or {}
+        task_rows = ""
+        if len(tasks) > 1:
+            task_rows = "\nPer-task AUC: " + ", ".join(
+                f"`{k}`={v:.4f}" for k, v in sorted(tasks.items())
+            ) + "\n"
+        out = TEMPLATE.format(
+            title=title, paper=paper, url=url, name=name,
+            batch=report["batch_size"], steps=report["steps"],
+            device=args.device, eps=r["examples_per_sec"],
+            sps=r["global_step_per_sec"],
+            auc=f"{r['auc']:.4f}" if r.get("auc") else "n/a",
+            task_rows=task_rows,
+        )
+        path = os.path.join(ZOO, name, "README.md")
+        with open(path, "w") as f:
+            f.write(out)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,4 +12,5 @@ def model_fn(args):
 
 
 if __name__ == "__main__":
-    main("bst", model_fn, "behavior")
+    main("bst", model_fn, "behavior",
+         defaults={"vocab": 100_000, "learning_rate": 0.2})

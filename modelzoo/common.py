@@ -221,7 +221,14 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
     return ev
 
 
-def main(name: str, model_fn: Callable, data_kind: str, argv=None):
-    args = build_argparser(name).parse_args(argv)
+def main(name: str, model_fn: Callable, data_kind: str, argv=None,
+         defaults: Optional[Dict] = None):
+    """defaults: per-model argparse default overrides (the reference's
+    per-model train.py files hard-code model-appropriate vocab/lr the same
+    way)."""
+    p = build_argparser(name)
+    if defaults:
+        p.set_defaults(**defaults)
+    args = p.parse_args(argv)
     model = model_fn(args)
     return run(model, args, data_kind)

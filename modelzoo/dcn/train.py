@@ -1,0 +1,15 @@
+"""DCN (v1, vector-weight cross net) on Criteo (reference: modelzoo/dcn)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from common import ev_option, main
+
+
+def model_fn(args):
+    from deeprec_tpu.models import DCN
+
+    return DCN(emb_dim=args.emb_dim, capacity=args.capacity, ev=ev_option(args))
+
+
+if __name__ == "__main__":
+    main("dcn", model_fn, "criteo")

@@ -1,0 +1,16 @@
+"""DLRM_DCN, the MLPerf 2022 config (reference: modelzoo/mlperf)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from common import ev_option, main
+
+
+def model_fn(args):
+    from deeprec_tpu.models import DLRMDCN
+
+    return DLRMDCN(emb_dim=args.emb_dim, capacity=args.capacity,
+                   bottom=(512, 256, args.emb_dim), ev=ev_option(args))
+
+
+if __name__ == "__main__":
+    main("mlperf", model_fn, "criteo")

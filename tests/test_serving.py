@@ -152,9 +152,13 @@ def test_http_server_end_to_end(tmp_path):
         out2 = call("/v1/predict", {"features": feats})["predictions"]
         assert np.abs(np.asarray(out2) - np.asarray(out1)).max() > 1e-6
 
-        # malformed request -> 400, server stays alive
+        # inconsistent row counts -> 400 BEFORE batching (would otherwise
+        # poison coalesced neighbors)
+        ragged = {k: (v if i else v[:1]) for i, (k, v) in
+                  enumerate(sorted(feats.items()))}
         req = urllib.request.Request(
-            base + "/v1/predict", data=b"{}",
+            base + "/v1/predict",
+            data=json.dumps({"features": ragged}).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )
         try:
@@ -162,7 +166,71 @@ def test_http_server_end_to_end(tmp_path):
             assert False, "expected HTTP 400"
         except urllib.error.HTTPError as e:
             assert e.code == 400
+            assert "row counts" in json.loads(e.read())["error"]
+
+        # malformed requests -> 400 with a JSON error, server stays alive:
+        # empty body, non-dict body, and a typo'd feature name (validated
+        # BEFORE batching so it can't poison coalesced neighbors)
+        bad_feats = dict(feats)
+        bad_feats["C_TYPO"] = bad_feats.pop(sorted(feats)[0])
+        for body in (b"{}", b"[1,2]",
+                     json.dumps({"features": bad_feats}).encode()):
+            req = urllib.request.Request(
+                base + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected HTTP 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                err = json.loads(e.read())
+                assert "error" in err
         assert call("/healthz") == "ok"
+    finally:
+        http.stop()
+        server.close()
+
+
+def test_http_serves_ragged_histories_one_shape(tmp_path):
+    """Sequence models over HTTP: ragged JSON history lists pad/trim to the
+    feature's declared max_len with its pad_value — one compiled shape per
+    feature, and short histories predict fine."""
+    from deeprec_tpu.data import SyntheticBehaviorSequence
+    from deeprec_tpu.models import DIN
+    from deeprec_tpu.serving import HttpServer
+    import json
+    import urllib.request
+
+    model = DIN(emb_dim=4, capacity=1 << 10, hidden=(8,))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticBehaviorSequence(batch_size=64, vocab=500, seq_len=6,
+                                    seed=0)
+    for _ in range(2):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=16,
+                         max_wait_ms=2)
+    http = HttpServer(server, port=0).start()
+    try:
+        feats = {
+            "user": [1, 2],
+            "target_item": [3, 4],
+            "target_cat": [5, 6],
+            "hist_items": [[7, 8, 9], [10]],  # ragged
+            "hist_cats": [[1, 2, 3], [4]],
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/predict",
+            data=json.dumps({"features": feats}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())["predictions"]
+        assert len(out) == 2 and all(0.0 <= p <= 1.0 for p in out)
     finally:
         http.stop()
         server.close()
