@@ -186,6 +186,8 @@ class CheckpointManager:
         return hasattr(self.trainer, "num_shards")
 
     def _export_bundle(self, state, bname, only_dirty) -> Dict[str, Dict[str, np.ndarray]]:
+        from deeprec_tpu.embedding.table import empty_key
+
         b = self.trainer.bundles[bname]
         exports = {}
         for tag, np_state in self._bundle_states(state, bname):
@@ -215,6 +217,13 @@ class CheckpointManager:
                 exports[tag] = merged
             else:
                 exports[tag] = export_table_arrays(b.table, np_state, only_dirty)
+            if only_dirty:
+                # Deltas carry the FULL live-key set (keys only, compact):
+                # restore prunes resurrected keys that were evicted between
+                # saves — dirty rows alone cannot express an eviction.
+                keys = np_state["keys"]
+                occ = keys != empty_key(b.table.cfg)
+                exports[tag]["live_keys"] = keys[occ]
         return exports
 
     def _clear_dirty(self, state: TrainState) -> TrainState:
@@ -327,7 +336,12 @@ class CheckpointManager:
                 if os.path.exists(fpath):
                     rows = dict(np.load(fpath))
                     rows.pop("partition_offset", None)
+                    live = rows.pop("live_keys", None)
                     sub = self._import_local(b.table, sub, rows)
+                    if live is not None:
+                        # delta semantics: anything absent from the delta's
+                        # live set was evicted since the previous save
+                        sub = self._prune_to_live(b, sub, live)
                 new_members.append(sub)
             if b.stacked:
                 ts = jax.tree.map(lambda *xs: jnp.stack(xs), *new_members)
@@ -343,6 +357,22 @@ class CheckpointManager:
             )
         return TrainState(step=state.step, tables=tables, dense=dense,
                           opt_state=opt_state)
+
+    def _prune_to_live(self, b, sub: TableState, live: np.ndarray) -> TableState:
+        """Drop keys not in the delta's live set (evicted between saves) —
+        rebuild-based, so probe chains heal and freed optimizer slot rows
+        restart at the optimizer's init value."""
+        fills = self.trainer._slot_fills(b)
+        keys = np.asarray(sub.keys)
+        if keys.ndim == 2:  # sharded: [N, C_local]
+            keep = np.stack([np.isin(k, live) for k in keys])
+            fn = jax.vmap(
+                lambda s, kp: b.table.rebuild(s, keep=kp, slot_fills=fills)
+            )
+            return fn(sub, jnp.asarray(keep))
+        return b.table.rebuild(
+            sub, keep=jnp.asarray(np.isin(keys, live)), slot_fills=fills
+        )
 
     def _import_local(self, table, sub: TableState, rows) -> TableState:
         """Import rows into a local (possibly shard-stacked) table state."""
