@@ -128,27 +128,14 @@ class Predictor:
     def _lookup_views(self, state, batch):
         """Readonly lookup pass: feature -> (unique embs, inverse, mask)
         plus per-bundle results (slot_ix/uids for the store fallback)."""
-        tables = dict(state.tables)
-        _, views, bundle_res = self._trainer._lookup_all(
-            tables, batch, state.step, False
-        )
-        return views, bundle_res
-
-    def _forward_from_views(self, state, views, batch):
-        tr = self._trainer
-        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
-        inputs = tr._build_inputs(embs, views, batch)
-        out = self.model.apply(state.dense, inputs, train=False)
-        if isinstance(out, dict):
-            return {k: jax.nn.sigmoid(v) for k, v in out.items()}
-        return jax.nn.sigmoid(out)
+        return self._trainer.forward_views(state, batch)
 
     def _predict_impl(self, state, batch):
         views, _ = self._lookup_views(state, batch)
-        return self._forward_from_views(state, views, batch)
+        return self._trainer.probs_from_views(state, views, batch)[1]
 
     def _forward_impl(self, state, views, batch):
-        return self._forward_from_views(state, views, batch)
+        return self._trainer.probs_from_views(state, views, batch)[1]
 
     def _predict_with_stores(self, state, batch):
         """Read-through path: jitted lookup, host-side store correction of
@@ -209,10 +196,11 @@ class Predictor:
 
     def model_info(self) -> Dict:
         """get_serving_model_info parity."""
-        sizes = {}
+        state = self._state  # one snapshot: no torn step/sizes mix under
+        sizes = {}  # a concurrent hot-swap
         for name, t in self._trainer.tables.items():
-            sizes[name] = int(t.size(self._trainer.table_state(self._state, name)))
-        return {"step": self.step, "table_sizes": sizes}
+            sizes[name] = int(t.size(self._trainer.table_state(state, name)))
+        return {"step": int(state.step), "table_sizes": sizes}
 
 
 class ModelServer:

@@ -350,17 +350,31 @@ class Trainer:
             step=step + 1, tables=tables, dense=dense, opt_state=opt_state
         ), jax.tree.map(jnp.mean, mets)
 
-    def _eval_impl(self, state: TrainState, batch):
+    def forward_views(self, state: TrainState, batch):
+        """Readonly lookup pass (no inserts/counters): per-feature views
+        plus per-bundle results. Shared by eval and the serving predictor."""
         tables = dict(state.tables)
-        tables, views, _ = self._lookup_all(tables, batch, state.step, False)
+        _, views, bundle_res = self._lookup_all(
+            tables, batch, state.step, False
+        )
+        return views, bundle_res
+
+    def probs_from_views(self, state: TrainState, views, batch):
+        """Label-free forward: views -> sigmoid probabilities (dict per
+        task for multi-task models). Returns (logits, probs)."""
         embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
         inputs = self._build_inputs(embs, views, batch)
         out = self.model.apply(state.dense, inputs, train=False)
-        loss, out = self._loss_from_logits(out, batch)
         if isinstance(out, dict):
             probs = {k: jax.nn.sigmoid(v) for k, v in out.items()}
         else:
             probs = jax.nn.sigmoid(out)
+        return out, probs
+
+    def _eval_impl(self, state: TrainState, batch):
+        views, _ = self.forward_views(state, batch)
+        out, probs = self.probs_from_views(state, views, batch)
+        loss, _ = self._loss_from_logits(out, batch)
         return loss, probs
 
     # --------------------------------------------------------------- public
