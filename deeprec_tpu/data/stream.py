@@ -1,24 +1,63 @@
-"""Streaming input: follow an append-only log with resumable offsets.
+"""Streaming input: record streams with resumable consumer offsets.
 
 The Kafka-analog (reference core/kernels/data/kafka_dataset_op.cc): DeepRec
 consumes record streams with consumer offsets so training resumes where it
-stopped. On a TPU pod the pragmatic stand-in is an append-only file (or a
-directory of them) fed by a log shipper; this reader tails it, parses
-complete newline-terminated lines into batches, and exposes offset
-save/restore with Kafka-offset semantics: the offset only advances past rows
-that have been YIELDED, so a checkpoint/crash/restore cycle is exactly-once
-with respect to delivered batches.
+stopped. Two transports, one offset contract:
 
-Records must be '\n'-terminated; an incomplete trailing line is left
-unconsumed until its newline arrives (or ignored at stop_at_eof).
+  * `FileTailReader` — tail an append-only file on a shared FS (the common
+    TPU-pod deployment: a log shipper lands records on GCS/NFS).
+  * `TCPStreamReader` — consume a newline-framed TCP stream from a broker
+    (`FileStreamServer` is the bundled broker: it serves a file from any
+    requested offset and follows appends, so crash/resume is testable with
+    real sockets).
+
+Offset semantics (both): the offset only advances past rows that have been
+YIELDED, so a checkpoint/crash/restore cycle is exactly-once with respect
+to delivered batches. Records must be '\n'-terminated; an incomplete
+trailing line is left unconsumed until its newline arrives.
 """
 from __future__ import annotations
 
 import os
+import socket
+import socketserver
+import threading
 import time
 from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
+
+
+def criteo_line_parser(num_dense: int = 13, num_cat: int = 26) -> Callable:
+    """Default record parser shared by the stream readers: Criteo TSV lines
+    -> batch dict, with the same id hashing as data/readers.py."""
+
+    def parse(lines):
+        from deeprec_tpu.data.readers import _hash_strings
+
+        n = len(lines)
+        labels = np.zeros(n, np.float32)
+        dense = np.zeros((n, num_dense), np.float32)
+        cat_cols = [np.empty(n, object) for _ in range(num_cat)]
+        for r, line in enumerate(lines):
+            parts = line.split("\t")
+            labels[r] = float(parts[0] or 0)
+            for i in range(num_dense):
+                v = parts[1 + i] if len(parts) > 1 + i else ""
+                dense[r, i] = float(v) if v else 0.0
+            for i in range(num_cat):
+                j = 1 + num_dense + i
+                cat_cols[i][r] = parts[j] if len(parts) > j else ""
+        out: Dict[str, np.ndarray] = {"label": labels}
+        for i in range(num_dense):
+            out[f"I{i+1}"] = dense[:, i : i + 1]
+        for i in range(num_cat):
+            out[f"C{i+1}"] = _hash_strings(
+                cat_cols[i], salt=(i + 1) * 0x9E3779B9 & 0x7FFFFFFF
+            )
+        return out
+
+    return parse
 
 
 class FileTailReader:
@@ -40,7 +79,7 @@ class FileTailReader:
     ):
         self.path = path
         self.B = batch_size
-        self.parser = parser or self._default_parser
+        self.parser = parser or criteo_line_parser(num_dense, num_cat)
         self.poll_secs = poll_secs
         self.stop_at_eof = stop_at_eof
         self.num_dense = num_dense
@@ -61,34 +100,6 @@ class FileTailReader:
                 "(pass allow_path_mismatch=True to force)"
             )
         self.offset = int(state["offset"])
-
-    # -------------------------------------------------------------- parser
-
-    def _default_parser(self, lines):
-        from deeprec_tpu.data.readers import _hash_strings
-
-        n = len(lines)
-        labels = np.zeros(n, np.float32)
-        dense = np.zeros((n, self.num_dense), np.float32)
-        cat_cols = [np.empty(n, object) for _ in range(self.num_cat)]
-        for r, line in enumerate(lines):
-            parts = line.split("\t")
-            labels[r] = float(parts[0] or 0)
-            for i in range(self.num_dense):
-                v = parts[1 + i] if len(parts) > 1 + i else ""
-                dense[r, i] = float(v) if v else 0.0
-            for i in range(self.num_cat):
-                j = 1 + self.num_dense + i
-                cat_cols[i][r] = parts[j] if len(parts) > j else ""
-        out: Dict[str, np.ndarray] = {"label": labels}
-        for i in range(self.num_dense):
-            out[f"I{i+1}"] = dense[:, i : i + 1]
-        for i in range(self.num_cat):
-            # same hash as the batch readers: ids stay interchangeable
-            out[f"C{i+1}"] = _hash_strings(
-                cat_cols[i], salt=(i + 1) * 0x9E3779B9 & 0x7FFFFFFF
-            )
-        return out
 
     # ------------------------------------------------------------- iterate
 
@@ -141,3 +152,163 @@ class FileTailReader:
                 return
             if not made_progress:
                 time.sleep(self.poll_secs)  # no busy loop on partial lines
+
+# --------------------------------------------------------------- TCP stream
+
+
+class TCPStreamReader:
+    """Consume a newline-framed record stream over TCP with offset resume.
+
+    Protocol (see FileStreamServer): on connect the consumer sends one
+    header line ``OFFSET <n>\\n``; the broker replies with the stream from
+    byte offset n onward and keeps the connection open for appended
+    records. Offsets advance only past YIELDED rows (the FileTailReader
+    contract), so `save()`/`restore()` give exactly-once delivery across
+    reconnects and process restarts — the consumer-group-offset semantics
+    of the reference's KafkaDataset (kafka_dataset_op.cc), over a socket
+    this environment can actually open.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        batch_size: int = 2048,
+        parser: Optional[Callable] = None,
+        stop_at_eof: bool = False,
+        reconnect_secs: float = 1.0,
+        num_dense: int = 13,
+        num_cat: int = 26,
+    ):
+        self.host = host
+        self.port = port
+        self.B = batch_size
+        self.parser = parser or criteo_line_parser(num_dense, num_cat)
+        self.stop_at_eof = stop_at_eof
+        self.reconnect_secs = reconnect_secs
+        self.offset = 0
+
+    def save(self) -> dict:
+        return {"host": self.host, "port": self.port, "offset": self.offset}
+
+    def restore(self, state: dict) -> None:
+        self.offset = int(state["offset"])
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=30)
+        s.settimeout(None)  # the 30s budget is for CONNECT only: a quiet
+        s.sendall(f"OFFSET {self.offset}\n".encode())  # follow-mode broker
+        return s  # must not look like an EOF after a lull
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        buf = b""
+        rows: list = []
+        sock = None
+        try:
+            while True:
+                if sock is None:
+                    try:
+                        sock = self._connect()
+                    except OSError:
+                        if self.stop_at_eof:
+                            # a bounded consume expects the broker to be
+                            # there: an empty iterator would masquerade as
+                            # an empty stream
+                            raise
+                        time.sleep(self.reconnect_secs)
+                        continue
+                try:
+                    data = sock.recv(1 << 20)
+                except OSError:
+                    data = b""
+                if not data:  # broker closed: flush or reconnect
+                    sock.close()
+                    sock = None
+                    if self.stop_at_eof:
+                        break  # keep rows: the final drain yields them
+                    # Drop un-yielded partials: the reconnect replays from
+                    # self.offset, which covers exactly the yielded rows —
+                    # keeping buf/rows would deliver them twice and splice
+                    # a corrupt record out of the old partial line.
+                    buf = b""
+                    rows = []
+                    time.sleep(self.reconnect_secs)
+                    continue
+                buf += data
+                nl = buf.rfind(b"\n")
+                if nl >= 0:
+                    rows.extend(buf[: nl + 1].split(b"\n")[:-1])
+                    buf = buf[nl + 1:]
+                while len(rows) >= self.B:
+                    batch_rows, rows = rows[: self.B], rows[self.B:]
+                    self.offset += sum(len(r) + 1 for r in batch_rows)
+                    yield self.parser(
+                        [r.decode(errors="replace") for r in batch_rows]
+                    )
+            # drain the final partial batch at EOF
+            if rows:
+                self.offset += sum(len(r) + 1 for r in rows)
+                yield self.parser([r.decode(errors="replace") for r in rows])
+        finally:
+            if sock is not None:
+                sock.close()
+
+
+class FileStreamServer:
+    """Minimal broker: serve a file's records over TCP from any offset.
+
+    Speaks the TCPStreamReader protocol. `follow=True` keeps connections
+    open and streams appended bytes (the log-broker behavior);
+    `follow=False` closes after the current contents (bounded replay).
+    Test/demo-grade by design — production pods read through a real broker
+    or the shared-FS FileTailReader.
+    """
+
+    def __init__(self, path: str, host: str = "127.0.0.1", port: int = 0,
+                 follow: bool = False, poll_secs: float = 0.05):
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                header = self.rfile.readline().decode().split()
+                offset = int(header[1]) if header[:1] == ["OFFSET"] else 0
+                try:
+                    with open(outer.path, "rb") as f:
+                        f.seek(offset)
+                        while not outer._stop.is_set():
+                            chunk = f.read(1 << 20)
+                            if chunk:
+                                self.wfile.write(chunk)
+                                self.wfile.flush()
+                            elif outer.follow:
+                                time.sleep(outer.poll_secs)
+                            else:
+                                return
+                except (BrokenPipeError, ConnectionResetError):
+                    return  # consumer went away; it will resume by offset
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.path = path
+        self.follow = follow
+        self.poll_secs = poll_secs
+        self._stop = threading.Event()
+        self._srv = Server((host, port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FileStreamServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
