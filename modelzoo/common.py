@@ -52,7 +52,12 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
     p.add_argument("--evict_every", type=int, default=0,
                    help="run eviction policies every N steps (0 = only with "
                         "checkpoints)")
-    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--bf16", action="store_true", default=False,
+                   help="bfloat16 embedding tables (halves table HBM; "
+                        "updates use stochastic rounding). Dense compute "
+                        "is bf16-on-MXU regardless (nn.py).")
+    p.add_argument("--kernel", default="auto", choices=["auto", "xla", "pallas"],
+                   help="embedding hot-path kernel (TableConfig.kernel)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeline", type=int, default=0,
                    help="trace steps [N, N+10) to --timeline_dir")
@@ -117,6 +122,24 @@ def make_data(args, kind: str):
     return D.staged(iter(gen))
 
 
+def _retable(model, **cfg_overrides):
+    """Rewrite every sparse feature's TableConfig (bf16 values, kernel
+    choice) — one hook instead of plumbing flags through every model."""
+    import dataclasses
+
+    from deeprec_tpu.features import SparseFeature
+
+    model.features = [
+        dataclasses.replace(
+            f, table=dataclasses.replace(f.table, **cfg_overrides)
+        )
+        if isinstance(f, SparseFeature) and f.table is not None
+        else f
+        for f in model.features
+    ]
+    return model
+
+
 def run(model, args, data_kind: str) -> Dict[str, float]:
     """The MonitoredTrainingSession loop: train, log steps/sec, eval AUC,
     checkpoint (full + incremental)."""
@@ -125,6 +148,14 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
 
     from deeprec_tpu.training import Trainer
     from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    overrides = {}
+    if args.bf16:
+        overrides["value_dtype"] = "bfloat16"
+    if args.kernel != "auto":
+        overrides["kernel"] = args.kernel
+    if overrides:
+        model = _retable(model, **overrides)
 
     sparse_opt, dense_opt = make_optimizers(args)
     if args.sharded:

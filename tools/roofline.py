@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Roofline analysis of the DLRM training step: measured throughput vs the
+hardware's memory-bandwidth and compute ceilings.
+
+Methodology (docs/perf.md): count the step's algorithmic HBM traffic and
+MXU FLOPs from the model config, run the step, and report how much of each
+ceiling the measured examples/sec implies. The larger of the two fractions
+identifies the binding roof; tuning stops being worth it as it approaches
+1.0. Run on the target TPU:
+
+    python tools/roofline.py [--batch 2048] [--emb_dim 16]
+        [--peak_bw_gbs 1228] [--peak_tflops 275]   # v4 defaults
+
+CPU runs exercise the accounting but say nothing about TPU roofs.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def mlp_flops(dims, batch):
+    """2*in*out MACs->FLOPs per layer, forward only."""
+    total = 0
+    for a, b in zip(dims[:-1], dims[1:]):
+        total += 2 * a * b * batch
+    return total
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--emb_dim", type=int, default=16)
+    p.add_argument("--capacity", type=int, default=1 << 20)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--peak_bw_gbs", type=float, default=1228.0,
+                   help="HBM bandwidth ceiling (GB/s); v4 default")
+    p.add_argument("--peak_tflops", type=float, default=275.0,
+                   help="bf16 MXU ceiling (TFLOP/s); v4 default")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import DLRM
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    B, D = args.batch, args.emb_dim
+    model = DLRM(emb_dim=D, capacity=args.capacity,
+                 bottom=(512, 256, 64, D) if D <= 64 else (512, 256, D))
+    trainer = Trainer(model, Adagrad(lr=0.05))
+    state = trainer.init(0)
+    gen = SyntheticCriteo(batch_size=B, vocab=1_000_000, seed=0)
+    batches = [
+        {k: jnp.asarray(v) for k, v in gen.batch().items()} for _ in range(8)
+    ]
+    for i in range(3):
+        state, mets = trainer.train_step(state, batches[i % 8])
+    jax.block_until_ready(mets["loss"])
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, mets = trainer.train_step(state, batches[i % 8])
+    jax.block_until_ready(mets["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    eps = B / dt
+
+    # ---- algorithmic cost accounting (per step) ----
+    F = model.num_cat
+    vbytes = jnp.dtype(model.features[0].table.value_dtype).itemsize
+    U = B  # worst case: all ids unique (synthetic zipf dedups below this)
+    # embedding engine HBM traffic: probe key gathers + value row
+    # gather + value scatter + Adagrad slot row gather + scatter
+    emb_bytes = F * U * (
+        2 * 4            # probe: key gather + claim scatter (4B keys)
+        + 2 * D * vbytes   # value row read + write
+        + 2 * D * 4        # accumulator row read + write (f32)
+        + 4 * 4            # freq/version/dirty touches
+    )
+    dense_in = model.num_dense
+    fwd = mlp_flops([dense_in] + list(model.bottom), B)
+    inter_f = (F + 1) * (F + 1) * D  # dot-interaction matmul per example
+    fwd += 2 * inter_f * B
+    inter_dim = (F + 1) * F // 2
+    fwd += mlp_flops([model.bottom[-1] + inter_dim] + list(model.top), B)
+    flops = 3 * fwd  # fwd + ~2x for bwd
+
+    bw_used = emb_bytes / dt / 1e9
+    tf_used = flops / dt / 1e12
+    frac_bw = bw_used / args.peak_bw_gbs
+    frac_tf = tf_used / args.peak_tflops
+    roof = "HBM-bandwidth" if frac_bw >= frac_tf else "MXU-compute"
+    print(f"backend           : {jax.default_backend()}")
+    print(f"examples/sec      : {eps:,.0f}   ({dt * 1e3:.2f} ms/step, batch {B})")
+    print(f"embedding traffic : {emb_bytes / 1e6:.1f} MB/step -> {bw_used:,.1f} GB/s "
+          f"({frac_bw:.1%} of {args.peak_bw_gbs:.0f} GB/s roof)")
+    print(f"dense compute     : {flops / 1e9:.2f} GFLOP/step -> {tf_used:.2f} TFLOP/s "
+          f"({frac_tf:.1%} of {args.peak_tflops:.0f} TFLOP/s roof)")
+    print(f"binding roof      : {roof}")
+    print(f"headroom          : {1 / max(frac_bw, frac_tf):,.1f}x before the roof "
+          f"(upper bound {eps / max(frac_bw, frac_tf):,.0f} ex/s)")
+
+
+if __name__ == "__main__":
+    main()
