@@ -1,2 +1,3 @@
 from deeprec_tpu.serving.predictor import ModelServer, Predictor
 from deeprec_tpu.serving.http_server import HttpServer
+from deeprec_tpu.serving.remote_store import RemoteKVClient, RemoteKVServer

@@ -192,6 +192,46 @@ def test_http_server_end_to_end(tmp_path):
         server.close()
 
 
+def test_remote_feature_store_over_tcp(tmp_path):
+    """Predictor read-through against a REMOTE store (redis_feature_store
+    parity): rows served over the network change predictions exactly like
+    an in-process HostKV store."""
+    from deeprec_tpu.native import HostKV
+    from deeprec_tpu.serving import RemoteKVClient, RemoteKVServer
+
+    model, tr, st, ck, batches, gen = make_trained(tmp_path)
+    tname = sorted(tr.tables)[0]
+    dim = tr.tables[tname].cfg.dim
+    kv = HostKV(dim=dim, initial_capacity=64)
+    srv = RemoteKVServer(kv, dim=dim).start()
+    try:
+        client = RemoteKVClient("127.0.0.1", srv.port, dim=dim)
+        novel = 424242
+        client.put(np.asarray([novel], np.int64),
+                   np.full((1, dim), 1.75, np.float32))
+        # round-trip sanity straight through the wire
+        vals, _, _, found = client.get(np.asarray([novel, 77], np.int64))
+        assert found.tolist() == [True, False]
+        np.testing.assert_allclose(vals[0], 1.75)
+
+        p_remote = Predictor(model, str(tmp_path), stores={tname: client})
+        p_plain = Predictor(model, str(tmp_path))
+        req = strip_labels(batches[0])
+        req_novel = dict(req)
+        req_novel[tname] = np.full_like(req[tname], novel)
+        out_r = p_remote.predict(req_novel)
+        out_p = p_plain.predict(req_novel)
+        assert np.abs(np.asarray(out_r) - np.asarray(out_p)).max() > 1e-6
+        # known keys unaffected
+        np.testing.assert_allclose(
+            np.asarray(p_remote.predict(req)),
+            np.asarray(p_plain.predict(req)), atol=1e-6,
+        )
+        client.close()
+    finally:
+        srv.stop()
+
+
 def test_http_serves_ragged_histories_one_shape(tmp_path):
     """Sequence models over HTTP: ragged JSON history lists pad/trim to the
     feature's declared max_len with its pad_value — one compiled shape per
