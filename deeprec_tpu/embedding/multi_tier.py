@@ -70,7 +70,13 @@ class MultiTierTable:
     # ------------------------------------------------------------------ sync
 
     def sync(self, state: TableState, step: int,
-             slot_fills: Optional[tuple] = None) -> tuple[TableState, TierStats]:
+             slot_fills: Optional[tuple] = None,
+             force: bool = False) -> tuple[TableState, TierStats]:
+        """force=True demotes down to the low watermark even below the high
+        watermark (capacity-pressure override: probes can exhaust from key
+        clustering before occupancy reaches `high`), and always rebuilds —
+        healing probe chains and resetting insert_fails — when there was
+        nothing to demote."""
         stats = TierStats()
         keys = np.asarray(state.keys)
         occ = keys != empty_key(self.table.cfg)
@@ -106,7 +112,8 @@ class MultiTierTable:
         # -------- demote: bring occupancy under the low watermark
         C = state.capacity
         live = int(occ.sum())
-        if live > int(self.high * C):
+        threshold = int((self.low if force else self.high) * C)
+        if live > threshold:
             n_out = live - int(self.low * C)
             occ_ix = np.nonzero(occ)[0]
             if self.cache_strategy == "lru":
@@ -128,6 +135,15 @@ class MultiTierTable:
                 slot_fills=tuple(slot_fills) if slot_fills else self.slot_fills,
             )
             stats.demoted = int(n_out)
+        elif force:
+            # Nothing to demote but the caller saw capacity pressure
+            # (insert_fails from probe clustering): rebuild in place —
+            # compacts probe chains and resets the fail counter so the
+            # pressure signal reflects the healed table.
+            state = self.table.rebuild(
+                state,
+                slot_fills=tuple(slot_fills) if slot_fills else self.slot_fills,
+            )
 
         stats.host_size = len(self.host)
         stats.device_size = int(self.table.size(state))

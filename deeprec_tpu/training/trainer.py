@@ -451,12 +451,19 @@ class Trainer:
             )
         return self._tiers[key]
 
+    @staticmethod
+    def _state_bytes(ts) -> int:
+        return sum(
+            a.size * a.dtype.itemsize for a in jax.tree.leaves(ts)
+        )
+
     def maintain(
         self,
         state: TrainState,
         *,
         grow_threshold: float = 0.85,
         max_capacity: Optional[int] = None,
+        hbm_budget_bytes: Optional[int] = None,
         step: Optional[int] = None,
     ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
         """Close the capacity loop DeepRec's tables close implicitly
@@ -471,10 +478,21 @@ class Trainer:
         TABLE as this trainer shards it (for ShardedTrainer: the global cap;
         it is divided by the shard count internally); non-power-of-two caps
         round down.
+
+        hbm_budget_bytes bounds the TOTAL device bytes of all table state:
+        when a needed growth would exceed it, the bundle is auto-tiered —
+        cold rows demote to the host store instead of the table growing.
+        This is the automated device-placement decision (the reference
+        places oversized EVs on CPU by hand; DeepRec multi_tier_storage.h).
         """
         import numpy as np
 
         step = int(state.step) if step is None else int(step)
+        total_bytes = (
+            sum(self._state_bytes(ts) for ts in state.tables.values())
+            if hbm_budget_bytes
+            else 0
+        )
         if max_capacity:
             # largest power of two <= cap (capacities must be powers of two)
             max_capacity = 1 << (int(max_capacity).bit_length() - 1)
@@ -498,14 +516,9 @@ class Trainer:
                 b.table.cfg.ev.storage.storage_type.value == "hbm_dram"
             )
             if multi_tier:
-                demoted = promoted = 0
-                members = list(members)
-                for k, (i, m) in enumerate(zip(idxs, members)):
-                    mt = self._multi_tier_for(b, i)
-                    m, stats = mt.sync(m, step)
-                    members[k] = m
-                    demoted += stats.demoted
-                    promoted += stats.promoted
+                members, demoted, promoted = self._tier_sync(
+                    b, idxs, members, step
+                )
                 rep.update(demoted=demoted, promoted=promoted)
                 ts = self._restack(members, lead)
             elif fails > 0 or occ > grow_threshold:
@@ -517,7 +530,25 @@ class Trainer:
                     new_c *= 2
                 if max_capacity:
                     new_c = min(new_c, max_capacity)
-                if new_c > C:
+                bundle_bytes = self._state_bytes(ts)
+                growth_bytes = bundle_bytes * (new_c // C - 1)
+                if (
+                    hbm_budget_bytes
+                    and total_bytes + growth_bytes > hbm_budget_bytes
+                ):
+                    # Budget exceeded: auto-place on the host tier instead
+                    # of growing — demote cold rows, keep capacity fixed.
+                    # force=True: pressure may come from probe clustering
+                    # below the high watermark; the tier must still act
+                    # (demote to the low mark, or at least rebuild to heal
+                    # chains and reset insert_fails).
+                    members, demoted, promoted = self._tier_sync(
+                        b, idxs, members, step, force=True
+                    )
+                    rep.update(auto_tiered=True, demoted=demoted,
+                               promoted=promoted)
+                    ts = self._restack(members, lead)
+                elif new_c > C:
                     fills = self._slot_fills(b)
                     members = [
                         b.table.grow(m, new_c, slot_fills=fills)
@@ -525,6 +556,7 @@ class Trainer:
                     ]
                     self._set_bundle_capacity(b, new_c)
                     rep["grew_to"] = new_c
+                    total_bytes += growth_bytes
                     ts = self._restack(members, lead)
             tables[bname] = ts
             report[bname] = rep
@@ -533,6 +565,20 @@ class Trainer:
                        opt_state=state.opt_state),
             report,
         )
+
+    def _tier_sync(self, b: Bundle, idxs, members, step: int,
+                   force: bool = False):
+        """Run the host-tier sync over every member state; returns
+        (members, total_demoted, total_promoted)."""
+        demoted = promoted = 0
+        members = list(members)
+        for k, (i, m) in enumerate(zip(idxs, members)):
+            mt = self._multi_tier_for(b, i)
+            m, stats = mt.sync(m, step, force=force)
+            members[k] = m
+            demoted += stats.demoted
+            promoted += stats.promoted
+        return members, demoted, promoted
 
     def _restack(self, members, lead):
         """Reassemble member states into the bundle's stacked layout."""

@@ -90,6 +90,31 @@ def test_overfill_grows_sharded():
     assert all(r["insert_fails"] == 0 for r in report2.values()), report2
 
 
+def test_hbm_budget_auto_tiers_instead_of_growing():
+    """With an HBM byte budget that growth would bust, maintain() auto-
+    places the bundle on the host tier (demote) instead of growing — the
+    automated device-placement decision."""
+    model = _model(capacity=256)
+    tr = Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3))
+    st = tr.init(0)
+    gen = _gen(vocab=600)
+    for _ in range(8):
+        st, _ = tr.train_step(st, _batches(gen, 1)[0])
+    budget = sum(tr._state_bytes(ts) for ts in st.tables.values())  # no room
+    st, report = tr.maintain(st, hbm_budget_bytes=budget)
+    assert all(r["capacity"] == 256 for r in report.values()), report
+    assert any(r.get("auto_tiered") for r in report.values()), report
+    assert sum(r.get("demoted", 0) for r in report.values()) > 0
+    st, mets = tr.train_step(st, _batches(gen, 1)[0])
+    assert np.isfinite(float(mets["loss"]))
+    # and the demotion relieved the pressure: a follow-up maintain with the
+    # same budget takes no action at all
+    st, report2 = tr.maintain(st, hbm_budget_bytes=budget)
+    assert not any(
+        r.get("auto_tiered") or "grew_to" in r for r in report2.values()
+    ), report2
+
+
 def test_multi_tier_demotes_inside_trainer():
     """HBM_DRAM tables demote cold rows at maintain() instead of growing;
     capacity stays fixed and training stays finite."""
