@@ -81,6 +81,16 @@ def _configure(lib):
             np.ctypeslib.ndpointer(np.int32, flags="C"),
             ctypes.POINTER(ctypes.c_int64),
         ]
+    if hasattr(lib, "criteo_parse_mt"):
+        lib.criteo_parse_mt.restype = ctypes.c_int64
+        lib.criteo_parse_mt.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C"),
+            np.ctypeslib.ndpointer(np.float32, flags="C"),
+            np.ctypeslib.ndpointer(np.int32, flags="C"),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
 
 
 class HostKV:
@@ -192,9 +202,12 @@ class HostKV:
 
 
 def criteo_parse_native(
-    buf: bytes, max_rows: int, num_dense: int = 13, num_cat: int = 26
+    buf: bytes, max_rows: int, num_dense: int = 13, num_cat: int = 26,
+    threads: int = 0,
 ):
-    """Parse Criteo TSV bytes with the native parser.
+    """Parse Criteo TSV bytes with the native parser (multi-threaded when
+    the library exports criteo_parse_mt; threads=0 picks the hardware
+    count, threads=1 forces the single-thread path).
 
     Returns (rows, labels, dense, cats, consumed_bytes) or None when the
     native library is unavailable. The id hashing matches
@@ -208,8 +221,14 @@ def criteo_parse_native(
     dense = np.zeros((max_rows, num_dense), np.float32)
     cats = np.zeros((max_rows, num_cat), np.int32)
     consumed = ctypes.c_int64(0)
-    rows = lib.criteo_parse(
-        buf, len(buf), max_rows, num_dense, num_cat, labels,
-        dense.reshape(-1), cats.reshape(-1), ctypes.byref(consumed),
-    )
+    if threads != 1 and hasattr(lib, "criteo_parse_mt"):
+        rows = lib.criteo_parse_mt(
+            buf, len(buf), max_rows, num_dense, num_cat, threads, labels,
+            dense.reshape(-1), cats.reshape(-1), ctypes.byref(consumed),
+        )
+    else:
+        rows = lib.criteo_parse(
+            buf, len(buf), max_rows, num_dense, num_cat, labels,
+            dense.reshape(-1), cats.reshape(-1), ctypes.byref(consumed),
+        )
     return int(rows), labels, dense, cats, int(consumed.value)

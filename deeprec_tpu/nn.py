@@ -270,3 +270,52 @@ def dot_interaction(emb_stack, keep_diag: bool = False):
     z = jnp.einsum("bfd,bgd->bfg", emb_stack, emb_stack)
     i, j = jnp.triu_indices(F, k=0 if keep_diag else 1)
     return z[:, i, j]
+
+
+# ------------------------------------------------- sample-aware compression
+
+
+def group_compress(group_ids, num_groups: int):
+    """Dedup rows by a group id (user id) for sample-aware compression.
+
+    The general form of the reference's Sample-awared Graph Compression
+    (docs/docs_en/Sample-awared-Graph-Compression.md): ranking batches are
+    packed as <user, N candidate items>, so user-side compute repeated N
+    times is waste. `num_groups` is the static maximum distinct groups per
+    batch (the packer's G).
+
+    Returns (first_ix [G], inverse [B], ok [B]): `x[first_ix]` is one
+    representative row per group, `out[inverse]` broadcasts per-group
+    results back to the batch, and `ok` marks rows whose group made the
+    cut — rows of overflow groups (a packer bug) have ok=False and MUST
+    NOT silently receive another group's output.
+    """
+    group_ids = group_ids.reshape(-1)
+    uids, first_ix, inverse = jnp.unique(
+        group_ids, size=num_groups, return_index=True, return_inverse=True,
+        fill_value=group_ids[0],
+    )
+    inverse = inverse.reshape(-1)
+    ok = inverse < num_groups
+    return first_ix, jnp.where(ok, inverse, 0), ok
+
+
+def apply_grouped(fn, inputs, group_ids, num_groups: int):
+    """Run `fn` once per distinct group and broadcast results to the batch:
+    fn(tree with leading dim G) on rows deduped by group_ids [B]; output
+    leaves regain leading dim B. Equal to fn(full batch) row-for-row when
+    fn is row-independent — with G/B of the compute.
+
+    Rows whose group overflowed num_groups come back as NaN: a packer that
+    violates its G must fail loudly, not serve one user's scores to
+    another."""
+    first_ix, inverse, ok = group_compress(group_ids, num_groups)
+    compact = jax.tree.map(lambda a: a[first_ix], inputs)
+    out = fn(compact)
+
+    def broadcast(a):
+        rows = a[inverse]
+        mask = ok.reshape(ok.shape + (1,) * (rows.ndim - 1))
+        return jnp.where(mask, rows, jnp.nan)
+
+    return jax.tree.map(broadcast, out)

@@ -126,6 +126,48 @@ def test_elastic_reshard_single_to_mesh_and_back(tmp_path):
 # -------------------------------------------------------- DSSM group scoring
 
 
+def test_sample_aware_group_compression():
+    """General sample-aware compression (reference
+    Sample-awared-Graph-Compression): a row-independent user tower applied
+    through apply_grouped gives row-identical outputs while computing only
+    one row per distinct group."""
+    import jax
+
+    from deeprec_tpu import nn
+
+    rng = np.random.default_rng(0)
+    B, G, D = 64, 8, 12
+    group_ids = jnp.asarray(rng.integers(0, G, B), jnp.int32)
+    x = jnp.asarray(rng.normal(0, 1, (B, D)).astype(np.float32))
+    # make user-side inputs constant within a group (the packed format)
+    base = jnp.asarray(rng.normal(0, 1, (G, D)).astype(np.float32))
+    x = base[group_ids]
+
+    params = nn.mlp_init(jax.random.PRNGKey(0), D, [16, 4])
+    calls = []
+
+    def tower(inp):
+        calls.append(inp.shape)
+        return nn.mlp_apply(params, inp)
+
+    out_grouped = nn.apply_grouped(tower, x, group_ids, num_groups=G)
+    out_full = nn.mlp_apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_grouped), np.asarray(out_full), rtol=1e-5, atol=1e-6
+    )
+    assert calls == [(G, D)]  # tower ran on G rows, not B
+
+    # packer violation (more distinct groups than G): overflow rows come
+    # back NaN — loud, never another group's output
+    out_over = nn.apply_grouped(
+        lambda inp: nn.mlp_apply(params, inp), x, group_ids, num_groups=G // 2
+    )
+    over = np.isnan(np.asarray(out_over)).any(axis=-1)
+    assert over.any() and not over.all()
+    kept_groups = np.sort(np.unique(np.asarray(group_ids)))[: G // 2]
+    assert set(np.asarray(group_ids)[~over].tolist()) == set(kept_groups.tolist())
+
+
 def test_dssm_score_items_matches_pairwise():
     model = DSSM(emb_dim=8, capacity=1 << 12, num_user_feats=2, num_item_feats=2,
                  hidden=(16, 8))

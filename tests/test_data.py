@@ -76,6 +76,33 @@ def test_native_csv_parser_matches_pandas(tmp_path):
             np.testing.assert_array_equal(nb[c], pb[c])
 
 
+def test_native_parser_mt_bit_identical():
+    """The multi-threaded parser path must produce bit-identical outputs to
+    the single-thread path (disjoint row ranges, no synchronization).
+    Speedup is only observable on multi-core hosts; correctness is not."""
+    import deeprec_tpu.native as N
+
+    if N.load_library() is None or not hasattr(N.load_library(), "criteo_parse_mt"):
+        pytest.skip("native mt parser not built")
+    rng = np.random.default_rng(5)
+    lines = []
+    for _ in range(5000):
+        dense = "\t".join(
+            str(rng.integers(0, 100)) if rng.random() > 0.1 else ""
+            for _ in range(13))
+        cats = "\t".join(
+            f"{rng.integers(0, 1 << 20):x}" if rng.random() > 0.1 else ""
+            for _ in range(26))
+        lines.append(f"{rng.integers(0, 2)}\t{dense}\t{cats}\n")
+    buf = "".join(lines).encode() + b"0\tpartial"  # trailing partial line
+    a = N.criteo_parse_native(buf, 5000, threads=1)
+    b = N.criteo_parse_native(buf, 5000, threads=4)
+    assert a[0] == b[0] == 5000
+    for i in (1, 2, 3):
+        np.testing.assert_array_equal(a[i], b[i])
+    assert a[4] == b[4]  # consumed stops at the same line boundary
+
+
 def test_native_parser_keeps_unterminated_final_line(tmp_path):
     """A file whose last line lacks a trailing newline must parse identically
     through the native and pandas paths (the native parser only consumes
