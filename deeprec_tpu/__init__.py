@@ -11,6 +11,7 @@ modelzoo and a serving path. See SURVEY.md for the blueprint.
 from deeprec_tpu.config import (
     CBFFilter,
     CheckpointConfig,
+    CheckpointOption,
     CounterFilter,
     EmbeddingVariableOption,
     GlobalStepEvict,

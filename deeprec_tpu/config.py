@@ -30,6 +30,9 @@ class StorageType(enum.Enum):
     HBM = "hbm"
     DRAM = "dram"
     HBM_DRAM = "hbm_dram"
+    # three-tier combo (hbm_dram_ssd_storage.h analog): device working set,
+    # bounded host DRAM tier, log-structured disk tier below it
+    HBM_DRAM_SSD = "hbm_dram_ssd"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,6 +130,21 @@ class StorageOption:
     storage_type: StorageType = StorageType.HBM
     storage_path: Optional[str] = None
     cache_strategy: str = "lfu"  # lfu | lru
+    # HBM_DRAM_SSD: max rows held in the host DRAM tier before the coldest
+    # spill to the disk tier (0 = unbounded, disk tier unused)
+    host_capacity: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointOption:
+    """Per-table checkpoint behavior — parity with tf.CheckpointOption
+    (variables.py:217) / TF_EV_SAVE_FILTERED_FEATURES: full checkpoints
+    normally keep sub-threshold (filter-blocked) keys so admission
+    counters survive restarts; save_filtered_features=False drops them at
+    save time (smaller serving-bound checkpoints, same effect as the
+    shrink tool but at the source)."""
+
+    save_filtered_features: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +158,7 @@ class EmbeddingVariableOption:
     global_step_evict: Optional[GlobalStepEvict] = None
     l2_weight_evict: Optional[L2WeightEvict] = None
     storage: StorageOption = StorageOption()
+    ckpt: CheckpointOption = CheckpointOption()
 
     def __post_init__(self):
         if self.counter_filter is not None and self.cbf_filter is not None:

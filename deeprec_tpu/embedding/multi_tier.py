@@ -19,6 +19,7 @@ merge so admission state survives the round-trip).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -30,12 +31,121 @@ from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
 from deeprec_tpu.native import HostKV
 
 
+class DiskKV:
+    """Log-structured on-disk row store — the SSD tier
+    (dram_ssd_storage.h / ssd_hash_kv.h analog). Rows append to a flat
+    record log (key, freq, version, value[dim]); an in-memory index maps
+    key -> record offset, so updates are append+repoint and reads are one
+    seek per key. `save()` persists the index sidecar; `load()` restores
+    it (or rebuilds by scanning the log)."""
+
+    def __init__(self, path: str, dim: int):
+        import json as _json
+
+        self.path = path
+        self.dim = dim
+        self.rec_bytes = 8 + 4 + 4 + 4 * dim
+        self.index: dict = {}
+        self._dtype = np.dtype(
+            [("key", "<i8"), ("freq", "<i4"), ("ver", "<i4"),
+             ("val", "<f4", (dim,))]
+        )
+        assert self._dtype.itemsize == self.rec_bytes
+        mode = "r+b" if os.path.exists(path) else "w+b"
+        self._f = open(path, mode)
+        log_len = self._f.seek(0, 2)
+        if log_len and os.path.exists(path + ".idx"):
+            with open(path + ".idx") as f:
+                saved = _json.load(f)
+            self.index = {
+                int(k): int(v) for k, v in saved.get("index", {}).items()
+            }
+            # A crash can leave records appended after the last save():
+            # scan the tail past the sidecar's recorded length so those
+            # keys (and updates) are not silently stale/lost.
+            tail_from = int(saved.get("_len", 0))
+            if log_len > tail_from:
+                self._scan_index(tail_from)
+        elif log_len:
+            self._scan_index(0)
+
+    def _scan_index(self, from_offset: int):
+        """(Re)build index entries from log records at/after from_offset
+        (later records win, log order)."""
+        end = self._f.seek(0, 2)
+        start = (from_offset // self.rec_bytes) * self.rec_bytes
+        n = (end - start) // self.rec_bytes
+        self._f.seek(start)
+        recs = np.fromfile(self._f, self._dtype, n)
+        for i, k in enumerate(recs["key"]):
+            self.index[int(k)] = start + i * self.rec_bytes
+
+    def __len__(self):
+        return len(self.index)
+
+    def put(self, keys, values, freqs=None, versions=None) -> None:
+        n = len(keys)
+        recs = np.zeros(n, self._dtype)
+        recs["key"] = np.asarray(keys, np.int64)
+        recs["freq"] = 0 if freqs is None else np.asarray(freqs, np.int32)
+        recs["ver"] = 0 if versions is None else np.asarray(versions, np.int32)
+        recs["val"] = np.asarray(values, np.float32).reshape(n, self.dim)
+        self._f.seek(0, 2)
+        base = self._f.tell()
+        recs.tofile(self._f)
+        self._f.flush()
+        for i, k in enumerate(recs["key"]):
+            self.index[int(k)] = base + i * self.rec_bytes
+
+    def get(self, keys):
+        keys = np.asarray(keys, np.int64)
+        n = len(keys)
+        vals = np.zeros((n, self.dim), np.float32)
+        freqs = np.zeros(n, np.int32)
+        vers = np.zeros(n, np.int32)
+        found = np.zeros(n, bool)
+        if not self.index or n == 0:
+            return vals, freqs, vers, found
+        # C-speed membership prefilter: sync() probes nearly every device
+        # key here, while the disk tier usually holds few rows — only seek
+        # for actual hits.
+        idx_keys = np.fromiter(self.index.keys(), np.int64, len(self.index))
+        hit_ix = np.nonzero(np.isin(keys, idx_keys))[0]
+        for i in hit_ix:
+            off = self.index[int(keys[i])]
+            self._f.seek(off)
+            rec = np.fromfile(self._f, self._dtype, 1)[0]
+            vals[i] = rec["val"]
+            freqs[i] = rec["freq"]
+            vers[i] = rec["ver"]
+            found[i] = True
+        return vals, freqs, vers, found
+
+    def erase(self, keys) -> None:
+        for k in np.asarray(keys, np.int64):
+            self.index.pop(int(k), None)
+
+    def save(self) -> None:
+        import json as _json
+
+        self._f.flush()
+        log_len = self._f.seek(0, 2)
+        with open(self.path + ".idx", "w") as f:
+            _json.dump({"_len": log_len, "index": self.index}, f)
+
+    def close(self) -> None:
+        self.save()
+        self._f.close()
+
+
 @dataclasses.dataclass
 class TierStats:
     demoted: int = 0
     promoted: int = 0
     host_size: int = 0
     device_size: int = 0
+    spilled: int = 0  # host -> disk this sync
+    disk_size: int = 0
 
 
 class MultiTierTable:
@@ -62,6 +172,25 @@ class MultiTierTable:
         self.host = HostKV(dim=cfg.dim, initial_capacity=cfg.capacity)
         self.cache_strategy = cfg.ev.storage.cache_strategy
         self.storage_path = storage_path or cfg.ev.storage.storage_path
+        # third tier (HBM_DRAM_SSD): bounded host DRAM, coldest rows spill
+        # to a log-structured disk store (storage-factory combo semantics,
+        # reference storage_factory.h / hbm_dram_ssd_storage.h)
+        self.host_capacity = cfg.ev.storage.host_capacity
+        self.disk: Optional[DiskKV] = None
+        if cfg.ev.storage.storage_type == StorageType.HBM_DRAM_SSD:
+            if self.storage_path:
+                path = self.storage_path + ".ssd"
+            else:
+                # No explicit path -> a fresh private log per run. A fixed
+                # default would silently resurrect a previous job's rows
+                # (and hand them to promote as if they were this model's).
+                import tempfile
+
+                fd, path = tempfile.mkstemp(
+                    prefix=f"deeprec_{cfg.name}_", suffix=".ssd"
+                )
+                os.close(fd)
+            self.disk = DiskKV(path, cfg.dim)
         # Optimizer slot init values ((name, fill), ...) threaded into every
         # rebuild so rows reborn in freed slots restart from the optimizer's
         # init (e.g. Adagrad initial accumulator), never a raw 0.
@@ -83,10 +212,23 @@ class MultiTierTable:
         freq = np.asarray(state.freq)
         version = np.asarray(state.version)
 
-        # -------- promote: device rows re-created while a host copy exists
+        # -------- promote: device rows re-created while a host (or disk)
+        # copy exists
         dev_keys = keys[occ].astype(np.int64)
         if len(dev_keys):
             h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
+            if self.disk is not None and (~found).any():
+                # second-chance from the disk tier (disk hits re-enter the
+                # device directly; their disk record is dropped)
+                miss = ~found
+                d_vals, d_freq, d_ver, d_found = self.disk.get(dev_keys[miss])
+                if d_found.any():
+                    mix = np.nonzero(miss)[0][d_found]
+                    h_vals[mix] = d_vals[d_found]
+                    h_freq[mix] = d_freq[d_found]
+                    h_ver[mix] = d_ver[d_found]
+                    found[mix] = True
+                    self.disk.erase(dev_keys[mix])
             dev_ix = np.nonzero(occ)[0][found]
             if dev_ix.size:
                 hf = h_freq[found]
@@ -145,18 +287,45 @@ class MultiTierTable:
                 slot_fills=tuple(slot_fills) if slot_fills else self.slot_fills,
             )
 
+        # -------- spill: bounded host tier overflows to the disk tier
+        if (
+            self.disk is not None
+            and self.host_capacity
+            and len(self.host) > self.host_capacity
+        ):
+            n_spill = len(self.host) - self.host_capacity
+            ks, vs, fs, vers = self.host.export()
+            order = (
+                np.argsort(vers) if self.cache_strategy == "lru"
+                else np.argsort(fs)
+            )
+            out = order[:n_spill]
+            self.disk.put(ks[out], vs[out], fs[out], vers[out])
+            self.host.erase(ks[out])
+            stats.spilled = int(n_spill)
+
         stats.host_size = len(self.host)
         stats.device_size = int(self.table.size(state))
+        if self.disk is not None:
+            stats.disk_size = len(self.disk)
         return state, stats
 
     # ------------------------------------------------------------- serving
 
     def lookup_with_fallback(self, state: TableState, ids) -> jnp.ndarray:
-        """Readonly lookup that also consults the host tier for misses —
-        the serving-path equivalent of HbmDram's CopyEmbeddingsFromCPUToGPU."""
+        """Readonly lookup that also consults the host tier (then the disk
+        tier) for misses — the serving-path equivalent of HbmDram's
+        CopyEmbeddingsFromCPUToGPU."""
         emb = np.array(self.table.lookup_readonly(state, ids))  # writable copy
         flat_ids = np.asarray(ids).reshape(-1).astype(np.int64)
         h_vals, _, _, found = self.host.get(flat_ids)
+        if self.disk is not None and (~found).any():
+            miss = ~found
+            d_vals, _, _, d_found = self.disk.get(flat_ids[miss])
+            if d_found.any():
+                mix = np.nonzero(miss)[0][d_found]
+                h_vals[mix] = d_vals[d_found]
+                found[mix] = True
         if found.any():
             emb = emb.reshape(len(flat_ids), -1)
             emb[found] = h_vals[found]
@@ -166,8 +335,10 @@ class MultiTierTable:
     # ----------------------------------------------------------- spill/load
 
     def spill(self, path: Optional[str] = None) -> None:
-        """Persist the host tier (the SSD/LevelDB-tier analog)."""
+        """Persist the host tier (and the disk tier's index)."""
         self.host.save(path or self.storage_path or "host_tier.bin")
+        if self.disk is not None:
+            self.disk.save()
 
     def load(self, path: Optional[str] = None) -> None:
         self.host.load(path or self.storage_path or "host_tier.bin")

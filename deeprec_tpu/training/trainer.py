@@ -446,8 +446,17 @@ class Trainer:
             self._tiers = {}
         key = (b.name, idx)
         if key not in self._tiers:
+            # Per-member store paths: every grouped table / shard owns its
+            # own disk log — a shared path would interleave members' rows
+            # in one log and let each member's index save clobber the rest.
+            base = b.table.cfg.ev.storage.storage_path
+            member_path = (
+                base + "_m" + "_".join(map(str, idx)) if base and idx
+                else base
+            )
             self._tiers[key] = MultiTierTable(
-                b.table, slot_fills=self._slot_fills(b)
+                b.table, slot_fills=self._slot_fills(b),
+                storage_path=member_path,
             )
         return self._tiers[key]
 
@@ -512,8 +521,8 @@ class Trainer:
             fails_each = [int(m.insert_fails) for m in members]
             fails = sum(fails_each)
             rep = {"occupancy": occ, "insert_fails": fails, "capacity": C}
-            multi_tier = (
-                b.table.cfg.ev.storage.storage_type.value == "hbm_dram"
+            multi_tier = b.table.cfg.ev.storage.storage_type.value in (
+                "hbm_dram", "hbm_dram_ssd"
             )
             if multi_tier:
                 members, demoted, promoted = self._tier_sync(
