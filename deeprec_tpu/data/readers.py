@@ -31,7 +31,11 @@ def _hash_strings(col: "np.ndarray", salt: int) -> np.ndarray:
 
 
 class CriteoCSVReader:
-    """Batched reader for Criteo-format TSV files."""
+    """Batched reader for Criteo-format TSV files.
+
+    `byte_range=(lo, hi)` restricts reading to that line-aligned span of a
+    SINGLE file (WorkQueue file-slice sharding: path#k/n items) — streamed
+    in place, no copy of the slice."""
 
     def __init__(
         self,
@@ -40,12 +44,16 @@ class CriteoCSVReader:
         num_dense: int = 13,
         num_cat: int = 26,
         drop_remainder: bool = True,
+        byte_range: Optional[tuple] = None,
     ):
         self.paths = list(paths)
         self.B = batch_size
         self.num_dense = num_dense
         self.num_cat = num_cat
         self.drop_remainder = drop_remainder
+        self.byte_range = byte_range
+        if byte_range is not None and len(self.paths) != 1:
+            raise ValueError("byte_range applies to exactly one file")
 
     def _frame_to_batches(self, df) -> Iterator[Dict[str, np.ndarray]]:
         import pandas as pd  # noqa
@@ -81,12 +89,24 @@ class CriteoCSVReader:
             CHUNK = max(1 << 20, self.B * 512)
             for path in self.paths:
                 with open(path, "rb") as f:
+                    remaining = None
+                    if self.byte_range is not None:
+                        lo, hi = self.byte_range
+                        f.seek(lo)
+                        remaining = hi - lo
                     pending = b""
                     while True:
-                        data = pending + f.read(CHUNK)
+                        want = (
+                            CHUNK if remaining is None
+                            else min(CHUNK, remaining)
+                        )
+                        fresh = f.read(want)
+                        if remaining is not None:
+                            remaining -= len(fresh)
+                        data = pending + fresh
                         if not data:
                             break
-                        at_eof = len(data) < len(pending) + CHUNK
+                        at_eof = len(fresh) < CHUNK
                         if at_eof and not data.endswith(b"\n"):
                             # Terminate the final line so the native parser
                             # consumes it, matching the pandas fallback.
@@ -123,11 +143,20 @@ class CriteoCSVReader:
         if native is not None:
             yield from native
             return
+        import io
+
         import pandas as pd
 
         for path in self.paths:
+            if self.byte_range is not None:
+                lo, hi = self.byte_range
+                with open(path, "rb") as f:
+                    f.seek(lo)
+                    src = io.BytesIO(f.read(hi - lo))
+            else:
+                src = path
             for df in pd.read_csv(
-                path,
+                src,
                 sep="\t",
                 names=CRITEO_COLUMNS[: 1 + self.num_dense + self.num_cat],
                 chunksize=self.B * 16,

@@ -98,6 +98,54 @@ class WorkQueue:
             self._items = list(state["items"])
             self._cursor = int(state["cursor"])
 
+    # ----------------------------------------------------------- datasets
+
+    def input_dataset(self, batch_size: int = 2048, reader_cls=None,
+                      **reader_kw):
+        """Stream parsed batches from taken work items — the
+        `WorkQueue.input_dataset()` analog (work_queue.py API,
+        docs/docs_en/WorkQueue.md): each `take()` yields a file (or a
+        `path#k/n` slice), read with CriteoCSVReader (or `reader_cls`).
+        Sliced items read only their byte range's complete lines."""
+        from deeprec_tpu.data.readers import CriteoCSVReader
+
+        reader_cls = reader_cls or CriteoCSVReader
+        # Slices are usually smaller than a batch; a per-slice reader that
+        # drops remainders could silently deliver NOTHING. Deliver every
+        # row unless the caller explicitly asks otherwise.
+        reader_kw.setdefault("drop_remainder", False)
+
+        def gen():
+            for item in self:
+                path, k, n = parse_slice(item)
+                if n == 1:
+                    yield from reader_cls([path], batch_size, **reader_kw)
+                else:
+                    yield from reader_cls(
+                        [path], batch_size,
+                        byte_range=self._slice_range(path, k, n), **reader_kw
+                    )
+
+        return gen()
+
+    @staticmethod
+    def _slice_range(path, k, n):
+        """Line-snapped byte range of the k-th of n slices: boundaries snap
+        forward to line starts so each line belongs to exactly one slice."""
+        size = os.path.getsize(path)
+        lo = size * k // n
+        hi = size * (k + 1) // n
+        with open(path, "rb") as f:
+            if lo:
+                f.seek(lo - 1)
+                f.readline()  # consume the partial line (previous slice's)
+                lo = f.tell()
+            if hi:
+                f.seek(hi - 1)
+                f.readline()
+                hi = f.tell()
+        return lo, hi
+
     # ------------------------------------------------- file-coordinated mode
 
     def _with_lock(self, fn):

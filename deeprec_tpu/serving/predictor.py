@@ -301,6 +301,29 @@ class ModelServer:
             for _, reply in pending:
                 reply.put(e)
 
+    def warmup(self, example: Dict[str, np.ndarray]) -> int:
+        """Precompile every batch bucket (8, 16, ... max_batch) from one
+        example row, so the first production burst never waits on XLA.
+        Returns the number of buckets compiled. The serving counterpart of
+        the reference's warmup requests (Processor.md warmup section)."""
+        one = {k: np.asarray(v)[:1] for k, v in example.items()}
+        sizes = []
+        bucket = 8
+        while bucket <= self.max_batch:
+            sizes.append(bucket)
+            bucket <<= 1
+        if not sizes or sizes[-1] != self.max_batch:
+            # _serve pads saturated loads to max_batch itself — a
+            # non-power-of-two max_batch is the heaviest bucket and must
+            # not be the one bucket left uncompiled
+            sizes.append(self.max_batch)
+        for size in sizes:
+            batch = {
+                k: np.concatenate([v] * size, axis=0) for k, v in one.items()
+            }
+            self.predictor.predict(batch)
+        return len(sizes)
+
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
         """Blocking predict for one (mini-)request — the process() call."""
         reply: "queue.Queue" = queue.Queue(maxsize=1)

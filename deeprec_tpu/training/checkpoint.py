@@ -53,10 +53,23 @@ def export_table_arrays(
     table: EmbeddingTable, state_np: Dict[str, np.ndarray], only_dirty: bool
 ) -> Dict[str, np.ndarray]:
     """Compact one LOCAL table state (host numpy arrays) to its live rows."""
+    cfg = table.cfg
     keys = state_np["keys"]
-    occ = keys != empty_key(table.cfg)
+    occ = keys != empty_key(cfg)
     if only_dirty:
         occ = occ & state_np["dirty"]
+    if (
+        not cfg.ev.ckpt.save_filtered_features
+        and cfg.ev.counter_filter is not None
+        and cfg.ev.counter_filter.filter_freq > 0
+    ):
+        # CheckpointOption / TF_EV_SAVE_FILTERED_FEATURES=False: drop
+        # sub-threshold keys at save time (admission counters restart).
+        # COUNTER filter only: its admission counter IS the row freq. In
+        # CBF mode sub-threshold keys never occupy rows (the counter lives
+        # in the sketch), so every resident row is admitted and a row-freq
+        # threshold would wrongly drop just-admitted keys.
+        occ = occ & (state_np["freq"] >= cfg.ev.counter_filter.filter_freq)
     idx = np.nonzero(occ)[0]
     out = {
         "keys": keys[idx],

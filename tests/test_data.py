@@ -382,6 +382,31 @@ def test_work_queue_epochs_shuffle_slices():
     assert path in ("a", "b") and n == 2 and k in (0, 1)
 
 
+def test_work_queue_input_dataset_slices_cover_file(tmp_path):
+    """input_dataset() over sliced work items: every row of the file is
+    delivered exactly once across the slices (line-snapped byte ranges)."""
+    from deeprec_tpu.data import WorkQueue
+
+    p = str(tmp_path / "day0.tsv")
+    _write_criteo_tsv(p, rows=300)
+    q = WorkQueue([p], shuffle=False, num_slices=3)
+    rows = 0
+    labels = []
+    # default delivers every row (a drop_remainder default would silently
+    # drop up to batch_size-1 rows PER SLICE)
+    for b in q.input_dataset(batch_size=32):
+        rows += len(b["label"])
+        labels.append(b["label"])
+    assert rows == 300
+    # parity with an unsliced read
+    full = np.concatenate(
+        [b["label"] for b in
+         __import__("deeprec_tpu.data", fromlist=["CriteoCSVReader"])
+         .CriteoCSVReader([p], 32, drop_remainder=False)]
+    )
+    np.testing.assert_array_equal(np.concatenate(labels), full)
+
+
 def test_work_queue_save_restore():
     wq = WorkQueue(["a", "b", "c"], shuffle=False)
     assert wq.take() == "a"

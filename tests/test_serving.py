@@ -192,6 +192,58 @@ def test_http_server_end_to_end(tmp_path):
         server.close()
 
 
+def test_server_warmup_precompiles_buckets(tmp_path):
+    model, tr, st, ck, batches, gen = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=32,
+                         max_wait_ms=2)
+    try:
+        n = server.warmup(strip_labels(batches[0]))
+        assert n == 3  # buckets 8, 16, 32
+        out = server.request(
+            {k: v[:1] for k, v in strip_labels(batches[0]).items()}
+        )
+        assert out.shape == (1,)
+    finally:
+        server.close()
+
+
+def test_checkpoint_option_drops_filtered_features():
+    """CheckpointOption(save_filtered_features=False): sub-threshold keys
+    are dropped at export (TF_EV_SAVE_FILTERED_FEATURES parity); the
+    default keeps them so admission counters survive restarts."""
+    import dataclasses
+
+    from deeprec_tpu import (
+        CheckpointOption,
+        CounterFilter,
+        EmbeddingTable,
+        EmbeddingVariableOption,
+        TableConfig,
+    )
+    from deeprec_tpu.training.checkpoint import _state_to_np, export_table_arrays
+
+    cfg = TableConfig(
+        name="cf", dim=4, capacity=128,
+        ev=EmbeddingVariableOption(counter_filter=CounterFilter(filter_freq=3)),
+    )
+    t = EmbeddingTable(cfg)
+    s = t.create()
+    hot = jnp.arange(5, dtype=jnp.int32)
+    for step in range(3):
+        s, _ = t.lookup_unique(s, hot, step=step)  # freq 3: admitted
+    s, _ = t.lookup_unique(s, jnp.arange(5, 20, dtype=jnp.int32), step=3)
+
+    keep_all = export_table_arrays(t, _state_to_np(s), only_dirty=False)
+    assert keep_all["keys"].shape[0] == 20  # default: everything saved
+
+    t2 = EmbeddingTable(dataclasses.replace(
+        cfg, ev=dataclasses.replace(
+            cfg.ev, ckpt=CheckpointOption(save_filtered_features=False))))
+    shrunk = export_table_arrays(t2, _state_to_np(s), only_dirty=False)
+    assert sorted(shrunk["keys"].tolist()) == list(range(5))
+    assert (shrunk["freqs"] >= 3).all()
+
+
 def test_remote_feature_store_over_tcp(tmp_path):
     """Predictor read-through against a REMOTE store (redis_feature_store
     parity): rows served over the network change predictions exactly like
