@@ -30,6 +30,48 @@ def _hash_strings(col: "np.ndarray", salt: int) -> np.ndarray:
     return out
 
 
+class _RangeFile:
+    """Read-only file-like view of bytes [lo, hi) of a file — lets pandas
+    stream a byte-range slice chunk-by-chunk instead of materializing it."""
+
+    def __init__(self, path: str, lo: int, hi: int):
+        self._f = open(path, "rb")
+        self._f.seek(lo)
+        self._left = hi - lo
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        if n is None or n < 0 or n > self._left:
+            n = self._left
+        data = self._f.read(n)
+        self._left -= len(data)
+        return data
+
+    def readline(self, *a) -> bytes:  # pandas' python engine probes this
+        if self._left <= 0:
+            return b""
+        line = self._f.readline(self._left)
+        self._left -= len(line)
+        return line
+
+    def __iter__(self):
+        while True:
+            line = self.readline()
+            if not line:
+                return
+            yield line
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
 class CriteoCSVReader:
     """Batched reader for Criteo-format TSV files.
 
@@ -143,26 +185,25 @@ class CriteoCSVReader:
         if native is not None:
             yield from native
             return
-        import io
+        import contextlib
 
         import pandas as pd
 
         for path in self.paths:
-            if self.byte_range is not None:
-                lo, hi = self.byte_range
-                with open(path, "rb") as f:
-                    f.seek(lo)
-                    src = io.BytesIO(f.read(hi - lo))
-            else:
-                src = path
-            for df in pd.read_csv(
-                src,
-                sep="\t",
-                names=CRITEO_COLUMNS[: 1 + self.num_dense + self.num_cat],
-                chunksize=self.B * 16,
-                header=None,
-            ):
-                yield from self._frame_to_batches(df)
+            with contextlib.ExitStack() as stack:
+                if self.byte_range is not None:
+                    lo, hi = self.byte_range
+                    src = stack.enter_context(_RangeFile(path, lo, hi))
+                else:
+                    src = path
+                for df in pd.read_csv(
+                    src,
+                    sep="\t",
+                    names=CRITEO_COLUMNS[: 1 + self.num_dense + self.num_cat],
+                    chunksize=self.B * 16,
+                    header=None,
+                ):
+                    yield from self._frame_to_batches(df)
 
 
 class ParquetReader:

@@ -273,14 +273,11 @@ class ModelServer:
             k: np.concatenate([np.asarray(r[k]) for r in reqs])
             for k in reqs[0]
         }
-        # Pad to a power-of-two bucket (capped at max_batch) so the jitted
-        # predict compiles once per bucket instead of once per arrival-timing
+        # Pad to a bucket from the fixed ladder so the jitted predict
+        # compiles once per bucket instead of once per arrival-timing
         # dependent size — otherwise concurrent load is a compile storm.
         total = sum(sizes)
-        bucket = 1
-        while bucket < total:
-            bucket <<= 1
-        bucket = min(max(bucket, 8), max(self.max_batch, total))
+        bucket = self._bucket_for(total)
         if bucket > total:
             batch = {
                 k: np.concatenate([v, np.repeat(v[-1:], bucket - total, axis=0)])
@@ -301,22 +298,31 @@ class ModelServer:
             for _, reply in pending:
                 reply.put(e)
 
-    def warmup(self, example: Dict[str, np.ndarray]) -> int:
-        """Precompile every batch bucket (8, 16, ... max_batch) from one
-        example row, so the first production burst never waits on XLA.
-        Returns the number of buckets compiled. The serving counterpart of
-        the reference's warmup requests (Processor.md warmup section)."""
-        one = {k: np.asarray(v)[:1] for k, v in example.items()}
+    def _buckets(self) -> List[int]:
+        """The ONE bucket ladder (shared by _serve and warmup — any change
+        here keeps them in lockstep): powers of two from 8, capped by
+        max_batch, which is always the last (and heaviest) bucket."""
         sizes = []
-        bucket = 8
-        while bucket <= self.max_batch:
-            sizes.append(bucket)
-            bucket <<= 1
-        if not sizes or sizes[-1] != self.max_batch:
-            # _serve pads saturated loads to max_batch itself — a
-            # non-power-of-two max_batch is the heaviest bucket and must
-            # not be the one bucket left uncompiled
-            sizes.append(self.max_batch)
+        b = 8
+        while b < self.max_batch:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(self.max_batch)
+        return sizes
+
+    def _bucket_for(self, total: int) -> int:
+        for b in self._buckets():
+            if total <= b:
+                return b
+        return total  # > max_batch: serve as-is (caller bounded by queue)
+
+    def warmup(self, example: Dict[str, np.ndarray]) -> int:
+        """Precompile every batch bucket from one example row, so the first
+        production burst never waits on XLA. Returns the number of buckets
+        compiled. The serving counterpart of the reference's warmup
+        requests (Processor.md warmup section)."""
+        one = {k: np.asarray(v)[:1] for k, v in example.items()}
+        sizes = self._buckets()
         for size in sizes:
             batch = {
                 k: np.concatenate([v] * size, axis=0) for k, v in one.items()

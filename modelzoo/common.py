@@ -58,6 +58,24 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
                         "is bf16-on-MXU regardless (nn.py).")
     p.add_argument("--kernel", default="auto", choices=["auto", "xla", "pallas"],
                    help="embedding hot-path kernel (TableConfig.kernel)")
+    p.add_argument("--micro_batch", type=int, default=0,
+                   help="split each batch into N micro-batches "
+                        "(Auto-Micro-Batch: sparse applies per micro, dense "
+                        "grads accumulated; batch_size must divide by N)")
+    p.add_argument("--workqueue", action="store_true",
+                   help="shard --data files through a WorkQueue (dynamic "
+                        "work-item sharding; straggler-proof multi-worker "
+                        "input). Requires --data.")
+    p.add_argument("--num_slices", type=int, default=1,
+                   help="with --workqueue: split each file into N slices")
+    p.add_argument("--epochs", type=int, default=1,
+                   help="with --workqueue: dataset epochs in the queue")
+    p.add_argument("--maintain_every", type=int, default=0,
+                   help="run capacity management (auto-grow / tiering) "
+                        "every N steps (0 = off)")
+    p.add_argument("--hbm_budget_mb", type=int, default=0,
+                   help="with --maintain_every: total table-bytes budget; "
+                        "growth beyond it auto-tiers to the host store")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeline", type=int, default=0,
                    help="trace steps [N, N+10) to --timeline_dir")
@@ -100,6 +118,23 @@ def make_data(args, kind: str):
         paths = sorted(glob.glob(args.data))
         if not paths:
             raise FileNotFoundError(f"--data glob matched nothing: {args.data}")
+        if getattr(args, "workqueue", False):
+            parquet = paths[0].endswith(".parquet")
+            if parquet and args.num_slices > 1:
+                raise ValueError(
+                    "--num_slices applies to TSV files only (parquet has no "
+                    "byte-range slicing; shard by file instead)"
+                )
+            q = D.WorkQueue(paths, num_epochs=args.epochs, shuffle=True,
+                            seed=args.seed, num_slices=args.num_slices)
+            # training wants one compiled batch shape: drop per-slice
+            # remainders (size the slices >= batch_size)
+            return D.staged(
+                q.input_dataset(
+                    args.batch_size, drop_remainder=True,
+                    reader_cls=D.ParquetReader if parquet else None,
+                )
+            )
         if paths[0].endswith(".parquet"):
             return D.staged(iter(D.ParquetReader(paths, args.batch_size)))
         return D.staged(iter(D.CriteoCSVReader(paths, args.batch_size)))
@@ -203,7 +238,12 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             break
         if tracer:
             tracer.on_step(step)
-        state, mets = trainer.train_step(state, put(batch))
+        if args.micro_batch > 1:
+            state, mets = trainer.train_step_accum(
+                state, put(batch), args.micro_batch
+            )
+        else:
+            state, mets = trainer.train_step(state, put(batch))
         step += 1
         if step % args.log_every == 0:
             jax.block_until_ready(mets["loss"])
@@ -228,6 +268,17 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             window_start = step
         if args.evict_every and step % args.evict_every == 0:
             state = trainer.evict_tables(state)
+        if args.maintain_every and step % args.maintain_every == 0:
+            state, report = trainer.maintain(
+                state,
+                hbm_budget_bytes=args.hbm_budget_mb << 20 or None,
+            )
+            acted = {
+                bn: r for bn, r in report.items()
+                if "grew_to" in r or r.get("demoted") or r.get("auto_tiered")
+            }
+            if acted:
+                print(f"maintain: {acted}", flush=True)
         if ck and args.save_steps and step % args.save_steps == 0:
             state = trainer.evict_tables(state)  # evict at ckpt time (ref cadence)
             state, path = ck.save(state)
