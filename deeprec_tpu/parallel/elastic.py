@@ -32,7 +32,17 @@ def reshard(
     Goes through the checkpoint container (host RAM-disk scratch) so the
     exact same tested export/import path handles the move; keys re-probe into
     their new owners' shards.
+
+    Multi-host: pass a SHARED scratch_dir — process 0 writes the files and
+    every process must read them, so per-process tempdirs cannot work.
     """
+    import jax
+
+    if jax.process_count() > 1 and scratch_dir is None:
+        raise ValueError(
+            "multi-host reshard needs a shared scratch_dir (process 0 "
+            "writes the checkpoint; every process reads it)"
+        )
     d = scratch_dir or tempfile.mkdtemp(prefix="reshard_")
     src_ck = CheckpointManager(d, src_trainer, keep=1)
     _, path = src_ck.save(src_state)

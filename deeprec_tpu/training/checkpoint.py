@@ -85,18 +85,29 @@ def export_table_arrays(
     return out
 
 
+def _to_host(x) -> np.ndarray:
+    """Materialize an array on THIS host — including multi-host global
+    arrays, whose shards are assembled across processes (shared-FS
+    checkpointing: every process sees the full value, process 0 writes)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def _state_to_np(ts: TableState) -> Dict[str, np.ndarray]:
     d = {
-        "keys": np.asarray(ts.keys),
-        "values": np.asarray(ts.values),
-        "freq": np.asarray(ts.freq),
-        "version": np.asarray(ts.version),
-        "dirty": np.asarray(ts.dirty),
+        "keys": _to_host(ts.keys),
+        "values": _to_host(ts.values),
+        "freq": _to_host(ts.freq),
+        "version": _to_host(ts.version),
+        "dirty": _to_host(ts.dirty),
     }
     for sname, arr in ts.slots.items():
-        d["slot:" + sname] = np.asarray(arr)
+        d["slot:" + sname] = _to_host(arr)
     if ts.bloom is not None:
-        d["bloom"] = np.asarray(ts.bloom)
+        d["bloom"] = _to_host(ts.bloom)
     return d
 
 
@@ -253,29 +264,64 @@ class CheckpointManager:
 
     # ---------------------------------------------------------------- save
 
+    def _is_writer(self) -> bool:
+        """Multi-host: every process assembles the global arrays (shared-FS
+        layout needs the files once), process 0 writes them.
+
+        Memory model: saves gather each table to host RAM (a full
+        process_allgather per save, incremental included) and multi-host
+        restore materializes it on one device per process — correct up to
+        host/device memory, which covers single-slice pods. A per-process
+        shard-part file format (no global gather anywhere) is the
+        pod-scale follow-up; see docs/STATUS-round2.md.
+        """
+        if jax.process_count() > 1 and not self._is_sharded():
+            raise RuntimeError(
+                "multi-process checkpointing requires a ShardedTrainer "
+                "(a plain Trainer under jax.distributed has no global mesh "
+                "to gather from / place onto)"
+            )
+        return jax.process_index() == 0
+
+    @staticmethod
+    def _sync(tag: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
+
     def save(self, state: TrainState) -> Tuple[TrainState, str]:
-        """Full checkpoint. Returns (state with dirty bits cleared, path)."""
+        """Full checkpoint. Returns (state with dirty bits cleared, path).
+        Multi-host safe: all processes participate in the gather, process 0
+        writes, and nobody returns before the manifest exists."""
         step = int(state.step)
         path = os.path.join(self.dir, f"full-{step}")
-        os.makedirs(path, exist_ok=True)
+        write = self._is_writer()
+        if write:
+            os.makedirs(path, exist_ok=True)
         for bname in self.trainer.bundles:
             for tag, arrays in self._export_bundle(state, bname, False).items():
-                np.savez(os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays)
-        np.savez(os.path.join(path, "dense.npz"), **_tree_to_npz_dict(state.dense))
-        np.savez(
-            os.path.join(path, "opt.npz"), **_tree_to_npz_dict(state.opt_state)
-        )
-        manifest = {
-            "step": step,
-            "kind": "full",
-            "bundles": {
-                bn: [f.name for f in b.features]
-                for bn, b in self.trainer.bundles.items()
-            },
-        }
-        with open(os.path.join(path, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
-        self._gc()
+                if write:
+                    np.savez(
+                        os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays
+                    )
+        if write:
+            np.savez(os.path.join(path, "dense.npz"),
+                     **_tree_to_npz_dict(state.dense))
+            np.savez(os.path.join(path, "opt.npz"),
+                     **_tree_to_npz_dict(state.opt_state))
+            manifest = {
+                "step": step,
+                "kind": "full",
+                "bundles": {
+                    bn: [f.name for f in b.features]
+                    for bn, b in self.trainer.bundles.items()
+                },
+            }
+            with open(os.path.join(path, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+            self._gc()
+        self._sync(f"ckpt-full-{step}")
         return self._clear_dirty(state), path
 
     def save_incremental(self, state: TrainState) -> Tuple[TrainState, str]:
@@ -283,14 +329,23 @@ class CheckpointManager:
         save. The consumer replays deltas over the latest full save."""
         step = int(state.step)
         path = os.path.join(self.dir, f"incr-{step}")
-        os.makedirs(path, exist_ok=True)
+        write = self._is_writer()
+        if write:
+            os.makedirs(path, exist_ok=True)
         for bname in self.trainer.bundles:
             for tag, arrays in self._export_bundle(state, bname, True).items():
-                np.savez(os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays)
-        np.savez(os.path.join(path, "dense.npz"), **_tree_to_npz_dict(state.dense))
-        np.savez(os.path.join(path, "opt.npz"), **_tree_to_npz_dict(state.opt_state))
-        with open(os.path.join(path, "manifest.json"), "w") as f:
-            json.dump({"step": step, "kind": "incr"}, f)
+                if write:
+                    np.savez(
+                        os.path.join(path, f"table_{bname}_{tag}.npz"), **arrays
+                    )
+        if write:
+            np.savez(os.path.join(path, "dense.npz"),
+                     **_tree_to_npz_dict(state.dense))
+            np.savez(os.path.join(path, "opt.npz"),
+                     **_tree_to_npz_dict(state.opt_state))
+            with open(os.path.join(path, "manifest.json"), "w") as f:
+                json.dump({"step": step, "kind": "incr"}, f)
+        self._sync(f"ckpt-incr-{step}")
         return self._clear_dirty(state), path
 
     # ------------------------------------------------------------- restore
@@ -310,11 +365,19 @@ class CheckpointManager:
 
     def restore(self, template: Optional[TrainState] = None) -> TrainState:
         """Latest full checkpoint + all newer deltas, onto the trainer's
-        CURRENT topology (mesh size / capacity may differ from save time)."""
+        CURRENT topology (mesh size / process count / capacity may all
+        differ from save time — this is the elastic-rescale mechanism).
+        Multi-host: every process replays the same files host-side, then
+        the result is re-placed onto the global mesh."""
         full_step = self.latest_full()
         if full_step is None:
             raise FileNotFoundError(f"no full checkpoint under {self.dir}")
         state = template if template is not None else self.trainer.init(0)
+        multi = jax.process_count() > 1
+        if multi:
+            # host-local replay: the import machinery indexes/reshapes
+            # per-shard states, which global multi-host arrays cannot do
+            state = jax.tree.map(lambda a: jnp.asarray(_to_host(a)), state)
         state = self._apply_ckpt(state, os.path.join(self.dir, f"full-{full_step}"),
                                  load_dense=True)
         for istep in [s for s in self._list("incr") if s > full_step]:
@@ -324,11 +387,44 @@ class CheckpointManager:
             full_step = istep
         with open(os.path.join(self.dir, self._latest_dir(), "manifest.json")) as f:
             step = json.load(f)["step"]
-        return TrainState(
+        out = TrainState(
             step=jnp.asarray(step, jnp.int32),
             tables=state.tables,
             dense=state.dense,
             opt_state=state.opt_state,
+        )
+        if multi:
+            out = self._place_on_mesh(out)
+        return out
+
+    def _place_on_mesh(self, state: TrainState) -> TrainState:
+        """Re-place host-local restored state onto the trainer's global
+        mesh (every process holds identical host values and contributes
+        its addressable shards)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeprec_tpu.parallel.mesh import put_global
+
+        if not self._is_sharded():  # unreachable: _is_writer() raises first
+            raise RuntimeError("multi-process restore requires ShardedTrainer")
+        mesh = self.trainer.mesh
+        tables = {
+            bname: jax.tree.map(
+                lambda a, sh=NamedSharding(
+                    mesh, self.trainer._table_spec(bname)
+                ): put_global(a, sh),
+                ts,
+            )
+            for bname, ts in state.tables.items()
+        }
+        repl = NamedSharding(mesh, P())
+        return TrainState(
+            step=put_global(state.step, repl),
+            tables=tables,
+            dense=jax.tree.map(lambda a: put_global(a, repl), state.dense),
+            opt_state=jax.tree.map(
+                lambda a: put_global(a, repl), state.opt_state
+            ),
         )
 
     def _latest_dir(self) -> str:

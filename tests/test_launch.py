@@ -70,8 +70,20 @@ WORKER = textwrap.dedent(
         st, mets = tr.train_step(st, batch)
         losses.append(float(mets["loss"]))
 
+    # multi-host checkpoint: all processes gather, proc 0 writes, barrier
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+    ck = CheckpointManager({ckdir!r}, tr)
+    st, ck_path = ck.save(st)
+    # restore on the SAME 2-process mesh and keep training: loss identical
+    st2 = ck.restore()
+    batch = shard_batch(gmesh, {{k: jnp.asarray(v)
+                                 for k, v in gen.batch().items()}})
+    _, m_orig = tr.train_step(st, batch)
+    _, m_rest = tr.train_step(st2, batch)
+    restore_pair = [float(m_orig["loss"]), float(m_rest["loss"])]
+
     out = {{"pid": pid, "psum": got, "taken": taken, "losses": losses,
-            "ndev": len(jax.devices())}}
+            "restore_pair": restore_pair, "ndev": len(jax.devices())}}
     with open({outdir!r} + f"/out{{pid}}.json", "w") as f:
         json.dump(out, f)
     """
@@ -90,10 +102,11 @@ def test_two_process_launch_psum_and_workqueue(tmp_path):
     import numpy as np
 
     coord_file = str(tmp_path / "queue.json")
+    ckdir = str(tmp_path / "ckpt")
     script = str(tmp_path / "worker.py")
     with open(script, "w") as f:
         f.write(WORKER.format(repo=os.path.abspath(REPO), coord=coord_file,
-                              outdir=str(tmp_path)))
+                              outdir=str(tmp_path), ckdir=ckdir))
     port = _free_port()
     env = {
         **os.environ,
@@ -133,3 +146,48 @@ def test_two_process_launch_psum_and_workqueue(tmp_path):
     # sharded training across hosts: same replicated loss on both, finite
     assert results[0]["losses"] == results[1]["losses"], results
     assert all(np.isfinite(l) for l in results[0]["losses"])
+    # multi-host save -> same-topology restore continues identically
+    for r in results:
+        a, b = r["restore_pair"]
+        assert abs(a - b) < 1e-6, r["restore_pair"]
+
+    # ELASTIC: restore the 2-process checkpoint in a SINGLE process on its
+    # own 2-device mesh (4 shards -> 2 shards) and keep training
+    single = textwrap.dedent(
+        f"""
+        import sys
+        sys.path.insert(0, {os.path.abspath(REPO)!r})
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+        from deeprec_tpu.data import SyntheticCriteo
+        from deeprec_tpu.models import WDL
+        from deeprec_tpu.optim import Adagrad
+        from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+        from deeprec_tpu.training.checkpoint import CheckpointManager
+
+        mesh = make_mesh(2)
+        model = WDL(emb_dim=4, capacity=1 << 8, hidden=(8,), num_cat=2,
+                    num_dense=2)
+        tr = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3),
+                            mesh=mesh)
+        st = CheckpointManager({ckdir!r}, tr).restore()
+        gen = SyntheticCriteo(batch_size=16, num_cat=2, num_dense=2,
+                              vocab=200, seed=7)
+        st, m = tr.train_step(
+            st, shard_batch(mesh, {{k: jnp.asarray(v)
+                                    for k, v in gen.batch().items()}})
+        )
+        assert np.isfinite(float(m["loss"]))
+        print("ELASTIC_OK", float(m["loss"]))
+        """
+    )
+    single_py = str(tmp_path / "single.py")
+    with open(single_py, "w") as f:
+        f.write(single)
+    out = subprocess.run(
+        [sys.executable, single_py], env=env, timeout=240,
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert "ELASTIC_OK" in out.stdout
