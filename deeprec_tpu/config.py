@@ -186,8 +186,10 @@ class TableConfig:
     # Hot-path kernel choice: "xla" = plain gather/scatter ops, "pallas" =
     # the fused DMA kernels in ops/fused_lookup.py (row gather + stochastic-
     # rounded scatter), "auto" = whichever tools/bench_lookup.py crowned on
-    # this hardware (currently xla; pallas is opt-in until measured faster).
-    # Off-TPU every choice falls back to identical-semantics XLA.
+    # this hardware: pallas, measured faster on v5e wherever the kernels are
+    # eligible (f32 tables, dim%128==0 — Mosaic HBM-tiling constraint); the
+    # ops self-gate ineligible shapes back to XLA. Off-TPU every choice
+    # falls back to identical-semantics XLA.
     kernel: str = "auto"  # auto | xla | pallas
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 

@@ -18,10 +18,16 @@ DMA pipeline, so the next row's fetch overlaps the current row's compute:
     rounding when the table is bf16 (plain round-to-nearest silently drops
     small gradient updates once |update| < ulp(value)/2).
 
-All kernels are opt-in via ``TableConfig.kernel = "pallas"`` and fall back
-to the identical-semantics XLA path off-TPU, so every caller is oracle-
-testable on CPU (and in Pallas interpret mode). ``tools/bench_lookup.py``
-measures both paths on hardware; whichever wins becomes the "auto" choice.
+Eligibility (measured on v5e): the DMA kernels require **f32 tables with
+dim % 128 == 0** — Mosaic's HBM tiling constraint, see ``_dma_ok``. With
+``TableConfig.kernel = "auto"`` (the default) eligible tables take the
+Pallas path (bench-crowned winner: gather 494 vs 362 GB/s, scatter 1117 vs
+726 — tools/bench_lookup.py, docs/perf.md) and everything else falls back
+to the identical-semantics XLA path, including bf16 stochastic rounding,
+which on hardware therefore always runs the XLA branch of apply_rows_sr.
+Off-TPU all calls are XLA, so every caller is oracle-testable on CPU (the
+kernels themselves via interpret mode, where the in-kernel SR branch is
+also covered).
 """
 from __future__ import annotations
 
@@ -31,10 +37,24 @@ import jax
 import jax.numpy as jnp
 
 _BLOCK = 8  # rows per grid step; sublane-aligned for f32
+_LANES = 128  # Mosaic HBM tiling: DMA row slices must be lane-aligned
 
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
+
+
+def _dma_ok(dim: int, dtype) -> bool:
+    """Row-DMA kernels slice single rows out of the HBM-resident table;
+    Mosaic requires those slices aligned to the HBM tiling, so the Pallas
+    path only exists for f32 tables with dim % 128 == 0 (measured on v5e:
+    misaligned widths are a compile error, not a slowdown — dim 64 fails
+    "must be aligned to tiling (128)"; bf16 tiles (2, 128) so a dynamic
+    single-row slice fails "index in dimension 0 is a multiple of 2").
+    Narrower tables take the XLA gather/scatter path, which is
+    bandwidth-bound anyway at small rows (a D<128 row underfills even one
+    DMA granule)."""
+    return dim % _LANES == 0 and jnp.dtype(dtype).itemsize == 4
 
 
 def _pad_rows(ix: jnp.ndarray, block: int, fill: int = 0) -> jnp.ndarray:
@@ -53,7 +73,7 @@ def gather_rows(values: jnp.ndarray, ix: jnp.ndarray, *,
     """values [C, D], ix [n] int32 -> [n, D]; out-of-range ix clamp (the
     'clip' semantics of the jnp fallback). Rows ride a 2-deep DMA pipeline."""
     n = ix.shape[0]
-    if not interpret and not _on_tpu():
+    if not interpret and not (_on_tpu() and _dma_ok(values.shape[1], values.dtype)):
         return values.at[ix].get(mode="clip")
 
     from jax.experimental import pallas as pl
@@ -123,7 +143,7 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
     """
     B, L = row_ix.shape
     C, D = values.shape
-    if not interpret and not _on_tpu():
+    if not interpret and not (_on_tpu() and _dma_ok(D, values.dtype)):
         e = values.at[jnp.clip(row_ix, 0, C - 1)].get(mode="clip")
         w = jnp.where(row_ix >= 0, weights, 0.0)
         return jnp.sum(e.astype(jnp.float32) * w[..., None], axis=1)
@@ -139,6 +159,11 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
         weights = jnp.concatenate([weights, jnp.zeros((padB, L), weights.dtype)])
     Bp = row_ix.shape[0]
     flat_ix = row_ix.reshape(-1).astype(jnp.int32)
+    # Weights ride SMEM as a second scalar-prefetch operand: a dynamic
+    # per-position scalar read from a VMEM block is not expressible on TPU
+    # ("index in dimension 1 must be a multiple of 128"); SMEM scalar loads
+    # at computed offsets are.
+    flat_w = weights.reshape(-1).astype(jnp.float32)
     rows_per_blk = block_b * L
 
     def kernel(ix_ref, w_ref, values_ref, out_ref, scratch, sems):
@@ -162,25 +187,21 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
 
             row_dma(cur, j).wait()
             b = j // L
-            l = j % L
-            w = jnp.where(ix_ref[base + j] >= 0, w_ref[b, l], 0.0)
+            w = jnp.where(ix_ref[base + j] >= 0, w_ref[base + j], 0.0)
             out_ref[b, :] = out_ref[b, :] + w * scratch[cur].astype(jnp.float32)
             return 0
 
         jax.lax.fori_loop(0, rows_per_blk, body, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(Bp // block_b,),
         in_specs=[
-            pl.BlockSpec(
-                (block_b, L), lambda i, ix_ref: (i, 0),
-                memory_space=pltpu.VMEM,
-            ),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (block_b, D), lambda i, ix_ref: (i, 0), memory_space=pltpu.VMEM
+            (block_b, D), lambda i, ix_ref, w_ref: (i, 0),
+            memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
             pltpu.VMEM((2, D), values.dtype),
@@ -192,7 +213,7 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((Bp, D), jnp.float32),
         interpret=interpret,
-    )(flat_ix, weights.astype(jnp.float32), values)
+    )(flat_ix, flat_w, values)
     return out[:B]
 
 
@@ -222,7 +243,7 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
     use_pallas=False keeps the XLA scatter (still stochastic-rounding bf16)."""
     U, D = new_rows.shape
     C = values.shape[0]
-    if not interpret and not (use_pallas and _on_tpu()):
+    if not interpret and not (use_pallas and _on_tpu() and _dma_ok(D, values.dtype)):
         if values.dtype == jnp.bfloat16:
             key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
             rows = stochastic_round(new_rows, key)

@@ -7,8 +7,6 @@ without TPU hardware.
 """
 import os
 
-# Force CPU: the environment may carry JAX_PLATFORMS=axon (the TPU tunnel),
-# and tests must run on the virtual mesh regardless.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,5 +15,16 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402  (import after env setup)
+
+# Force CPU for real: the TPU tunnel's sitecustomize hook (PYTHONPATH)
+# registers an 'axon' PJRT plugin in every interpreter AND overrides
+# jax_platforms to prefer it, so the env vars above aren't enough — when
+# the tunnel is wedged, the plugin's backend init hangs even a CPU-only
+# test run. Deregister the factory and restore the platform selection
+# before any backend initializes (both no-ops when the hook is absent).
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_threefry_partitionable", True)

@@ -53,6 +53,15 @@ def main(argv=None):
     backend = jax.default_backend()
     if backend != "tpu":
         print(f"WARNING: running on {backend}; TPU is the question", file=sys.stderr)
+    from deeprec_tpu.ops.fused_lookup import _dma_ok
+
+    if not _dma_ok(args.dim, jnp.dtype(args.dtype)):
+        print(
+            f"WARNING: dim={args.dim} dtype={args.dtype} is ineligible for the "
+            "Pallas row-DMA kernels (needs f32, dim%128==0) — the 'pallas' "
+            "rows below fall back to XLA, so the verdict is XLA-vs-XLA",
+            file=sys.stderr,
+        )
 
     C, D, U = 1 << args.capacity, args.dim, args.batch
     dt = jnp.dtype(args.dtype)
