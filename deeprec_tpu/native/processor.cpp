@@ -1,0 +1,184 @@
+// Serving C ABI: the symbol contract external RPC hosts code against.
+//
+// Mirrors the reference's processor ABI
+// (/root/reference/serving/processor/serving/processor.h — initialize /
+// process / batch_process / get_serving_model_info) so a host built for it
+// can dlopen libdeeprec_processor.so unchanged. The implementation is this
+// framework's own: an embedded CPython interpreter forwarding JSON payloads
+// to deeprec_tpu.serving.cabi, where the full serving stack (validation,
+// request coalescing onto the TPU, full/delta hot-swap polling, warmup)
+// lives. Payloads are JSON, not protobuf — the TPU repo's wire choice,
+// documented in cabi.py.
+//
+// Threading: any host thread may call process(); each entry point takes the
+// GIL via PyGILState_Ensure. When this library boots the interpreter itself
+// (a C host), the boot thread releases the GIL afterwards so other threads
+// can enter. When loaded INTO a Python process (ctypes — how the test
+// drives it), Py_IsInitialized() short-circuits the boot.
+//
+// Memory: process()/get_serving_model_info() malloc the output buffer; the
+// caller frees it with free() (or the exported free_buffer alias).
+//
+// Build: make processor   (links against libpython; see Makefile)
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct ProcessorState {
+  PyObject* server;        // deeprec_tpu.serving.ModelServer
+  PyObject* process_fn;    // cabi.process_json
+  PyObject* info_fn;       // cabi.model_info_json
+};
+
+// Copy a Python (status, bytes) tuple into a malloc'd C buffer.
+int unpack_reply(PyObject* res, void** output_data, int* output_size) {
+  if (res == nullptr) {
+    PyErr_Print();
+    return -1;
+  }
+  int status = -1;
+  PyObject* body = nullptr;
+  if (PyTuple_Check(res) && PyTuple_Size(res) == 2) {
+    status = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 0)));
+    body = PyTuple_GetItem(res, 1);  // borrowed
+  }
+  if (body != nullptr && PyBytes_Check(body)) {
+    Py_ssize_t n = PyBytes_Size(body);
+    void* buf = std::malloc(static_cast<size_t>(n));
+    if (buf != nullptr) {
+      std::memcpy(buf, PyBytes_AsString(body), static_cast<size_t>(n));
+      *output_data = buf;
+      *output_size = static_cast<int>(n);
+    } else {
+      status = -1;
+    }
+  } else {
+    status = -1;
+  }
+  Py_DECREF(res);
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+// model_entry: unused slot kept for ABI compatibility (the reference passes
+// a SavedModel path here; this framework's model comes from the config's
+// registry name + ckpt_dir). model_config: JSON, see cabi.create_server.
+// On success *state = 0 and the returned handle is passed to process();
+// on failure returns nullptr and *state = -1.
+void* initialize(const char* model_entry, const char* model_config,
+                 int* state) {
+  (void)model_entry;
+  bool booted_here = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    booted_here = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  ProcessorState* ps = nullptr;
+  PyObject* mod = PyImport_ImportModule("deeprec_tpu.serving.cabi");
+  if (mod != nullptr) {
+    PyObject* create = PyObject_GetAttrString(mod, "create_server");
+    PyObject* server =
+        create ? PyObject_CallFunction(create, "s", model_config) : nullptr;
+    if (server != nullptr) {
+      ps = new ProcessorState();
+      ps->server = server;
+      ps->process_fn = PyObject_GetAttrString(mod, "process_json");
+      ps->info_fn = PyObject_GetAttrString(mod, "model_info_json");
+    }
+    Py_XDECREF(create);
+    Py_DECREF(mod);
+  }
+  if (ps == nullptr) {
+    PyErr_Print();
+  }
+  if (state != nullptr) {
+    *state = ps != nullptr ? 0 : -1;
+  }
+  PyGILState_Release(gil);
+  if (booted_here) {
+    // Release the GIL held by the booting thread so process() may be
+    // called from any host thread.
+    PyEval_SaveThread();
+  }
+  return ps;
+}
+
+// Returns the serving status code (200/400/500, mirroring the HTTP
+// frontend) or -1 on an internal error. *output_data is malloc'd JSON.
+int process(void* model_buf, const void* input_data, int input_size,
+            void** output_data, int* output_size) {
+  if (model_buf == nullptr || output_data == nullptr ||
+      output_size == nullptr) {
+    return -1;
+  }
+  auto* ps = static_cast<ProcessorState*>(model_buf);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallFunction(
+      ps->process_fn, "Oy#", ps->server, static_cast<const char*>(input_data),
+      static_cast<Py_ssize_t>(input_size));
+  int status = unpack_reply(res, output_data, output_size);
+  PyGILState_Release(gil);
+  return status;
+}
+
+// Convenience loop over process(); per-request statuses are not folded —
+// the return is the first non-200 status (0-th order error signal), each
+// output buffer carries its own error body.
+int batch_process(void* model_buf, const void* input_data[], int* input_size,
+                  void* output_data[], int* output_size) {
+  if (input_data == nullptr || input_size == nullptr) {
+    return -1;
+  }
+  int first_bad = 200;
+  for (int i = 0; input_data[i] != nullptr; ++i) {
+    int rc = process(model_buf, input_data[i], input_size[i], &output_data[i],
+                     &output_size[i]);
+    if (rc != 200 && first_bad == 200) {
+      first_bad = rc;
+    }
+  }
+  return first_bad;
+}
+
+int get_serving_model_info(void* model_buf, void** output_data,
+                           int* output_size) {
+  if (model_buf == nullptr) {
+    return -1;
+  }
+  auto* ps = static_cast<ProcessorState*>(model_buf);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallFunction(ps->info_fn, "O", ps->server);
+  int status = unpack_reply(res, output_data, output_size);
+  PyGILState_Release(gil);
+  return status;
+}
+
+void free_buffer(void* buf) { std::free(buf); }
+
+// Stop the coalescing worker and drop the Python references. The
+// interpreter itself is left running (it may be the host's).
+void shutdown_processor(void* model_buf) {
+  if (model_buf == nullptr) {
+    return;
+  }
+  auto* ps = static_cast<ProcessorState*>(model_buf);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* closed = PyObject_CallMethod(ps->server, "close", nullptr);
+  Py_XDECREF(closed);
+  Py_XDECREF(ps->process_fn);
+  Py_XDECREF(ps->info_fn);
+  Py_DECREF(ps->server);
+  PyGILState_Release(gil);
+  delete ps;
+}
+
+}  // extern "C"

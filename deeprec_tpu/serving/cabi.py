@@ -1,0 +1,111 @@
+"""Python side of the serving C ABI (native/processor.cpp).
+
+The reference exposes its serving stack to external RPC frameworks (EAS,
+custom frontends) through a 4-function C ABI —
+``initialize(model_entry, model_config, &state)`` / ``process`` /
+``batch_process`` / ``get_serving_model_info``
+(/root/reference/serving/processor/serving/processor.h). This framework
+keeps the SAME symbol contract so a host written against it can load
+``libdeeprec_processor.so`` instead, with two TPU-repo substitutions:
+the payloads are JSON (the reference's protobuf PredictRequest ->
+``{"features": {...}}``), and the model graph comes from the modelzoo
+registry + a checkpoint dir rather than a SavedModel bundle.
+
+The C layer embeds CPython and forwards to the three functions below; all
+serving logic (validation, coalescing, hot-swap polling, warmup) is the
+ordinary Python stack, so every frontend — HTTP, C ABI, in-process —
+behaves identically.
+
+Config JSON accepted by :func:`create_server` (= the C ``model_config``):
+
+    {
+      "model": "wdl",                  # modelzoo registry name
+      "ckpt_dir": "/path/to/ckpts",    # required
+      "model_args": {"emb_dim": 16, "capacity": 1048576},
+      "max_batch": 256,                # ModelServer coalescing bucket cap
+      "max_wait_ms": 2.0,
+      "poll_secs": 10.0,               # 0 disables background hot-swap
+      "warmup": false                  # precompile every batch bucket
+    }
+"""
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+from deeprec_tpu.serving.predictor import (
+    BadRequest,
+    ModelServer,
+    Predictor,
+    parse_features,
+)
+
+
+def create_server(config_json: str) -> ModelServer:
+    cfg = json.loads(config_json)
+    if "ckpt_dir" not in cfg:
+        raise ValueError("model_config must set 'ckpt_dir'")
+    from deeprec_tpu.models.registry import build_model
+
+    model = build_model(cfg.get("model", "wdl"), **cfg.get("model_args", {}))
+    pred = Predictor(model, cfg["ckpt_dir"])
+    server = ModelServer(
+        pred,
+        max_batch=int(cfg.get("max_batch", 256)),
+        max_wait_ms=float(cfg.get("max_wait_ms", 2.0)),
+        poll_updates_secs=float(cfg.get("poll_secs", 0.0)),
+    )
+    if cfg.get("warmup"):
+        example = _synth_example(pred)
+        server.warmup(example)
+    return server
+
+
+def _synth_example(pred: Predictor) -> dict:
+    """One all-zeros row per feature — enough to trace every bucket shape."""
+    out = {}
+    specs = {f.name: f for f in pred._trainer.sparse_specs}
+    for name, dt in pred.feature_dtypes.items():
+        if dt.kind in "iu":
+            L = specs[name].max_len or 1
+            out[name] = np.zeros((1, L), dt)
+        else:
+            out[name] = np.zeros((1, 1), np.float32)
+    return out
+
+
+def process_json(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:
+    """One request through the coalescing queue. Returns (status, body):
+    200 on success, 400 on a client error, 500 on a serving error — the
+    C return code, mirroring the HTTP frontend's codes."""
+    try:
+        req = json.loads(payload or b"{}")
+    except Exception as e:
+        return 400, json.dumps({"error": f"bad json: {e}"}).encode()
+    try:
+        if not isinstance(req, dict):
+            raise BadRequest("body must be a JSON object")
+        batch = parse_features(server.predictor, req.get("features"))
+    except BadRequest as e:
+        return 400, json.dumps(e.details).encode()
+    except ValueError as e:
+        return 400, json.dumps({"error": str(e)}).encode()
+    try:
+        probs = server.request(batch)
+        out = (
+            {k: np.asarray(v).tolist() for k, v in probs.items()}
+            if isinstance(probs, dict)
+            else np.asarray(probs).tolist()
+        )
+        return 200, json.dumps({"predictions": out}).encode()
+    except Exception as e:
+        return 500, json.dumps({"error": str(e)}).encode()
+
+
+def model_info_json(server: ModelServer) -> Tuple[int, bytes]:
+    try:
+        return 200, json.dumps(server.predictor.model_info()).encode()
+    except Exception as e:
+        return 500, json.dumps({"error": str(e)}).encode()

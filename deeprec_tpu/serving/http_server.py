@@ -26,7 +26,12 @@ from typing import Optional
 
 import numpy as np
 
-from deeprec_tpu.serving.predictor import ModelServer, Predictor
+from deeprec_tpu.serving.predictor import (
+    BadRequest,
+    ModelServer,
+    Predictor,
+    parse_features,
+)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -70,61 +75,15 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(404, {"error": f"unknown path {self.path}"})
         if not isinstance(payload, dict):
             return self._send(400, {"error": "body must be a JSON object"})
-        feats = payload.get("features")
-        if not isinstance(feats, dict) or not feats:
-            return self._send(400, {"error": "missing 'features' object"})
-        pred = self.model_server.predictor
-        dtypes = pred.feature_dtypes
-        # Validate BEFORE enqueueing: the coalescer concatenates requests,
-        # so one bad request must not poison everyone batched with it.
-        unknown = sorted(set(feats) - set(dtypes))
-        missing = sorted(set(dtypes) - set(feats))
-        if unknown or missing:
-            return self._send(400, {
-                "error": "feature-name mismatch",
-                "unknown": unknown, "missing": missing,
-            })
-        specs = {f.name: f for f in pred._trainer.sparse_specs}
         try:
-            batch = {}
-            for k, v in feats.items():
-                want = dtypes[k]
-                if want.kind in "iu":
-                    f = specs[k]
-                    L = f.max_len
-                    if L and isinstance(v, list) and v and isinstance(v[0], list):
-                        # ragged id bags: pad/trim each row to the declared
-                        # length with the feature's pad value — one compiled
-                        # shape per feature, not one per organic list length
-                        rows = [
-                            (r + [f.pad_value] * (L - len(r)))[:L] for r in v
-                        ]
-                        arr = np.asarray(rows, want)
-                    else:
-                        arr = np.asarray(v).astype(want)
-                        if L:
-                            if arr.ndim == 1:
-                                arr = arr[:, None]
-                            if arr.shape[1] < L:
-                                pad = np.full(
-                                    (arr.shape[0], L - arr.shape[1]),
-                                    f.pad_value, want,
-                                )
-                                arr = np.concatenate([arr, pad], axis=1)
-                            else:
-                                arr = arr[:, :L]
-                else:
-                    arr = np.asarray(v).astype(np.float32)
-                    if arr.ndim == 1:
-                        arr = arr[:, None]  # dense features are [B, W]
-                batch[k] = arr
-            rows = {k: a.shape[0] for k, a in batch.items()}
-            if len(set(rows.values())) > 1:
-                # inconsistent row counts would poison every request the
-                # coalescer batches this one with — reject it up front
-                return self._send(400, {
-                    "error": "inconsistent feature row counts", "rows": rows,
-                })
+            batch = parse_features(
+                self.model_server.predictor, payload.get("features")
+            )
+        except BadRequest as e:
+            return self._send(400, e.details)
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        try:
             probs = self.model_server.request(batch)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}

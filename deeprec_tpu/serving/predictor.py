@@ -31,6 +31,74 @@ from deeprec_tpu.training.checkpoint import CheckpointManager
 from deeprec_tpu.training.trainer import Trainer, TrainState
 
 
+class BadRequest(ValueError):
+    """Client-side request error, with a structured payload for frontends
+    that return machine-readable error bodies (HTTP, C ABI)."""
+
+    def __init__(self, message: str, **details):
+        super().__init__(message)
+        self.details = {"error": message, **details}
+
+
+def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]:
+    """Validate + coerce a wire-format feature dict (JSON-shaped lists or
+    arrays) into a model batch. Shared by every frontend (HTTP, C ABI):
+    validates BEFORE the coalescing queue so one bad request can't poison
+    the requests batched with it. Raises ValueError with a client-facing
+    message.
+
+    Rules: id features pad/trim ragged bags to the feature's declared
+    max_len with its pad value (one compiled shape per feature, not one per
+    organic list length); dense features become [B, W] float32; all
+    features must agree on the row count."""
+    if not isinstance(feats, dict) or not feats:
+        raise BadRequest("missing 'features' object")
+    dtypes = predictor.feature_dtypes
+    unknown = sorted(set(feats) - set(dtypes))
+    missing = sorted(set(dtypes) - set(feats))
+    if unknown or missing:
+        raise BadRequest("feature-name mismatch", unknown=unknown,
+                         missing=missing)
+    specs = {f.name: f for f in predictor._trainer.sparse_specs}
+    batch = {}
+    for k, v in feats.items():
+        want = dtypes[k]
+        try:
+            if want.kind in "iu":
+                f = specs[k]
+                L = f.max_len
+                if L and isinstance(v, list) and v and isinstance(v[0], list):
+                    rows = [(r + [f.pad_value] * (L - len(r)))[:L] for r in v]
+                    arr = np.asarray(rows, want)
+                else:
+                    arr = np.asarray(v).astype(want)
+                    if L:
+                        if arr.ndim == 1:
+                            arr = arr[:, None]
+                        if arr.shape[1] < L:
+                            pad = np.full(
+                                (arr.shape[0], L - arr.shape[1]), f.pad_value,
+                                want,
+                            )
+                            arr = np.concatenate([arr, pad], axis=1)
+                        else:
+                            arr = arr[:, :L]
+            else:
+                arr = np.asarray(v).astype(np.float32)
+                if arr.ndim == 1:
+                    arr = arr[:, None]  # dense features are [B, W]
+        except (TypeError, ValueError) as e:
+            # numpy coercion of garbage values raises TypeError — still the
+            # CLIENT's fault, so surface it as a request error, not a crash
+            raise BadRequest(f"feature {k!r}: cannot coerce to {want}: {e}",
+                             feature=k) from e
+        batch[k] = arr
+    rows = {k: a.shape[0] for k, a in batch.items()}
+    if len(set(rows.values())) > 1:
+        raise BadRequest("inconsistent feature row counts", rows=rows)
+    return batch
+
+
 class Predictor:
     """Load-latest-and-serve. Thread-safe; updates swap atomically.
 
