@@ -34,14 +34,32 @@ from deeprec_tpu.serving.predictor import (
 )
 
 
+def instances_to_features(instances) -> dict:
+    """TF-Serving row-major request body -> this stack's column-major
+    features: [{"f1": v, ...}, ...] -> {"f1": [v, ...], ...}."""
+    if not isinstance(instances, list) or not instances:
+        raise BadRequest("'instances' must be a non-empty list")
+    if not all(isinstance(r, dict) for r in instances):
+        raise BadRequest("each instance must be an object of named features")
+    names = set(instances[0])
+    if any(set(r) != names for r in instances):
+        raise BadRequest("instances disagree on feature names")
+    return {k: [r[k] for r in instances] for k in names}
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "deeprec-tpu-serving/1.0"
 
     # set by HttpServer
-    model_server: ModelServer = None
+    servers: dict = None  # name -> ModelServer
+    default: str = None
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
+
+    @property
+    def model_server(self) -> ModelServer:
+        return self.servers[self.default]
 
     def _send(self, code: int, payload) -> None:
         body = json.dumps(payload).encode()
@@ -51,13 +69,44 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _named(self, name: str) -> Optional[ModelServer]:
+        srv = self.servers.get(name)
+        if srv is None:
+            self._send(404, {"error": f"unknown model {name!r}",
+                             "models": sorted(self.servers)})
+        return srv
+
     def do_GET(self):
         if self.path == "/healthz":
             self._send(200, "ok")
         elif self.path == "/v1/model_info":
             self._send(200, self.model_server.predictor.model_info())
+        elif self.path == "/v1/models":
+            self._send(200, {"models": sorted(self.servers)})
+        elif self.path.startswith("/v1/models/"):
+            # TF-Serving REST model-status shape, so TFS clients can point
+            # here unchanged: GET /v1/models/<name>
+            srv = self._named(self.path[len("/v1/models/"):])
+            if srv is not None:
+                self._send(200, {"model_version_status": [{
+                    "version": str(srv.predictor.step),
+                    "state": "AVAILABLE",
+                    "status": {"error_code": "OK", "error_message": ""},
+                }]})
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
+
+    def _route_post(self):
+        """(server, verb) for a POST path: the single-model back-compat
+        routes (/v1/predict, /v1/reload) hit the default model; the
+        TF-Serving shape (/v1/models/<name>:predict|:reload) names one."""
+        if self.path in ("/v1/predict", "/v1/reload"):
+            return self.model_server, self.path.rsplit("/", 1)[-1]
+        if self.path.startswith("/v1/models/") and ":" in self.path:
+            name, verb = self.path[len("/v1/models/"):].rsplit(":", 1)
+            return self._named(name), verb
+        self._send(404, {"error": f"unknown path {self.path}"})
+        return None, None
 
     def do_POST(self):
         try:
@@ -65,26 +114,30 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(n) or b"{}")
         except Exception as e:
             return self._send(400, {"error": f"bad json: {e}"})
-        if self.path == "/v1/reload":
+        server, verb = self._route_post()
+        if server is None:
+            return  # 404 already sent
+        if verb == "reload":
             try:
-                updated = bool(self.model_server.predictor.poll_updates())
+                updated = bool(server.predictor.poll_updates())
             except Exception as e:  # corrupt/partial checkpoint: report it
                 return self._send(500, {"error": str(e)})
             return self._send(200, {"updated": updated})
-        if self.path != "/v1/predict":
-            return self._send(404, {"error": f"unknown path {self.path}"})
+        if verb != "predict":
+            return self._send(404, {"error": f"unknown verb {verb!r}"})
         if not isinstance(payload, dict):
             return self._send(400, {"error": "body must be a JSON object"})
         try:
-            batch = parse_features(
-                self.model_server.predictor, payload.get("features")
-            )
+            feats = payload.get("features")
+            if feats is None and "instances" in payload:
+                feats = instances_to_features(payload["instances"])
+            batch = parse_features(server.predictor, feats)
         except BadRequest as e:
             return self._send(400, e.details)
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         try:
-            probs = self.model_server.request(batch)
+            probs = server.request(batch)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}
             else:
@@ -95,12 +148,24 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    """Bind a ModelServer to a TCP port. start() is non-blocking."""
+    """Bind one ModelServer — or a {name: ModelServer} dict for multi-model
+    serving — to a TCP port. start() is non-blocking. With a dict, the
+    TF-Serving routes address each model by name and the bare routes hit
+    `default_model` (first name if unset)."""
 
-    def __init__(self, model_server: ModelServer, port: int = 8500,
-                 host: str = "127.0.0.1"):
+    def __init__(self, model_server, port: int = 8500,
+                 host: str = "127.0.0.1", default_model: Optional[str] = None):
+        if isinstance(model_server, ModelServer):
+            servers = {"default": model_server}
+        else:
+            servers = dict(model_server)
+        if not servers:
+            raise ValueError("need at least one ModelServer")
+        default = default_model or next(iter(servers))
+        if default not in servers:
+            raise ValueError(f"default_model {default!r} not in {sorted(servers)}")
         handler = type("BoundHandler", (_Handler,),
-                       {"model_server": model_server})
+                       {"servers": servers, "default": default})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]  # resolved if port=0
         self._thread: Optional[threading.Thread] = None
@@ -123,9 +188,14 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--ckpt", required=True, help="checkpoint directory")
+    p.add_argument("--ckpt", help="checkpoint directory (single-model mode)")
     p.add_argument("--model", default="wdl",
                    help="modelzoo model name (see deeprec_tpu.models)")
+    p.add_argument("--serve", action="append", default=[],
+                   help="multi-model: JSON per model, repeatable — "
+                        '\'{"name": "wdl-a", "model": "wdl", "ckpt_dir": '
+                        '"...", "model_args": {...}}\' (same config schema '
+                        "as the serving C ABI, serving/cabi.py)")
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--max_batch", type=int, default=256)
@@ -135,16 +205,34 @@ def main(argv=None):
                    help="must match the trained checkpoint's table capacity")
     args = p.parse_args(argv)
 
-    from deeprec_tpu.models.registry import build_model
+    if args.serve:
+        from deeprec_tpu.serving.cabi import create_server
 
-    model = build_model(args.model, emb_dim=args.emb_dim,
-                        capacity=args.capacity)
-    pred = Predictor(model, args.ckpt)
-    ms = ModelServer(pred, max_batch=args.max_batch,
-                     poll_updates_secs=args.poll_secs)
-    srv = HttpServer(ms, port=args.port, host=args.host)
-    print(f"serving {args.model} from {args.ckpt} on "
-          f"http://{args.host}:{srv.port}")
+        servers = {}
+        for spec in args.serve:
+            cfg = json.loads(spec)
+            name = cfg.pop("name", None) or cfg.get("model", "default")
+            if name in servers:
+                p.error(f"duplicate --serve name {name!r}: set a distinct "
+                        '"name" per model')
+            cfg.setdefault("max_batch", args.max_batch)
+            cfg.setdefault("poll_secs", args.poll_secs)
+            servers[name] = create_server(json.dumps(cfg))
+        srv = HttpServer(servers, port=args.port, host=args.host)
+        print(f"serving {sorted(servers)} on http://{args.host}:{srv.port}")
+    else:
+        if not args.ckpt:
+            p.error("--ckpt is required without --serve")
+        from deeprec_tpu.models.registry import build_model
+
+        model = build_model(args.model, emb_dim=args.emb_dim,
+                            capacity=args.capacity)
+        pred = Predictor(model, args.ckpt)
+        ms = ModelServer(pred, max_batch=args.max_batch,
+                         poll_updates_secs=args.poll_secs)
+        srv = HttpServer(ms, port=args.port, host=args.host)
+        print(f"serving {args.model} from {args.ckpt} on "
+              f"http://{args.host}:{srv.port}")
     srv.start()
     try:
         threading.Event().wait()

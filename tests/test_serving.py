@@ -349,3 +349,81 @@ def test_model_server_batches_concurrent_requests(tmp_path):
     # all identical inputs -> identical outputs
     vals = np.asarray([float(r[0]) for r in results])
     np.testing.assert_allclose(vals, vals[0], atol=1e-6)
+
+
+def test_multi_model_tfs_routes(tmp_path):
+    """Multi-model serving over the TF-Serving REST shapes: two separately
+    trained models behind one port, addressed by name; row-major
+    'instances' bodies; model status; per-model reload."""
+    import json
+    import urllib.request
+    import urllib.error
+
+    from deeprec_tpu.serving import HttpServer
+
+    dirs = {n: tmp_path / n for n in ("alpha", "beta")}
+    trained = {n: make_trained(d, steps=3 if n == "alpha" else 6)
+               for n, d in dirs.items()}
+    servers = {
+        n: ModelServer(Predictor(t[0], str(dirs[n])), max_batch=32,
+                       max_wait_ms=1)
+        for n, t in trained.items()
+    }
+    http = HttpServer(servers, port=0, default_model="alpha").start()
+    base = f"http://127.0.0.1:{http.port}"
+
+    def call(path, payload=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="GET" if payload is None else "POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        assert call("/v1/models")["models"] == ["alpha", "beta"]
+        # TFS model-status route reports each model's own version
+        assert call("/v1/models/alpha")["model_version_status"][0]["version"] == "3"
+        assert call("/v1/models/beta")["model_version_status"][0]["version"] == "6"
+
+        batches = trained["alpha"][4]
+        feats = {k: np.asarray(v)[:3].tolist()
+                 for k, v in strip_labels(batches[0]).items()}
+        # column-major per-model predict
+        pa = call("/v1/models/alpha:predict", {"features": feats})["predictions"]
+        pb = call("/v1/models/beta:predict", {"features": feats})["predictions"]
+        assert len(pa) == len(pb) == 3
+        assert np.abs(np.asarray(pa) - np.asarray(pb)).max() > 1e-6  # distinct models
+        # bare route hits the default model
+        pd = call("/v1/predict", {"features": feats})["predictions"]
+        np.testing.assert_allclose(pd, pa, atol=1e-6)
+
+        # TFS row-major instances body == column-major features body
+        instances = [
+            {k: feats[k][i] for k in feats} for i in range(3)
+        ]
+        pi = call("/v1/models/alpha:predict", {"instances": instances})["predictions"]
+        np.testing.assert_allclose(pi, pa, atol=1e-6)
+
+        # per-model reload: advance beta only; alpha's step is untouched
+        model, tr, st, ck = trained["beta"][:4]
+        for _ in range(2):
+            st, _ = tr.train_step(st, trained["beta"][4][0])
+        st, _ = ck.save_incremental(st)
+        assert call("/v1/models/beta:reload", {})["updated"] is True
+        assert call("/v1/models/beta")["model_version_status"][0]["version"] == "8"
+        assert call("/v1/models/alpha")["model_version_status"][0]["version"] == "3"
+
+        # unknown model -> 404 with the catalog
+        try:
+            call("/v1/models/nope:predict", {"features": feats})
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read())["models"] == ["alpha", "beta"]
+    finally:
+        http.stop()
+        for s in servers.values():
+            s.close()
