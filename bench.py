@@ -6,6 +6,13 @@ available device, batch 2048, reference protocol mean(steps/sec) × batch
 reference's best published DLRM number: 188.11 global steps/sec × bs 2048 =
 385,249 examples/sec on 1×A100-80G + 64-core Xeon
 (docs/docs_en/Smart-Stage.md:182-190, see BASELINE.md).
+
+The TPU behind the axon tunnel is intermittent, so the harness probes with
+retries across a window (BENCH_PROBE_ATTEMPTS × BENCH_PROBE_TIMEOUT, default
+5 × 120s with 30s between failures, ~13 min worst case) and records probe
+diagnostics in the JSON ("tpu": "ok" | "unreachable: <last error>") so a CPU
+fallback is self-describing. The measured workload runs in a subprocess so a
+tunnel that wedges mid-run degrades to the CPU number instead of hanging.
 """
 import json
 import os
@@ -15,31 +22,79 @@ import time
 
 BASELINE_EXAMPLES_PER_SEC = 188.11 * 2048  # DLRM GPU SmartStage, BASELINE.md
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256));"
+    "print((x @ x).sum(), jax.devices()[0].platform)"
+)
 
-def _tpu_alive(timeout: int = 90) -> bool:
-    """Probe the TPU in a subprocess so a wedged tunnel can't hang the
-    benchmark itself."""
+
+def _probe_once(timeout: int):
+    """One TPU liveness attempt in a subprocess. Returns (ok, diagnostic)."""
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "x = jnp.ones((256, 256));"
-             "print((x @ x).sum())"],
-            timeout=timeout, capture_output=True,
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout, capture_output=True, text=True,
         )
-        return r.returncode == 0
+        if r.returncode == 0:
+            # jax can silently init on CPU (JAX_PLATFORMS=cpu in the env, or
+            # the tunnel's TPU runtime absent); that is NOT a live TPU.
+            platform = (r.stdout or "").strip().split()[-1:]
+            if platform == ["tpu"]:
+                return True, "ok"
+            # Deterministic verdict (this host resolves to cpu/gpu): not a
+            # transient tunnel failure — tell the caller not to retry.
+            return False, "notpu: probe ran on %s, not tpu" % (
+                platform[0] if platform else "?")
+        tail = (r.stderr or r.stdout or "").strip().splitlines()
+        return False, "rc=%d: %s" % (r.returncode, tail[-1][-200:] if tail else "")
     except subprocess.TimeoutExpired:
-        return False
+        return False, "probe timed out after %ds" % timeout
 
 
-def main():
-    if os.environ.get("BENCH_FORCED") != "1" and not _tpu_alive():
-        # TPU unreachable: rerun self on CPU so the harness still gets its
-        # JSON line (the value then reflects CPU, not TPU, throughput).
-        env = dict(os.environ)
-        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "", "BENCH_FORCED": "1"})
-        sys.stderr.write("bench: TPU unreachable, falling back to CPU\n")
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+def _probe_with_retry():
+    """Retry the probe across a window; the tunnel is known-intermittent."""
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
+    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    wait = int(os.environ.get("BENCH_PROBE_WAIT", "30"))
+    diag = "no attempts"
+    for i in range(attempts):
+        ok, diag = _probe_once(timeout)
+        if ok:
+            return True, "ok (attempt %d/%d)" % (i + 1, attempts)
+        sys.stderr.write("bench: probe %d/%d failed: %s\n" % (i + 1, attempts, diag))
+        if diag.startswith("notpu:"):
+            return False, "unreachable: " + diag[len("notpu: "):]
+        if i + 1 < attempts:
+            time.sleep(wait)
+    return False, "unreachable: " + diag
+
+
+def _run_worker(extra_env, timeout):
+    """Run the measured workload in a subprocess; return parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["BENCH_WORKER"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "workload timed out after %ds" % timeout
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return None, "workload rc=%d: %s" % (r.returncode, tail[-1][-200:] if tail else "")
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except ValueError:
+            continue
+    return None, "workload produced no JSON"
+
+
+def workload():
+    """The measured DLRM step loop. Runs on whatever platform jax resolves."""
     import jax
     import jax.numpy as jnp
 
@@ -84,10 +139,48 @@ def main():
                 "value": round(ex_per_sec, 1),
                 "unit": "examples/sec",
                 "vs_baseline": round(ex_per_sec / BASELINE_EXAMPLES_PER_SEC, 4),
+                "device": jax.devices()[0].platform,
             }
         )
     )
 
 
+def main():
+    if os.environ.get("BENCH_FORCED") == "1":
+        # CI / smoke path: skip the (many-minute) probe window and measure
+        # on whatever platform jax resolves in this environment.
+        workload()
+        return
+    ok, probe_diag = _probe_with_retry()
+    result, err = None, None
+    if ok:
+        # Pin the platform: if the tunnel drops between probe and worker,
+        # jax must fail loudly (rc!=0 -> clean CPU fallback), not silently
+        # init on CPU and mislabel a CPU number as a TPU measurement.
+        result, err = _run_worker(
+            {"JAX_PLATFORMS": "tpu"},
+            timeout=int(os.environ.get("BENCH_TPU_TIMEOUT", "900")))
+        if result is not None and result.get("device") != "tpu":
+            result, err = None, "worker ran on %s" % result.get("device")
+        if result is None:
+            probe_diag = "came up then failed: " + err
+            sys.stderr.write("bench: TPU workload failed (%s), falling back to CPU\n" % err)
+    if result is None:
+        sys.stderr.write("bench: TPU %s, falling back to CPU\n" % probe_diag)
+        result, err = _run_worker(
+            {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}, timeout=1800)
+    if result is None:
+        result = {
+            "metric": "dlrm_criteo_examples_per_sec", "value": 0.0,
+            "unit": "examples/sec", "vs_baseline": 0.0,
+            "device": "none", "error": err,
+        }
+    result["tpu"] = probe_diag
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_WORKER") == "1":
+        workload()
+    else:
+        main()

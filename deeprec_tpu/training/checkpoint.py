@@ -426,6 +426,10 @@ class CheckpointManager:
         path = os.path.join(self.dir, f"{kind}-{step}")
         write = self._is_writer()
         parts = self._use_parts()
+        # The manifest at this path is about to change (clear + rewrite);
+        # drop any cached copy so a later restore() on this manager
+        # validates against the new one.
+        getattr(self, "_manifest_cache", {}).pop(path, None)
         try:
             if write or parts:
                 os.makedirs(path, exist_ok=True)
@@ -433,7 +437,27 @@ class CheckpointManager:
                 # Pod-scale path: every process writes ONLY its addressable
                 # shards' rows — no process_allgather, no host ever holds a
                 # table it doesn't own a shard of.
+                #
+                # A crashed earlier attempt at this step (no manifest written)
+                # can leave part files behind — including pids beyond this
+                # run's process_count after an elastic downscale, or gathered
+                # single files from a pre-rescale save that would shadow the
+                # fresh parts on restore. Restore globs part*.npz, so stale
+                # files would be silently merged: the writer clears the
+                # manifest FIRST (so a crash mid-clear/mid-write leaves an
+                # incomplete dir that _list() ignores, not a dir that
+                # restores empty), then every table file, behind a barrier,
+                # before anyone writes.
                 pid = jax.process_index()
+                if write:
+                    import glob as _glob
+                    mf = os.path.join(path, "manifest.json")
+                    if os.path.exists(mf):
+                        os.remove(mf)
+                    # table_*.npz matches gathered AND .partNNNNN.npz files
+                    for stale in _glob.glob(os.path.join(path, "table_*.npz")):
+                        os.remove(stale)
+                self._sync(f"ckpt-{kind}-{step}-clear")
                 for bname in self.trainer.bundles:
                     exported = self._export_bundle_parts(
                         state, bname, kind == "incr"
@@ -731,14 +755,54 @@ class CheckpointManager:
             _glob.glob(os.path.join(path, f"table_{bname}_{tag}.part*.npz"))
         )
 
+    def _manifest(self, path: str) -> dict:
+        """The dir's manifest, cached per path (restore re-enters per
+        bundle × member × chain dir; don't re-parse each time)."""
+        cache = getattr(self, "_manifest_cache", None)
+        if cache is None:
+            cache = self._manifest_cache = {}
+        if path not in cache:
+            try:
+                with open(os.path.join(path, "manifest.json")) as f:
+                    cache[path] = json.load(f)
+            except OSError:
+                cache[path] = {}  # pre-manifest legacy dir
+            except ValueError as e:
+                # A manifest that EXISTS but doesn't parse is a torn write;
+                # degrading to {} would disable exactly the stale/partial
+                # validation this dir needs. Fail the dir instead.
+                raise ValueError(
+                    f"checkpoint {path}: manifest.json exists but is "
+                    f"unparseable ({e}) — torn save; refusing to restore"
+                )
+        return cache[path]
+
     def _iter_part_rows(self, path: str, bname: str, tag: str):
         """Yield row dicts for one table from a checkpoint dir, one file at
-        a time (bounded memory) — a single gathered file or N part files."""
+        a time (bounded memory) — a single gathered file or N part files.
+        Validates the part-file count against the manifest so a stale or
+        partial save fails loudly instead of merging duplicate rows. Zero
+        files is tolerated only for bundles the manifest doesn't declare
+        (restoring a checkpoint that predates a newly added table)."""
+        mf = self._manifest(path)
         single = os.path.join(path, f"table_{bname}_{tag}.npz")
-        if os.path.exists(single):
+        # In a parts-format dir a gathered file can only be stale residue
+        # (pre-rescale save at the same step) — never prefer it.
+        if mf.get("format") != "parts" and os.path.exists(single):
             yield dict(np.load(single))
             return
-        for pf in self._part_files(path, bname, tag):
+        files = self._part_files(path, bname, tag)
+        expected = mf.get("parts")
+        declared = bname in mf.get("bundles", {})
+        if expected is not None and len(files) != expected and (
+            files or declared
+        ):
+            raise ValueError(
+                f"checkpoint {path}: {len(files)} part files for table "
+                f"{bname}/{tag} but manifest records {expected} — stale or "
+                f"partial save; refusing to merge"
+            )
+        for pf in files:
             yield dict(np.load(pf))
 
     def _load_rows(self, path: str, bname: str, tag: str):

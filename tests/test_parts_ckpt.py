@@ -8,6 +8,7 @@ split in two, as two processes would write it). The multi-PROCESS path
 itself is exercised end-to-end by tests/test_launch.py, which now saves
 parts automatically (process_count > 1)."""
 import glob
+import json
 import os
 import shutil
 
@@ -190,9 +191,50 @@ def test_parts_multi_writer_simulation(tmp_path):
         np.savez(base + "00000.npz", **halves[0])
         np.savez(base + "00001.npz", **halves[1])
 
+    # A real 2-process save records parts=2; restore validates the count.
+    mf_path = os.path.join(path, "manifest.json")
+    with open(mf_path) as f:
+        mf = json.load(f)
+    mf["parts"] = 2
+    with open(mf_path, "w") as f:
+        json.dump(mf, f)
+
     tr2 = ShardedTrainer(small(), Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
     st2 = CheckpointManager(str(tmp_path), tr2, sharded_io=True).restore()
     _, p2 = tr2.eval_step(st2, shard_batch(mesh, batches[0]))
     np.testing.assert_array_equal(np.asarray(p8), np.asarray(p2))
+    m1, m2 = _key_value_map(tr, st), _key_value_map(tr2, st2)
+    assert set(m1) == set(m2)
+
+
+def test_parts_stale_file_refused_and_cleared(tmp_path):
+    """A part file left by a crashed earlier attempt (e.g. from a larger
+    pre-downscale topology) must make restore fail loudly, and a re-save at
+    the same step must clear it rather than letting it merge silently."""
+    mesh = make_mesh(8)
+    tr, st, batches = _trained(mesh)
+    ck = CheckpointManager(str(tmp_path), tr, sharded_io=True)
+    _, path = ck.save(st)
+
+    # Plant a stale part (as pid 7 of a crashed wider run would leave).
+    bname = next(iter(tr.bundles))
+    real = glob.glob(os.path.join(path, f"table_{bname}_*.part00000.npz"))[0]
+    tag = os.path.basename(real).split("_")[-1].split(".part")[0]
+    stale = real.replace(".part00000.npz", ".part00007.npz")
+    shutil.copy(real, stale)
+
+    tr2 = ShardedTrainer(small(), Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
+    ck2 = CheckpointManager(str(tmp_path), tr2, sharded_io=True)
+    try:
+        ck2.restore()
+        raise AssertionError("restore merged a stale part file")
+    except ValueError as e:
+        assert "stale or partial" in str(e)
+
+    # A fresh save at the same step clears the stale file first.
+    _, path2 = ck.save(st)
+    assert path2 == path
+    assert not os.path.exists(stale)
+    st2 = ck2.restore()
     m1, m2 = _key_value_map(tr, st), _key_value_map(tr2, st2)
     assert set(m1) == set(m2)

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Probe the axon TPU tunnel once; append result to /tmp/tpu_probe.log
+TS=$(date +%H:%M:%S)
+OUT=$(timeout 90 python -c "import jax; d=jax.devices(); print('UP', d)" 2>&1 | tail -2)
+if echo "$OUT" | grep -q "^UP"; then
+  echo "$TS UP $OUT" >> /tmp/tpu_probe.log
+else
+  echo "$TS DOWN" >> /tmp/tpu_probe.log
+fi
