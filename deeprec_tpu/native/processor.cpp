@@ -112,36 +112,87 @@ void* initialize(const char* model_entry, const char* model_config,
   return ps;
 }
 
-// Returns the serving status code (200/400/500, mirroring the HTTP
-// frontend) or -1 on an internal error. *output_data is malloc'd JSON.
-int process(void* model_buf, const void* input_data, int input_size,
-            void** output_data, int* output_size) {
+int get_serving_model_info(void* model_buf, void** output_data,
+                           int* output_size);
+
+// The predict path without the empty-payload ping (batch_process_n keeps
+// per-request 400 semantics for a zero-size request).
+static int process_predict(void* model_buf, const void* input_data,
+                           int input_size, void** output_data,
+                           int* output_size) {
   if (model_buf == nullptr || output_data == nullptr ||
       output_size == nullptr) {
     return -1;
   }
   auto* ps = static_cast<ProcessorState*>(model_buf);
+  static const char kEmpty[] = "";
+  const char* data =
+      input_data != nullptr ? static_cast<const char*>(input_data) : kEmpty;
+  int size = input_data != nullptr ? input_size : 0;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* res = PyObject_CallFunction(
-      ps->process_fn, "Oy#", ps->server, static_cast<const char*>(input_data),
-      static_cast<Py_ssize_t>(input_size));
+      ps->process_fn, "Oy#", ps->server, data,
+      static_cast<Py_ssize_t>(size));
   int status = unpack_reply(res, output_data, output_size);
   PyGILState_Release(gil);
   return status;
 }
 
-// Convenience loop over process(); per-request statuses are not folded —
-// the return is the first non-200 status (0-th order error signal), each
-// output buffer carries its own error body.
+// Returns the serving status code (200/400/500, mirroring the HTTP
+// frontend) or -1 on an internal error. *output_data is malloc'd JSON.
+// input_size == 0 mirrors the reference (processor.cc:29-34): the model's
+// debug/serving info is returned with status 200 — hosts use an empty
+// payload as a liveness + introspection ping.
+int process(void* model_buf, const void* input_data, int input_size,
+            void** output_data, int* output_size) {
+  if (input_size == 0) {
+    return get_serving_model_info(model_buf, output_data, output_size);
+  }
+  return process_predict(model_buf, input_data, input_size, output_data,
+                         output_size);
+}
+
+// Reference-ABI batch entry point. The ABI has no request count anywhere
+// (processor.h:8), and the reference implementation resolves that with
+// `sizeof(input_data)/sizeof(void*)` (message_coding.cc:79) — i.e. it
+// ALWAYS processes exactly one request, whatever the host meant to pass.
+// Hosts coded against the reference therefore observe batch-of-1
+// semantics, and they do NOT null-terminate the array, so walking it here
+// would read out of bounds. We match the observable reference behavior:
+// exactly one request. A null input_size mirrors the reference's
+// `if (input_size == 0)` pointer check: return model debug info. Hosts
+// that want real batching use batch_process_n (explicit count, below).
 int batch_process(void* model_buf, const void* input_data[], int* input_size,
                   void* output_data[], int* output_size) {
+  if (model_buf == nullptr || output_data == nullptr ||
+      output_size == nullptr) {
+    return -1;
+  }
   if (input_data == nullptr || input_size == nullptr) {
+    return get_serving_model_info(model_buf, &output_data[0],
+                                  &output_size[0]);
+  }
+  return process(model_buf, input_data[0], input_size[0], &output_data[0],
+                 &output_size[0]);
+}
+
+// Extension (not in the reference ABI): batch with an explicit request
+// count. Per-request statuses are not folded — the return is the first
+// non-200 status, each output buffer carries its own error body. An empty
+// (size-0) request is a client error for its slot, not an info ping — the
+// ping semantic belongs to the single-request reference entry points only.
+int batch_process_n(void* model_buf, const void* input_data[],
+                    int* input_size, int num_requests, void* output_data[],
+                    int* output_size) {
+  if (model_buf == nullptr || input_data == nullptr ||
+      input_size == nullptr || output_data == nullptr ||
+      output_size == nullptr) {
     return -1;
   }
   int first_bad = 200;
-  for (int i = 0; input_data[i] != nullptr; ++i) {
-    int rc = process(model_buf, input_data[i], input_size[i], &output_data[i],
-                     &output_size[i]);
+  for (int i = 0; i < num_requests; ++i) {
+    int rc = process_predict(model_buf, input_data[i], input_size[i],
+                             &output_data[i], &output_size[i]);
     if (rc != 200 && first_bad == 200) {
       first_bad = rc;
     }
@@ -151,7 +202,8 @@ int batch_process(void* model_buf, const void* input_data[], int* input_size,
 
 int get_serving_model_info(void* model_buf, void** output_data,
                            int* output_size) {
-  if (model_buf == nullptr) {
+  if (model_buf == nullptr || output_data == nullptr ||
+      output_size == nullptr) {
     return -1;
   }
   auto* ps = static_cast<ProcessorState*>(model_buf);

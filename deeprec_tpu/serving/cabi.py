@@ -67,12 +67,17 @@ def _synth_example(pred: Predictor) -> dict:
     """One all-zeros row per feature — enough to trace every bucket shape."""
     out = {}
     specs = {f.name: f for f in pred._trainer.sparse_specs}
+    dense = {f.name: f for f in pred._trainer.dense_specs}
     for name, dt in pred.feature_dtypes.items():
         if dt.kind in "iu":
             L = specs[name].max_len or 1
             out[name] = np.zeros((1, L), dt)
         else:
-            out[name] = np.zeros((1, 1), np.float32)
+            # Warmup must trace the REAL dense width, not assume 1 — a
+            # width-W feature warmed at width 1 would compile a useless
+            # bucket and recompile (or fail) on the first live request.
+            w = dense[name].width if name in dense else 1
+            out[name] = np.zeros((1, w), np.float32)
     return out
 
 

@@ -157,6 +157,9 @@ def test_model_info_and_hot_swap(served):
 
 
 def test_batch_process(served):
+    """Reference-ABI batch_process: batch-of-1 semantics (the reference's
+    sizeof(input_data)/sizeof(void*) always yields 1, message_coding.cc:79),
+    and NO null terminator — reference hosts don't write one."""
     lib, handle, tr, st, ck, batches = served
     b0 = {k: np.asarray(v)[:4] for k, v in batches[0].items()
           if k != "label"}
@@ -164,15 +167,68 @@ def test_batch_process(served):
         {"features": {k: v.tolist() for k, v in b0.items()}}
     ).encode()
     n_req = 3
-    inputs = (ctypes.c_char_p * (n_req + 1))(
-        *([payload] * n_req), None
-    )
+    inputs = (ctypes.c_char_p * n_req)(*([payload] * n_req))
     sizes = (ctypes.c_int * n_req)(*([len(payload)] * n_req))
     outputs = (ctypes.c_void_p * n_req)()
     out_sizes = (ctypes.c_int * n_req)()
     rc = lib.batch_process(handle, inputs, sizes, outputs, out_sizes)
     assert rc == 200
+    body = json.loads(ctypes.string_at(outputs[0], out_sizes[0]))
+    assert len(body["predictions"]) == 4
+    lib.free_buffer(outputs[0])
+    assert not outputs[1] and not outputs[2]  # only request 0 processed
+
+
+def test_batch_process_n(served):
+    """Extension entry point: explicit request count, real batching."""
+    lib, handle, tr, st, ck, batches = served
+    lib.batch_process_n.restype = ctypes.c_int
+    lib.batch_process_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+    ]
+    b0 = {k: np.asarray(v)[:4] for k, v in batches[0].items()
+          if k != "label"}
+    payload = json.dumps(
+        {"features": {k: v.tolist() for k, v in b0.items()}}
+    ).encode()
+    n_req = 3
+    inputs = (ctypes.c_char_p * n_req)(*([payload] * n_req))
+    sizes = (ctypes.c_int * n_req)(*([len(payload)] * n_req))
+    outputs = (ctypes.c_void_p * n_req)()
+    out_sizes = (ctypes.c_int * n_req)()
+    rc = lib.batch_process_n(handle, inputs, sizes, n_req, outputs, out_sizes)
+    assert rc == 200
     for i in range(n_req):
         body = json.loads(ctypes.string_at(outputs[i], out_sizes[i]))
         assert len(body["predictions"]) == 4
         lib.free_buffer(outputs[i])
+
+    # A size-0 slot is a client error for that slot (no info-ping semantics
+    # inside an explicit-count batch); the good slot still serves.
+    sizes2 = (ctypes.c_int * 2)(0, len(payload))
+    inputs2 = (ctypes.c_char_p * 2)(payload, payload)
+    outputs2 = (ctypes.c_void_p * 2)()
+    out_sizes2 = (ctypes.c_int * 2)()
+    rc = lib.batch_process_n(handle, inputs2, sizes2, 2, outputs2, out_sizes2)
+    assert rc == 400
+    err = json.loads(ctypes.string_at(outputs2[0], out_sizes2[0]))
+    assert "error" in err
+    ok = json.loads(ctypes.string_at(outputs2[1], out_sizes2[1]))
+    assert len(ok["predictions"]) == 4
+    for o in outputs2:
+        lib.free_buffer(o)
+
+
+def test_process_empty_payload_returns_model_info(served):
+    """input_size==0 mirrors the reference (processor.cc:29-34): model
+    debug/serving info with status 200, not a 400."""
+    lib, handle, tr, st, ck, batches = served
+    out = ctypes.c_void_p()
+    n = ctypes.c_int()
+    rc = lib.process(handle, b"", 0, ctypes.byref(out), ctypes.byref(n))
+    assert rc == 200
+    info = json.loads(ctypes.string_at(out, n.value))
+    lib.free_buffer(out)
+    assert "step" in info
