@@ -4,11 +4,13 @@
 // (/root/reference/serving/processor/serving/processor.h — initialize /
 // process / batch_process / get_serving_model_info) so a host built for it
 // can dlopen libdeeprec_processor.so unchanged. The implementation is this
-// framework's own: an embedded CPython interpreter forwarding JSON payloads
-// to deeprec_tpu.serving.cabi, where the full serving stack (validation,
+// framework's own: an embedded CPython interpreter forwarding payloads to
+// deeprec_tpu.serving.cabi, where the full serving stack (validation,
 // request coalescing onto the TPU, full/delta hot-swap polling, warmup)
-// lives. Payloads are JSON, not protobuf — the TPU repo's wire choice,
-// documented in cabi.py.
+// lives. Payloads may be either the reference's protobuf wire format
+// (serialized tensorflow.eas.PredictRequest -> PredictResponse,
+// predict.proto — what reference-built hosts send) or JSON; cabi.py
+// sniffs the format per request.
 //
 // Threading: any host thread may call process(); each entry point takes the
 // GIL via PyGILState_Ensure. When this library boots the interpreter itself
@@ -31,7 +33,7 @@ namespace {
 
 struct ProcessorState {
   PyObject* server;        // deeprec_tpu.serving.ModelServer
-  PyObject* process_fn;    // cabi.process_json
+  PyObject* process_fn;    // cabi.process_request (JSON or protobuf)
   PyObject* info_fn;       // cabi.model_info_json
 };
 
@@ -91,7 +93,7 @@ void* initialize(const char* model_entry, const char* model_config,
     if (server != nullptr) {
       ps = new ProcessorState();
       ps->server = server;
-      ps->process_fn = PyObject_GetAttrString(mod, "process_json");
+      ps->process_fn = PyObject_GetAttrString(mod, "process_request");
       ps->info_fn = PyObject_GetAttrString(mod, "model_info_json");
     }
     Py_XDECREF(create);

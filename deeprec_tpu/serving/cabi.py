@@ -6,10 +6,12 @@ custom frontends) through a 4-function C ABI —
 ``batch_process`` / ``get_serving_model_info``
 (/root/reference/serving/processor/serving/processor.h). This framework
 keeps the SAME symbol contract so a host written against it can load
-``libdeeprec_processor.so`` instead, with two TPU-repo substitutions:
-the payloads are JSON (the reference's protobuf PredictRequest ->
-``{"features": {...}}``), and the model graph comes from the modelzoo
-registry + a checkpoint dir rather than a SavedModel bundle.
+``libdeeprec_processor.so`` instead. Payloads may be the reference's
+protobuf wire format (serialized ``tensorflow.eas.PredictRequest`` ->
+``PredictResponse``, decoded by :mod:`predict_pb`) or JSON
+(``{"features": {...}}``); the format is sniffed per request. The one
+remaining substitution: the model graph comes from the modelzoo registry
++ a checkpoint dir rather than a SavedModel bundle.
 
 The C layer embeds CPython and forwards to the three functions below; all
 serving logic (validation, coalescing, hot-swap polling, warmup) is the
@@ -79,6 +81,72 @@ def _synth_example(pred: Predictor) -> dict:
             w = dense[name].width if name in dense else 1
             out[name] = np.zeros((1, w), np.float32)
     return out
+
+
+def process_request(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:
+    """Wire-format dispatch for the C ABI: a JSON object (first
+    non-whitespace byte ``{``) takes the JSON path; anything else is
+    parsed as a serialized ``tensorflow.eas.PredictRequest`` — the
+    reference's native wire format (predict.proto, message_coding.cc) —
+    so a host built against the reference processor can call this library
+    with its protobuf payloads unchanged. A valid protobuf message never
+    begins with RAW byte 0x7b ('{'): that would be field 15 wire-type 3,
+    a group start, which protoc never emits for proto3. The sniff must
+    NOT strip whitespace first — protobuf tag/length bytes 0x09-0x0d/0x20
+    are ASCII whitespace (e.g. a tag byte of 0x0a is '\\n'), so stripping
+    can expose a '{' from inside a valid message. Whitespace-prefixed
+    JSON still works via the fallback below."""
+    if not payload or payload[:1] == b"{":
+        return process_json(server, payload)
+    code, body = process_proto(server, payload)
+    if (
+        code == 400
+        and body.startswith(b"bad PredictRequest")
+        and payload.lstrip()[:1] == b"{"
+    ):
+        # Not protobuf after all; a JSON object behind leading whitespace.
+        return process_json(server, payload)
+    return code, body
+
+
+def process_proto(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:
+    """PredictRequest in, PredictResponse out. Error bodies are plain-text
+    messages (the reference returns strndup'd error strings, not protobuf,
+    on non-200 — processor.cc:38-46)."""
+    from deeprec_tpu.serving import predict_pb as pb
+
+    try:
+        req = pb.PredictRequest.parse(bytes(payload))
+        feats = {k: v.to_numpy() for k, v in req.inputs.items()}
+    except Exception as e:
+        return 400, f"bad PredictRequest: {e}".encode()
+    try:
+        batch = parse_features(server.predictor, feats)
+    except BadRequest as e:
+        return 400, json.dumps(e.details).encode()
+    except ValueError as e:
+        return 400, str(e).encode()
+    try:
+        probs = server.request(batch)
+        items = (
+            list(probs.items())
+            if isinstance(probs, dict)
+            else [("probabilities", probs)]
+        )
+        outputs = {
+            k: pb.ArrayProto.from_numpy(np.asarray(v))
+            for k, v in items
+            if not req.output_filter or k in req.output_filter
+        }
+        if not outputs:
+            known = sorted(k for k, _ in items)
+            return 400, (
+                f"output_filter {req.output_filter} matches none of "
+                f"{known}".encode()
+            )
+        return 200, pb.PredictResponse(outputs).serialize()
+    except Exception as e:
+        return 500, str(e).encode()
 
 
 def process_json(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:

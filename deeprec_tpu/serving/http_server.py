@@ -111,7 +111,35 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         try:
             n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n) or b"{}")
+        except (TypeError, ValueError):
+            return self._send(400, {"error": "bad Content-Length"})
+        raw = self.rfile.read(n)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        # Only explicit protobuf media types take the protobuf path;
+        # octet-stream stays on the JSON path (clients commonly use it as
+        # a generic default for JSON bodies, and it worked before).
+        if ctype in ("application/x-protobuf", "application/protobuf"):
+            # Reference wire format: serialized PredictRequest in,
+            # PredictResponse out (predict.proto). Routing still applies.
+            server, verb = self._route_post()
+            if server is None:
+                return
+            if verb != "predict":
+                return self._send(400, {"error":
+                                        "protobuf body only valid on :predict"})
+            from deeprec_tpu.serving.cabi import process_proto
+
+            code, body = process_proto(server, raw)
+            self.send_response(code)
+            self.send_header(
+                "Content-Type",
+                "application/x-protobuf" if code == 200 else "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        try:
+            payload = json.loads(raw or b"{}")
         except Exception as e:
             return self._send(400, {"error": f"bad json: {e}"})
         server, verb = self._route_post()

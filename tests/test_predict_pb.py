@@ -1,0 +1,191 @@
+"""Protobuf wire codec: round-trip + differential tests.
+
+The differential half compiles the reference's predict.proto with protoc
+and checks OUR hand-rolled codec parses bytes produced by the official
+protobuf runtime and produces bytes the official runtime parses — the
+actual interop contract a reference-built host exercises. Skipped when
+protoc / the reference tree / a compatible runtime is unavailable.
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.serving.predict_pb import (
+    DT_FLOAT,
+    DT_INT64,
+    DT_STRING,
+    ArrayProto,
+    PredictRequest,
+    PredictResponse,
+    ServingModelInfo,
+)
+
+REF_PROTO = "/root/reference/serving/processor/serving/predict.proto"
+
+
+def test_array_roundtrip_dtypes():
+    cases = [
+        np.arange(12, dtype=np.float32).reshape(3, 4) * 0.5,
+        np.arange(6, dtype=np.float64).reshape(2, 3) - 2.5,
+        np.asarray([[1, -2], [3, -(1 << 40)]], np.int64),
+        np.asarray([5, -6, 7], np.int32),
+        np.asarray([True, False, True]),
+        np.asarray([1, 200, 255], np.uint8),
+    ]
+    for arr in cases:
+        back = ArrayProto.parse(ArrayProto.from_numpy(arr).serialize()).to_numpy()
+        assert back.shape == arr.shape
+        np.testing.assert_array_equal(back.astype(arr.dtype), arr)
+
+
+def test_array_strings():
+    arr = np.asarray(["user_a", "user_b"], dtype=object)
+    p = ArrayProto.from_numpy(arr)
+    assert p.dtype == DT_STRING
+    back = ArrayProto.parse(p.serialize())
+    assert back.string_val == [b"user_a", b"user_b"]
+
+
+def test_request_roundtrip():
+    req = PredictRequest(
+        signature_name="serving_default",
+        inputs={
+            "C1": ArrayProto.from_numpy(np.asarray([[1], [2]], np.int64)),
+            "I1": ArrayProto.from_numpy(np.asarray([[0.5], [1.5]], np.float32)),
+        },
+        output_filter=["probabilities"],
+    )
+    back = PredictRequest.parse(req.serialize())
+    assert back.signature_name == "serving_default"
+    assert sorted(back.inputs) == ["C1", "I1"]
+    assert back.output_filter == ["probabilities"]
+    np.testing.assert_array_equal(
+        back.inputs["C1"].to_numpy(), [[1], [2]]
+    )
+
+
+def test_response_roundtrip():
+    resp = PredictResponse(
+        {"probabilities": ArrayProto.from_numpy(np.asarray([0.1, 0.9], np.float32))}
+    )
+    back = PredictResponse.parse(resp.serialize())
+    np.testing.assert_allclose(
+        back.outputs["probabilities"].to_numpy(), [0.1, 0.9], rtol=1e-6
+    )
+
+
+def test_unknown_fields_skipped():
+    # field 15, varint 7 prepended: conforming parsers skip unknown fields
+    raw = b"\x78\x07" + PredictResponse(
+        {"p": ArrayProto.from_numpy(np.asarray([1.0], np.float32))}
+    ).serialize()
+    back = PredictResponse.parse(raw)
+    assert "p" in back.outputs
+
+
+@pytest.fixture(scope="module")
+def eas_pb2(tmp_path_factory):
+    if not os.path.exists(REF_PROTO):
+        pytest.skip("reference predict.proto not available")
+    tmp = tmp_path_factory.mktemp("pb")
+    r = subprocess.run(
+        ["protoc", f"-I{os.path.dirname(REF_PROTO)}",
+         f"--python_out={tmp}", os.path.basename(REF_PROTO)],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"protoc failed: {r.stderr}")
+    spec = importlib.util.spec_from_file_location(
+        "predict_pb2", tmp / "predict_pb2.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["predict_pb2"] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:  # gencode/runtime version mismatch
+        pytest.skip(f"protobuf runtime rejected gencode: {e}")
+    return mod
+
+
+def test_differential_request(eas_pb2):
+    """Bytes from the official runtime parse identically in our codec."""
+    req = eas_pb2.PredictRequest()
+    req.signature_name = "serving_default"
+    ids = req.inputs["C1"]
+    ids.dtype = eas_pb2.DT_INT64
+    ids.array_shape.dim.extend([2, 1])
+    ids.int64_val.extend([10, -3])
+    dense = req.inputs["I1"]
+    dense.dtype = eas_pb2.DT_FLOAT
+    dense.array_shape.dim.extend([2, 1])
+    dense.float_val.extend([0.25, -1.5])
+    req.output_filter.append("probabilities")
+
+    ours = PredictRequest.parse(req.SerializeToString())
+    assert ours.signature_name == "serving_default"
+    assert ours.output_filter == ["probabilities"]
+    np.testing.assert_array_equal(
+        ours.inputs["C1"].to_numpy(), [[10], [-3]]
+    )
+    np.testing.assert_allclose(
+        ours.inputs["I1"].to_numpy(), [[0.25], [-1.5]], rtol=1e-6
+    )
+
+
+def test_differential_response(eas_pb2):
+    """Bytes from our codec parse identically in the official runtime."""
+    resp = PredictResponse(
+        {"probabilities": ArrayProto.from_numpy(
+            np.asarray([[0.1], [0.9]], np.float32))}
+    )
+    theirs = eas_pb2.PredictResponse()
+    theirs.ParseFromString(resp.serialize())
+    out = theirs.outputs["probabilities"]
+    assert out.dtype == eas_pb2.DT_FLOAT
+    assert list(out.array_shape.dim) == [2, 1]
+    np.testing.assert_allclose(list(out.float_val), [0.1, 0.9], rtol=1e-6)
+
+
+def test_differential_model_info(eas_pb2):
+    info = eas_pb2.ServingModelInfo()
+    info.model_path = "/models/wdl/full-120"
+    ours = ServingModelInfo.parse(info.SerializeToString())
+    assert ours.model_path == "/models/wdl/full-120"
+    theirs = eas_pb2.ServingModelInfo()
+    theirs.ParseFromString(ServingModelInfo("/x/y").serialize())
+    assert theirs.model_path == "/x/y"
+
+
+def test_dispatch_never_misroutes(tmp_path):
+    """Wire sniffing: a protobuf whose bytes LOOK like whitespace+'{' after
+    lstrip (tag 0x0a = '\\n', length 123 = '{') must still take the
+    protobuf path, and whitespace-prefixed JSON must still parse."""
+    from deeprec_tpu.serving import cabi
+
+    calls = []
+
+    class FakeServer:  # never reached: both payloads fail validation first
+        predictor = None
+
+    def fake_json(server, payload):
+        calls.append("json")
+        return 200, b"{}"
+
+    orig = cabi.process_json
+    cabi.process_json = fake_json
+    try:
+        wire = PredictRequest(signature_name="x" * 123).serialize()
+        assert wire.lstrip()[:1] == b"{"  # the trap this test guards
+        code, body = cabi.process_request(FakeServer(), wire)
+        # took the protobuf path: parsed fine, then failed feature
+        # validation (no inputs) — NOT 'bad json'
+        assert calls == [] and code == 400 and b"missing" in body
+        cabi.process_request(FakeServer(), b'  \n {"features": {}}')
+        # leading-whitespace JSON: proto parse fails -> JSON fallback
+        assert calls == ["json"]
+    finally:
+        cabi.process_json = orig

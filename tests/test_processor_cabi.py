@@ -123,8 +123,17 @@ def test_process_matches_inprocess_predictor(served):
 
 def test_client_errors_are_400(served):
     lib, handle, *_ = served
-    rc, resp = _call_json(lib, lib.process, handle, b"not json at all")
-    assert rc == 400 and "error" in resp
+    # Not JSON (and not a parseable PredictRequest either): the wire
+    # sniffer routes non-'{' payloads to the protobuf path, whose error
+    # bodies are plain text like the reference's (processor.cc:38-46).
+    out = ctypes.c_void_p()
+    n = ctypes.c_int()
+    payload = b"not json at all"
+    rc = lib.process(handle, payload, len(payload), ctypes.byref(out),
+                     ctypes.byref(n))
+    assert rc == 400
+    assert b"PredictRequest" in ctypes.string_at(out, n.value)
+    lib.free_buffer(out)
     rc, resp = _call_json(
         lib, lib.process, handle,
         json.dumps({"features": {"BOGUS": [1]}}).encode(),
@@ -232,3 +241,30 @@ def test_process_empty_payload_returns_model_info(served):
     info = json.loads(ctypes.string_at(out, n.value))
     lib.free_buffer(out)
     assert "step" in info
+
+
+def test_process_protobuf_payload(served):
+    """A reference-built host's serialized PredictRequest through the real
+    .so: process() sniffs protobuf, returns a PredictResponse."""
+    from deeprec_tpu.serving.predict_pb import (
+        ArrayProto,
+        PredictRequest,
+        PredictResponse,
+    )
+
+    lib, handle, tr, st, ck, batches = served
+    feats = {k: np.asarray(v)[:4] for k, v in batches[0].items()
+             if k != "label"}
+    wire = PredictRequest(
+        inputs={k: ArrayProto.from_numpy(v) for k, v in feats.items()}
+    ).serialize()
+    out = ctypes.c_void_p()
+    n = ctypes.c_int()
+    rc = lib.process(handle, wire, len(wire), ctypes.byref(out),
+                     ctypes.byref(n))
+    assert rc == 200
+    resp = PredictResponse.parse(ctypes.string_at(out, n.value))
+    lib.free_buffer(out)
+    probs = resp.outputs["probabilities"].to_numpy()
+    assert probs.shape[0] == 4
+    assert np.all((probs >= 0) & (probs <= 1))

@@ -427,3 +427,65 @@ def test_multi_model_tfs_routes(tmp_path):
         http.stop()
         for s in servers.values():
             s.close()
+
+
+def test_protobuf_wire_end_to_end(tmp_path):
+    """Reference wire format through both frontends: a serialized
+    PredictRequest (predict.proto) in, a PredictResponse out, predictions
+    byte-identical to the JSON path. Covers the C-ABI dispatch function
+    (process_request) and the HTTP content-type route."""
+    import urllib.request
+
+    from deeprec_tpu.serving import HttpServer
+    from deeprec_tpu.serving.cabi import process_proto, process_request
+    from deeprec_tpu.serving.predict_pb import (
+        ArrayProto,
+        PredictRequest,
+        PredictResponse,
+    )
+
+    model, tr, st, ck, batches, gen = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=64,
+                         max_wait_ms=2)
+    feats = {k: np.asarray(v)[:4] for k, v in strip_labels(batches[0]).items()}
+    expect = np.asarray(server.predictor.predict(feats))
+
+    wire = PredictRequest(
+        signature_name="serving_default",
+        inputs={k: ArrayProto.from_numpy(v) for k, v in feats.items()},
+    ).serialize()
+
+    # In-process (what the C ABI's process() forwards to)
+    code, body = process_request(server, wire)
+    assert code == 200
+    out = PredictResponse.parse(body).outputs["probabilities"].to_numpy()
+    np.testing.assert_allclose(out, expect, atol=1e-6)
+
+    # output_filter: unknown alias -> client error, not a 500
+    bad = PredictRequest(
+        inputs={k: ArrayProto.from_numpy(v) for k, v in feats.items()},
+        output_filter=["no_such_output"],
+    ).serialize()
+    code, body = process_proto(server, bad)
+    assert code == 400 and b"no_such_output" in body
+
+    # Garbage protobuf -> 400 plain-text, not a crash
+    code, body = process_request(server, b"\xff\xfe\xfd")
+    assert code == 400
+
+    # HTTP with the protobuf content-type
+    http = HttpServer(server, port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/predict", data=wire,
+            headers={"Content-Type": "application/x-protobuf"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("Content-Type") == "application/x-protobuf"
+            out2 = PredictResponse.parse(r.read())
+        np.testing.assert_allclose(
+            out2.outputs["probabilities"].to_numpy(), expect, atol=1e-6)
+    finally:
+        http.stop()
+        server.close()
