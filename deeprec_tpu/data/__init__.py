@@ -8,3 +8,4 @@ from deeprec_tpu.data.readers import CriteoCSVReader, ParquetReader
 from deeprec_tpu.data.prefetch import Prefetcher, staged
 from deeprec_tpu.data.work_queue import WorkQueue, parse_slice
 from deeprec_tpu.data.stream import FileStreamServer, FileTailReader, TCPStreamReader
+from deeprec_tpu.data.kafka import KafkaClient, KafkaStreamReader

@@ -61,6 +61,91 @@ def initialize(
     jax.distributed.initialize(**kw)
 
 
+def supervise_elastic(
+    script: str,
+    script_args,
+    num_processes: int,
+    elastic_dir: str,
+    max_generations: int = 100,
+    env_extra: Optional[dict] = None,
+) -> int:
+    """Single-host elastic supervisor: the UpdateServerDef analog.
+
+    jax pins the process set at jax.distributed.initialize, so a topology
+    change means a new worker generation: spawn `num_processes` workers
+    running `script` under this launcher; when they exit with
+    elastic.EXIT_RESCALE (having checkpointed and acked the plan), respawn
+    at the plan's target count and bump DEEPREC_ELASTIC_EPOCH so the plan
+    isn't re-run. A zero exit from all workers ends the job. Mirrors the
+    reference choreography (elastic_training.proto:38-76) with the
+    supervisor in the coordinator role.
+
+    Scope: SINGLE-host process sets (the CI topology, and one TPU-VM
+    driving its local chips). A multi-host pod needs an external
+    orchestrator (e.g. the K8s operator pattern the reference's modelzoo
+    distribute recipes assume) running this same choreography across
+    hosts: per-host supervisors alone cannot form one jax job, because
+    each would pin its own coordinator address and process-id range.
+    """
+    import subprocess
+
+    from deeprec_tpu.parallel.elastic import EXIT_RESCALE, ElasticCoordinator
+
+    coord = ElasticCoordinator(elastic_dir)
+    n = num_processes
+    epoch_done = coord.plan()[0]  # plans at/below this are already applied
+    for _generation in range(max_generations):
+        port = _free_port()
+        procs = []
+        for pid in range(n):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update(
+                DEEPREC_COORDINATOR=f"127.0.0.1:{port}",
+                DEEPREC_NUM_PROCESSES=str(n),
+                DEEPREC_PROCESS_ID=str(pid),
+                DEEPREC_ELASTIC_DIR=elastic_dir,
+                DEEPREC_ELASTIC_EPOCH=str(epoch_done),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "deeprec_tpu.launch", script]
+                    + list(script_args),
+                    env=env,
+                )
+            )
+        rcs = [q.wait() for q in procs]
+        if all(rc == 0 for rc in rcs):
+            return 0
+        if all(rc == EXIT_RESCALE for rc in rcs):
+            # The workers acked the epoch they COLLECTIVELY decided on,
+            # which may be older than the latest plan.json (an autoscaler
+            # can post again mid-rescale); scan the acks, don't re-read
+            # the plan. A newer plan triggers the next generation.
+            epoch, target = coord.wait_acked_after(epoch_done, n)
+            print(
+                f"deeprec_tpu.launch: elastic rescale {n} -> {target} "
+                f"(plan epoch {epoch})",
+                flush=True,
+            )
+            n = target
+            epoch_done = epoch
+            continue
+        bad = [(i, rc) for i, rc in enumerate(rcs) if rc not in (0, EXIT_RESCALE)]
+        raise RuntimeError(f"elastic workers failed: {bad}")
+    raise RuntimeError("elastic: max_generations exceeded")
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="deeprec_tpu multi-host launcher",
@@ -69,9 +154,22 @@ def main(argv=None):
     p.add_argument("--coordinator", default=None, help="host:port of proc 0")
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
+    p.add_argument(
+        "--elastic_dir", default=None,
+        help="run as elastic SUPERVISOR: spawn --num_processes workers and "
+        "respawn the set at the plan's target size on rescale exits",
+    )
     p.add_argument("script", help="training script to run after init")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
+
+    if args.elastic_dir:
+        sys.exit(
+            supervise_elastic(
+                args.script, args.script_args,
+                args.num_processes or 1, args.elastic_dir,
+            )
+        )
 
     initialize(args.coordinator, args.num_processes, args.process_id)
 

@@ -1,22 +1,44 @@
 """Elastic re-scaling: move live training state between topologies.
 
 DeepRec's elastic training re-partitions PS-resident EVs through a gRPC
-scaling protocol (core/protobuf/elastic_training.proto, ElasticGrpcServer —
-SURVEY.md §2.5). Here the equivalent is a structural property plus one
-helper: checkpoints restore by re-probing keys, so ANY saved state loads
-onto ANY mesh size or capacity; `reshard` packages that as a single in-memory
-move for scale-up/scale-down events, and the file-coordinated WorkQueue
-(`data/work_queue.py`) re-balances the data stream automatically because
-workers pull items dynamically.
+scaling protocol (core/protobuf/elastic_training.proto:38-76 —
+IsReadyScaling polled by workers, ReadyToUpdate, UpdateServerDef with the
+new cluster; served by contrib/elastic_grpc_server). This module carries
+the same choreography onto a TPU pod, where the cluster is an SPMD mesh
+rather than a PS set:
+
+  * `reshard` — the state move: checkpoints restore by re-probing keys,
+    so ANY saved state loads onto ANY mesh size or capacity.
+  * `ElasticCoordinator` — the control plane, over a shared filesystem
+    instead of gRPC (a TPU pod always has one for checkpoints). An
+    autoscaler posts a scaling plan (`request_scale`); workers poll at
+    step boundaries (`should_scale`, collectively agreed so every
+    process decides at the SAME step); `ack_rescale` is the
+    ReadyToUpdate barrier.
+  * the launcher's `--elastic` supervisor (deeprec_tpu.launch) is the
+    UpdateServerDef analog: jax pins the process set at
+    jax.distributed.initialize, so changing the topology means the
+    supervisor respawns the worker set at the new size and training
+    resumes from the rescale checkpoint — mid-JOB, no operator action.
+
+The file-coordinated WorkQueue (`data/work_queue.py`) re-balances the
+data stream across the new worker set automatically because workers pull
+items dynamically from the shared cursor.
 """
 from __future__ import annotations
 
+import json
 import os
 import tempfile
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
 from deeprec_tpu.training.checkpoint import CheckpointManager
 from deeprec_tpu.training.trainer import TrainState, Trainer
+
+#: exit code a worker uses to tell the supervisor "respawn me at the new
+#: size" (any other nonzero exit aborts the job).
+EXIT_RESCALE = 42
 
 
 def reshard(
@@ -48,3 +70,149 @@ def reshard(
     _, path = src_ck.save(src_state)
     dst_state = CheckpointManager(d, dst_trainer, keep=1).restore()
     return dst_state
+
+
+class ElasticCoordinator:
+    """File-based scaling control plane (ElasticTrainingService analog).
+
+    Plan file (`plan.json`): ``{"epoch": E, "target": N}`` — epoch
+    increments per scaling event so a plan that already ran isn't re-run.
+    Worker acks (`ack-E-P`): the ReadyToUpdate barrier — the supervisor
+    respawns only after every worker of the outgoing generation acked.
+    """
+
+    def __init__(self, dir: str):
+        self.dir = dir
+        self._decided: Optional[Tuple[int, int]] = None  # (epoch, target)
+        os.makedirs(dir, exist_ok=True)
+
+    # ------------------------------------------------------- autoscaler
+
+    def request_scale(self, target: int) -> int:
+        """Post a scaling plan (the IsReadyScaling SCALING_UP/DOWN signal).
+        Returns the new plan epoch."""
+        epoch = self.plan()[0] + 1
+        tmp = os.path.join(self.dir, f".plan.{epoch}.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "target": int(target)}, f)
+        os.replace(tmp, os.path.join(self.dir, "plan.json"))
+        return epoch
+
+    def plan(self) -> Tuple[int, Optional[int]]:
+        """(epoch, target) of the current plan; (0, None) when none."""
+        try:
+            with open(os.path.join(self.dir, "plan.json")) as f:
+                p = json.load(f)
+            return int(p["epoch"]), int(p["target"])
+        except (OSError, ValueError, KeyError):
+            return 0, None
+
+    # ---------------------------------------------------------- workers
+
+    def should_scale(self) -> Optional[int]:
+        """Poll at a step boundary. Returns the target process count when
+        a new plan wants a DIFFERENT topology, else None.
+
+        Collectively agreed: process 0's view of the plan file is
+        broadcast to all processes, so every process decides at the same
+        step even if the shared FS shows the file at different moments —
+        the property the reference gets from a single coordinator serving
+        IsReadyScaling (elastic_training.proto:38-47).
+        """
+        import jax
+
+        done_epoch = int(os.environ.get("DEEPREC_ELASTIC_EPOCH", "0"))
+        if jax.process_count() == 1:
+            epoch, target = self.plan()
+            if target is not None and epoch > done_epoch:
+                self._decided = (epoch, target)
+                return target
+            return None
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        if jax.process_index() == 0:
+            epoch, target = self.plan()
+            view = np.asarray(
+                [epoch, target if target is not None else -1], np.int64
+            )
+        else:
+            view = np.zeros(2, np.int64)
+        view = multihost_utils.broadcast_one_to_all(view)
+        epoch, target = int(view[0]), int(view[1])
+        if target >= 0 and epoch > done_epoch:
+            # Every process remembers the SAME (epoch, target) — acks must
+            # reference this decision, not a re-read of plan.json, which a
+            # racing autoscaler may already have replaced.
+            self._decided = (epoch, target)
+            return target
+        return None
+
+    def ack_rescale(self) -> None:
+        """ReadyToUpdate: mark this process ready for the topology swap.
+        Call after the rescale checkpoint is on disk, right before
+        exiting with EXIT_RESCALE. Acks the plan epoch agreed in
+        should_scale (the ack file body carries the agreed target, which
+        the supervisor uses to size the next generation)."""
+        import jax
+
+        if self._decided is None:
+            raise RuntimeError("ack_rescale without a should_scale decision")
+        epoch, target = self._decided
+        with open(
+            os.path.join(
+                self.dir, f"ack-{epoch}-{jax.process_index():05d}"
+            ),
+            "w",
+        ) as f:
+            f.write(str(target))
+
+    def acked(self, epoch: int, n: int) -> bool:
+        """Supervisor side: has every worker of the outgoing generation
+        acked plan `epoch`?"""
+        return all(
+            os.path.exists(os.path.join(self.dir, f"ack-{epoch}-{p:05d}"))
+            for p in range(n)
+        )
+
+    def wait_acked_after(
+        self, after_epoch: int, n: int, timeout: float = 300.0
+    ) -> Tuple[int, int]:
+        """Supervisor side: wait until SOME epoch > after_epoch has all
+        `n` worker acks; return (epoch, target). Scans rather than
+        trusting the current plan.json — the workers may have agreed on an
+        older plan than the latest posted one (a later plan will trigger
+        the next generation's rescale)."""
+        import glob as _glob
+        import re
+
+        deadline = time.time() + timeout
+        pat = re.compile(r"ack-(\d+)-\d{5}$")
+        while True:
+            epochs = sorted({
+                int(m.group(1))
+                for p in _glob.glob(os.path.join(self.dir, "ack-*"))
+                if (m := pat.search(p)) and int(m.group(1)) > after_epoch
+            })
+            for e in epochs:
+                if self.acked(e, n):
+                    with open(
+                        os.path.join(self.dir, f"ack-{e}-00000")
+                    ) as f:
+                        return e, int(f.read().strip())
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"elastic: {n} workers did not ack any plan after "
+                    f"epoch {after_epoch} within {timeout}s"
+                )
+            time.sleep(0.05)
+
+    def wait_acked(self, epoch: int, n: int, timeout: float = 300.0) -> None:
+        deadline = time.time() + timeout
+        while not self.acked(epoch, n):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"elastic: {n} workers did not ack plan {epoch} within "
+                    f"{timeout}s"
+                )
+            time.sleep(0.05)

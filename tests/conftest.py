@@ -4,8 +4,16 @@ Mirrors the reference's strategy of testing distributed behavior with
 in-process fake clusters (SURVEY.md §4): jax's host-platform device-count
 flag gives us 8 fake devices so sharding/collective paths compile and run
 without TPU hardware.
+
+Speed: the default run excludes tests marked ``slow`` (multi-process
+launches, the largest compile grids) so `pytest -q` gives a quick green;
+``DEEPREC_FULL_TESTS=1`` runs everything (any explicit ``-m`` expression
+also takes over, e.g. ``-m 'slow or not slow'``). XLA results are
+also cached persistently across runs (JAX_COMPILATION_CACHE_DIR, default
+under the system tmpdir) — compile-heavy tests warm up run-over-run.
 """
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -13,6 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "deeprec_jax_cache"),
+)
+
+import pytest  # noqa: E402
 
 import jax  # noqa: E402  (import after env setup)
 
@@ -31,3 +45,16 @@ except (ImportError, AttributeError):
 jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip slow-marked tests by default; DEEPREC_FULL_TESTS=1 (or an
+    explicit -m) runs the full grid."""
+    if os.environ.get("DEEPREC_FULL_TESTS") == "1" or config.option.markexpr:
+        return
+    skip = pytest.mark.skip(
+        reason="slow; set DEEPREC_FULL_TESTS=1 (or -m slow) to run"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -98,6 +98,7 @@ def test_adaptive_embedding_routes_by_admission():
 # ------------------------------------------------------------ elastic scale
 
 
+@pytest.mark.slow
 def test_elastic_reshard_single_to_mesh_and_back(tmp_path):
     model = WDL(emb_dim=8, capacity=1 << 12, hidden=(32,), num_cat=4, num_dense=2)
     tr1 = Trainer(model, make("adagrad", lr=0.1), optax.adam(1e-3))

@@ -1,0 +1,270 @@
+"""Live elastic scaling: a RUNNING training job rescales 2 -> 4 -> 2.
+
+The reference negotiates mid-job scaling over gRPC
+(core/protobuf/elastic_training.proto:38-76, driven by
+contrib/elastic_grpc_server/elastic_grpc_server_lib_test.cc): workers
+poll IsReadyScaling, checkpoint, ReadyToUpdate, and the cluster def is
+swapped. Here the same choreography runs over the file control plane
+(parallel/elastic.ElasticCoordinator) with the launcher's supervisor
+respawning worker generations (launch.supervise_elastic), because jax
+pins the process set at distributed-init time.
+
+The test is the autoscaler: it starts the supervisor at 2 processes,
+posts scale plans mid-run, and asserts afterwards that
+  * the job ran three generations (2 -> 4 -> 2 process sets),
+  * a fixed probe batch predicts IDENTICALLY across every rescale
+    boundary (state equivalence through save -> re-shard -> restore),
+  * the shared WorkQueue rebalanced with no item processed twice and
+    nothing lost except items taken in the final incomplete lockstep
+    round (< process_count of them).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.parallel.elastic import ElasticCoordinator
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+WORKER = textwrap.dedent(
+    """
+    import glob, json, os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.data.work_queue import WorkQueue
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+    from deeprec_tpu.parallel.elastic import EXIT_RESCALE, ElasticCoordinator
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+    from jax.experimental import multihost_utils
+
+    OUT = {outdir!r}
+    pid = jax.process_index()
+    n = jax.process_count()
+    gen_tag = f"g{{n}}-{{os.environ['DEEPREC_ELASTIC_EPOCH']}}"
+
+    coord = ElasticCoordinator(os.environ["DEEPREC_ELASTIC_DIR"])
+    mesh = make_mesh()
+    model = WDL(emb_dim=4, capacity=1 << 8, hidden=(8,), num_cat=2,
+                num_dense=2)
+    tr = ShardedTrainer(model, Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
+    ck = CheckpointManager({ckdir!r}, tr)
+    st = ck.restore() if ck.latest_full() is not None else tr.init(0)
+
+    def J(b):
+        return {{k: jnp.asarray(v) for k, v in b.items()}}
+
+    def local_preds(p):
+        # process-local slice of the global prediction array: every
+        # process feeds the SAME 8 probe rows as its local slice, so this
+        # fingerprint is identical across processes AND topologies
+        shards = sorted(p.addressable_shards, key=lambda s: s.index)
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    probe = J(SyntheticCriteo(batch_size=8, num_cat=2, num_dense=2,
+                              vocab=200, seed=777).batch())
+
+    # restored-state fingerprint on a FIXED probe batch (must equal the
+    # fingerprint the previous generation wrote right before its save)
+    _, p_in = tr.eval_step(st, shard_batch(mesh, probe))
+    with open(f"{{OUT}}/probe-in-{{gen_tag}}-{{pid}}.json", "w") as f:
+        json.dump({{"step": int(st.step),
+                   "probe": local_preds(p_in).tolist()}}, f)
+
+    q = WorkQueue([f"item{{i:03d}}" for i in range(64)], shuffle=False,
+                  coordination_file={qfile!r})
+    processed = []
+    unprocessed = []
+    while True:
+        target = coord.should_scale()
+        if target is not None and target != n:
+            st, _ = ck.save(st)
+            _, p_out = tr.eval_step(st, shard_batch(mesh, probe))
+            with open(f"{{OUT}}/probe-out-{{gen_tag}}-{{pid}}.json", "w") as f:
+                json.dump({{"step": int(st.step),
+                           "probe": local_preds(p_out).tolist()}}, f)
+            with open(f"{{OUT}}/items-{{gen_tag}}-{{pid}}.json", "w") as f:
+                json.dump({{"processed": processed,
+                           "unprocessed": unprocessed}}, f)
+            coord.ack_rescale()
+            sys.exit(EXIT_RESCALE)
+
+        item = q.take()
+        have = multihost_utils.process_allgather(
+            np.asarray([0 if item is None else 1]))
+        if int(have.sum()) < n:  # lockstep round incomplete: stop together
+            if item is not None:
+                unprocessed.append(item)
+            break
+        # train on this worker's item (its local slice of the global batch)
+        seed = int(item[4:])
+        b = J(SyntheticCriteo(batch_size=8, num_cat=2, num_dense=2,
+                              vocab=200, seed=seed).batch())
+        st, mets = tr.train_step(st, shard_batch(mesh, b))
+        processed.append(item)
+        if len(processed) == 3:  # autoscaler waits for real progress
+            open(f"{{OUT}}/progress-{{gen_tag}}-{{pid}}", "w").close()
+
+    st, _ = ck.save(st)
+    _, p_fin = tr.eval_step(st, shard_batch(mesh, probe))
+    with open(f"{{OUT}}/final-{{gen_tag}}-{{pid}}.json", "w") as f:
+        json.dump({{"step": int(st.step), "ndev": len(jax.devices()),
+                   "probe": local_preds(p_fin).tolist()}}, f)
+    with open(f"{{OUT}}/items-{{gen_tag}}-{{pid}}.json", "w") as f:
+        json.dump({{"processed": processed, "unprocessed": unprocessed}}, f)
+    """
+)
+
+
+@pytest.mark.slow
+def test_live_elastic_2_4_2(tmp_path):
+    outdir = str(tmp_path / "out")
+    os.makedirs(outdir)
+    edir = str(tmp_path / "elastic")
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER.format(repo=REPO, outdir=outdir,
+                              ckdir=str(tmp_path / "ckpt"),
+                              qfile=str(tmp_path / "queue.json")))
+
+    env = {
+        **os.environ,
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    # log to a FILE, not a PIPE: three generations of workers inherit this
+    # fd, and an undrained pipe would deadlock everyone at ~64KB
+    log_path = str(tmp_path / "supervisor.log")
+    log_f = open(log_path, "w")
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "deeprec_tpu.launch",
+         "--num_processes", "2", "--elastic_dir", edir, script],
+        env={**env, "PYTHONPATH": REPO}, cwd=REPO,
+        stdout=log_f, stderr=subprocess.STDOUT, text=True,
+    )
+    coord = ElasticCoordinator(edir)
+
+    def wait_for(pattern, timeout=240):
+        deadline = time.time() + timeout
+        import glob as g
+
+        while time.time() < deadline:
+            if g.glob(os.path.join(outdir, pattern)):
+                return
+            if sup.poll() is not None:
+                raise AssertionError(
+                    "supervisor died early:\n" + open(log_path).read()
+                )
+            time.sleep(0.3)
+        sup.kill()
+        raise AssertionError(
+            "timeout waiting for " + pattern + ":\n" + open(log_path).read()
+        )
+
+    try:
+        # generation 1 (n=2) starts training...
+        wait_for("progress-g2-0-*")  # gen 1 trained >= 3 items/worker
+        coord.request_scale(4)
+        # generation 2 (n=4) must come up and train...
+        wait_for("progress-g4-1-*")
+        coord.request_scale(2)
+        # generation 3 (n=2) drains the queue and finishes
+        rc = sup.wait(timeout=300)
+        assert rc == 0, open(log_path).read()
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+        log_f.close()
+
+    import glob as g
+
+    # --- three generations ran
+    assert g.glob(os.path.join(outdir, "probe-in-g4-1-*.json"))
+    assert g.glob(os.path.join(outdir, "final-g2-2-*.json"))
+
+    # --- state equivalence across each rescale boundary: the fingerprint
+    # written right before a generation's save equals the one the next
+    # generation wrote right after restore (same step, same predictions)
+    def load(pat):
+        fs = sorted(g.glob(os.path.join(outdir, pat)))
+        assert fs, pat
+        return json.load(open(fs[0]))
+
+    out1 = load("probe-out-g2-0-0.json")      # gen1 (n=2, epoch 0) save
+    in2 = load("probe-in-g4-1-0.json")        # gen2 (n=4, epoch 1) restore
+    assert out1["step"] == in2["step"]
+    np.testing.assert_allclose(out1["probe"], in2["probe"], atol=1e-5)
+
+    out2 = load("probe-out-g4-1-0.json")      # gen2 save
+    in3 = load("probe-in-g2-2-0.json")        # gen3 (n=2, epoch 2) restore
+    assert out2["step"] == in3["step"]
+    np.testing.assert_allclose(out2["probe"], in3["probe"], atol=1e-5)
+
+    # steps strictly advanced across generations (it really TRAINED in
+    # each topology, not just bounced checkpoints)
+    fin = load("final-g2-2-0.json")
+    assert out1["step"] > 0
+    assert in2["step"] == out1["step"]
+    assert out2["step"] > in2["step"]
+    assert fin["step"] > out2["step"]
+
+    # --- WorkQueue rebalancing: no item processed twice; nothing lost
+    # except items taken in a final incomplete lockstep round
+    processed, unprocessed = [], []
+    for p in g.glob(os.path.join(outdir, "items-*.json")):
+        d = json.load(open(p))
+        processed += d["processed"]
+        unprocessed += d["unprocessed"]
+    assert len(processed) == len(set(processed)), "item processed twice"
+    all_items = {f"item{i:03d}" for i in range(64)}
+    assert set(processed) | set(unprocessed) == all_items
+    assert len(unprocessed) < 4  # < max process count
+
+
+def test_coordinator_plan_epoch_and_acks(tmp_path):
+    """Fast control-plane unit test (no subprocesses): plan epochs
+    increment, applied plans don't re-trigger, acks gate the supervisor."""
+    coord = ElasticCoordinator(str(tmp_path))
+    assert coord.plan() == (0, None)
+    assert coord.should_scale() is None  # no plan, single process
+
+    assert coord.request_scale(4) == 1
+    assert coord.plan() == (1, 4)
+    assert coord.should_scale() == 4
+
+    # after the supervisor applies epoch 1 (env bump), it must not re-run
+    os.environ["DEEPREC_ELASTIC_EPOCH"] = "1"
+    try:
+        assert coord.should_scale() is None
+        assert coord.request_scale(2) == 2  # next event
+        assert coord.should_scale() == 2
+    finally:
+        del os.environ["DEEPREC_ELASTIC_EPOCH"]
+
+    # ReadyToUpdate barrier: acks reference the DECIDED epoch (and carry
+    # the decided target for the supervisor), not a re-read of plan.json
+    e = coord.request_scale(2)
+    assert coord.should_scale() == 2  # decision recorded at epoch e
+    coord.request_scale(8)  # racing autoscaler posts e+1 mid-rescale
+    assert not coord.acked(e, 2)
+    coord.ack_rescale()  # process 0 (single-process jax) -> acks epoch e
+    assert not coord.acked(e, 2)
+    with open(os.path.join(str(tmp_path), f"ack-{e}-00001"), "w") as f:
+        f.write("2")
+    assert coord.acked(e, 2)
+    coord.wait_acked(e, 2, timeout=1)
+    # the supervisor scans for the workers' epoch, not the latest plan
+    assert coord.wait_acked_after(e - 1, 2, timeout=1) == (e, 2)

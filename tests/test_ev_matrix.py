@@ -59,9 +59,23 @@ FILTERS = [
 ]
 
 
-@pytest.mark.parametrize("opt_name", ["adagrad", "adam_async", "ftrl"])
+_FILTER_IDS = ["none", "counter", "cbf"]
+# Default run covers the diagonal (every filter, every optimizer, each
+# appearing once); the remaining combinations run under DEEPREC_FULL_TESTS.
+_DIAGONAL = {("adagrad", "none"), ("ftrl", "counter"), ("adam_async", "cbf")}
+
+
 @pytest.mark.parametrize(
-    "ev", FILTERS, ids=["none", "counter", "cbf"]
+    "opt_name,ev",
+    [
+        pytest.param(
+            o, f,
+            marks=[] if (o, fid) in _DIAGONAL else pytest.mark.slow,
+            id=f"{fid}-{o}",
+        )
+        for o in ["adagrad", "adam_async", "ftrl"]
+        for fid, f in zip(_FILTER_IDS, FILTERS)
+    ],
 )
 def test_sharded_filter_optimizer_grid(mesh, opt_name, ev):
     """Every admission filter x optimizer combination must train sharded

@@ -15,6 +15,7 @@ import textwrap
 import time
 
 import numpy as np
+import pytest
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
@@ -72,6 +73,7 @@ WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.slow
 def test_sigkill_mid_training_resumes_and_completes(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     script = str(tmp_path / "worker.py")

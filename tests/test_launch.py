@@ -98,6 +98,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_launch_psum_and_workqueue(tmp_path):
     import numpy as np
 

@@ -10,6 +10,7 @@ converge anyway — single-device and sharded.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from deeprec_tpu import EmbeddingVariableOption, StorageOption
@@ -62,6 +63,7 @@ def test_overfill_grows_and_converges_single_device():
     assert evals["auc"] > 0.55, evals
 
 
+@pytest.mark.slow
 def test_overfill_grows_sharded():
     from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
 

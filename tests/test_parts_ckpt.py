@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from deeprec_tpu.config import EmbeddingVariableOption, GlobalStepEvict
 from deeprec_tpu.data import SyntheticCriteo
@@ -54,6 +55,16 @@ def _trained(mesh, steps=3, seed=3, ttl=0):
     return tr, st, batches
 
 
+@pytest.fixture(scope="module")
+def trained8():
+    """One trained (trainer, state, batches) shared by every test that
+    only READS it (each saves to its own tmp dir): the training compile
+    dominated this file's runtime when every test trained its own."""
+    mesh = make_mesh(8)
+    tr, st, batches = _trained(mesh)
+    return mesh, tr, st, batches
+
+
 def _key_value_map(tr, st):
     """key -> value row for every live key across shards/members (host)."""
     out = {}
@@ -69,9 +80,8 @@ def _key_value_map(tr, st):
     return out
 
 
-def test_parts_save_matches_gathered(tmp_path):
-    mesh = make_mesh(8)
-    tr, st, batches = _trained(mesh)
+def test_parts_save_matches_gathered(tmp_path, trained8):
+    mesh, tr, st, batches = trained8
     CheckpointManager(str(tmp_path / "parts"), tr, sharded_io=True).save(st)
     CheckpointManager(str(tmp_path / "single"), tr, sharded_io=False).save(st)
 
@@ -93,9 +103,8 @@ def test_parts_save_matches_gathered(tmp_path):
                                   np.asarray(preds["single"]))
 
 
-def test_parts_same_topology_exact(tmp_path):
-    mesh = make_mesh(8)
-    tr, st, batches = _trained(mesh)
+def test_parts_same_topology_exact(tmp_path, trained8):
+    mesh, tr, st, batches = trained8
     CheckpointManager(str(tmp_path), tr, sharded_io=True).save(st)
     tr2 = ShardedTrainer(small(), Adagrad(lr=0.1), optax.adam(1e-3), mesh=mesh)
     st2 = CheckpointManager(str(tmp_path), tr2, sharded_io=True).restore()
@@ -109,9 +118,8 @@ def test_parts_same_topology_exact(tmp_path):
     assert np.isfinite(float(mets["loss"]))
 
 
-def test_parts_elastic_reshard(tmp_path):
-    mesh = make_mesh(8)
-    tr, st, batches = _trained(mesh)
+def test_parts_elastic_reshard(tmp_path, trained8):
+    mesh, tr, st, batches = trained8
     CheckpointManager(str(tmp_path), tr, sharded_io=True).save(st)
     _, p8 = tr.eval_step(st, shard_batch(mesh, batches[0]))
 
@@ -130,6 +138,7 @@ def test_parts_elastic_reshard(tmp_path):
     np.testing.assert_allclose(np.asarray(p8), np.asarray(p1), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_parts_incremental_with_eviction(tmp_path):
     mesh = make_mesh(8)
     tr, st, batches = _trained(mesh, ttl=2)
@@ -154,11 +163,10 @@ def test_parts_incremental_with_eviction(tmp_path):
         np.testing.assert_array_equal(m1[kk], m2[kk])
 
 
-def test_parts_multi_writer_simulation(tmp_path):
+def test_parts_multi_writer_simulation(tmp_path, trained8):
     """Split each part file in two (rows + shard metadata), as two writer
     processes would produce, and check the streaming restore merges them."""
-    mesh = make_mesh(8)
-    tr, st, batches = _trained(mesh)
+    mesh, tr, st, batches = trained8
     ck = CheckpointManager(str(tmp_path), tr, sharded_io=True)
     _, path = ck.save(st)
     _, p8 = tr.eval_step(st, shard_batch(mesh, batches[0]))
@@ -207,12 +215,11 @@ def test_parts_multi_writer_simulation(tmp_path):
     assert set(m1) == set(m2)
 
 
-def test_parts_stale_file_refused_and_cleared(tmp_path):
+def test_parts_stale_file_refused_and_cleared(tmp_path, trained8):
     """A part file left by a crashed earlier attempt (e.g. from a larger
     pre-downscale topology) must make restore fail loudly, and a re-save at
     the same step must clear it rather than letting it merge silently."""
-    mesh = make_mesh(8)
-    tr, st, batches = _trained(mesh)
+    mesh, tr, st, batches = trained8
     ck = CheckpointManager(str(tmp_path), tr, sharded_io=True)
     _, path = ck.save(st)
 

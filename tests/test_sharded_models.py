@@ -66,6 +66,7 @@ def test_multitask_sharded_trains(mesh):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_sharded_incremental_checkpoint(tmp_path, mesh):
     from deeprec_tpu.models import WDL
 
