@@ -11,10 +11,13 @@ HostKV store and promotes host-resident rows whose keys reappeared on device.
 Promotion correctness: when a demoted key is looked up again, the device
 table creates a fresh slot with initializer values. sync() detects device
 rows whose key exists in the host tier and whose device freq is LOWER than
-the host freq — i.e. freshly re-created — and restores the host row
-(values + optimizer slots are NOT in the host tier; DeepRec's DRAM tier
-likewise stores values + stats, and optimizer slots restart. freq/version
-merge so admission state survives the round-trip).
+the host freq — i.e. freshly re-created — and restores the host row.
+Host rows carry the VALUES **and the per-row optimizer slots** (packed
+side by side into one wide row), matching DeepRec's DRAM tier storing
+full ValuePtrs (hbm_dram_storage.h) — a demoted-then-promoted key resumes
+Adagrad/Adam state instead of restarting it. freq/version merge so
+admission state survives the round-trip. Per-table scalar slots (e.g.
+AdamAsync beta powers) are not per-row state and stay on device.
 """
 from __future__ import annotations
 
@@ -39,10 +42,35 @@ class DiskKV:
     seek per key. `save()` persists the index sidecar; `load()` restores
     it (or rebuilds by scanning the log)."""
 
-    def __init__(self, path: str, dim: int):
+    MAGIC = 0xD15C_0001  # log header: magic u32 | dim u32
+
+    def __init__(self, path: str, dim: Optional[int] = None):
+        """dim=None reopens an existing log using its header's row width
+        (the serving flow, where the packed width — values + optimizer
+        slot columns — is only known to the process that wrote it)."""
         import json as _json
 
         self.path = path
+        exists = os.path.exists(path) and os.path.getsize(path) >= 8
+        if exists:
+            with open(path, "rb") as f:
+                magic, hdim = np.frombuffer(f.read(8), "<u4")
+            if int(magic) != self.MAGIC:
+                raise ValueError(
+                    f"{path}: not a DiskKV log (bad magic {magic:#x})"
+                )
+            if dim is not None and int(hdim) != dim:
+                raise ValueError(
+                    f"{path}: log rows are {int(hdim)} wide but this table/"
+                    f"optimizer layout needs {dim} — the log was written "
+                    "under a different configuration"
+                )
+            dim = int(hdim)
+        elif dim is None:
+            raise FileNotFoundError(
+                f"{path}: dim=None requires an existing log to read the "
+                "width from"
+            )
         self.dim = dim
         self.rec_bytes = 8 + 4 + 4 + 4 * dim
         self.index: dict = {}
@@ -51,10 +79,12 @@ class DiskKV:
              ("val", "<f4", (dim,))]
         )
         assert self._dtype.itemsize == self.rec_bytes
-        mode = "r+b" if os.path.exists(path) else "w+b"
-        self._f = open(path, mode)
+        self._f = open(path, "r+b" if exists else "w+b")
+        if not exists:
+            np.asarray([self.MAGIC, dim], "<u4").tofile(self._f)
+            self._f.flush()
         log_len = self._f.seek(0, 2)
-        if log_len and os.path.exists(path + ".idx"):
+        if log_len > 8 and os.path.exists(path + ".idx"):
             with open(path + ".idx") as f:
                 saved = _json.load(f)
             self.index = {
@@ -63,17 +93,17 @@ class DiskKV:
             # A crash can leave records appended after the last save():
             # scan the tail past the sidecar's recorded length so those
             # keys (and updates) are not silently stale/lost.
-            tail_from = int(saved.get("_len", 0))
+            tail_from = int(saved.get("_len", 8))
             if log_len > tail_from:
                 self._scan_index(tail_from)
-        elif log_len:
-            self._scan_index(0)
+        elif log_len > 8:
+            self._scan_index(8)
 
     def _scan_index(self, from_offset: int):
         """(Re)build index entries from log records at/after from_offset
         (later records win, log order)."""
         end = self._f.seek(0, 2)
-        start = (from_offset // self.rec_bytes) * self.rec_bytes
+        start = 8 + ((max(from_offset, 8) - 8) // self.rec_bytes) * self.rec_bytes
         n = (end - start) // self.rec_bytes
         self._f.seek(start)
         recs = np.fromfile(self._f, self._dtype, n)
@@ -82,6 +112,49 @@ class DiskKV:
 
     def __len__(self):
         return len(self.index)
+
+    def _log_records(self) -> int:
+        return (self._f.seek(0, 2) - 8) // self.rec_bytes
+
+    def compact(self, min_records: int = 1024, garbage_factor: float = 2.0,
+                force: bool = False) -> bool:
+        """Rewrite live records into a fresh log when dead records (updates
+        and erases the log still carries) dominate: without this, a
+        long-running HBM_DRAM_SSD job appends forever and crash-rebuild
+        cost grows with the GARBAGE, not the data (the reference's SSD
+        tier compacts its record files the same way —
+        ssd_hash_kv.h / ssd_record_descriptor.h). Returns True if a
+        rewrite happened."""
+        total = self._log_records()
+        live = len(self.index)
+        if not force and (
+            total < min_records or total <= garbage_factor * max(live, 1)
+        ):
+            return False
+        tmp = self.path + ".compact"
+        offs = sorted(self.index.items(), key=lambda kv: kv[1])
+        with open(tmp, "wb") as out:
+            np.asarray([self.MAGIC, self.dim], "<u4").tofile(out)
+            new_index = {}
+            for k, off in offs:
+                self._f.seek(off)
+                rec = np.fromfile(self._f, self._dtype, 1)
+                new_index[k] = out.tell()
+                rec.tofile(out)
+        # A saved sidecar holds the OLD log's offsets. Remove it BEFORE the
+        # log swap: a crash between the swap and a fresh save() must find
+        # no sidecar (reopen falls back to a full scan of the new log),
+        # never stale offsets into the compacted file.
+        had_sidecar = os.path.exists(self.path + ".idx")
+        if had_sidecar:
+            os.remove(self.path + ".idx")
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "r+b")
+        self.index = new_index
+        if had_sidecar:
+            self.save()
+        return True
 
     def put(self, keys, values, freqs=None, versions=None) -> None:
         n = len(keys)
@@ -96,6 +169,7 @@ class DiskKV:
         self._f.flush()
         for i, k in enumerate(recs["key"]):
             self.index[int(k)] = base + i * self.rec_bytes
+        self.compact()
 
     def get(self, keys):
         keys = np.asarray(keys, np.int64)
@@ -138,6 +212,22 @@ class DiskKV:
         self._f.close()
 
 
+def _spill_dim(path: str) -> int:
+    """Row width recorded in a spill file's header (native hkv format:
+    magic u64, dim u64, n u64; npz fallback: the values array)."""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            head = f.read(16)
+        if len(head) == 16:
+            magic, dim = np.frombuffer(head, "<u8")
+            if magic == 0xDEE99EC0011:
+                return int(dim)
+    npz = path if path.endswith(".npz") else path + ".npz"
+    if os.path.exists(npz):
+        return int(np.load(npz)["values"].shape[1])
+    raise FileNotFoundError(path)
+
+
 @dataclasses.dataclass
 class TierStats:
     demoted: int = 0
@@ -169,15 +259,56 @@ class MultiTierTable:
         self.table = table
         self.high = high_watermark
         self.low = low_watermark
-        self.host = HostKV(dim=cfg.dim, initial_capacity=cfg.capacity)
         self.cache_strategy = cfg.ev.storage.cache_strategy
         self.storage_path = storage_path or cfg.ev.storage.storage_path
-        # third tier (HBM_DRAM_SSD): bounded host DRAM, coldest rows spill
-        # to a log-structured disk store (storage-factory combo semantics,
-        # reference storage_factory.h / hbm_dram_ssd_storage.h)
         self.host_capacity = cfg.ev.storage.host_capacity
+        # Host/disk tiers are created lazily at the first sync(): their row
+        # width is D + the widths of the per-row optimizer slots, which
+        # only the live TableState knows. Packing slots into the tier row
+        # (DeepRec's DRAM tier stores full ValuePtrs, hbm_dram_storage.h)
+        # is what lets a demote/promote round-trip preserve optimizer
+        # state.
+        self.host: Optional[HostKV] = None
         self.disk: Optional[DiskKV] = None
-        if cfg.ev.storage.storage_type == StorageType.HBM_DRAM_SSD:
+        self._slot_layout: Optional[tuple] = None  # ((name, width), ...)
+        # Optimizer slot init values ((name, fill), ...) threaded into every
+        # rebuild so rows reborn in freed slots restart from the optimizer's
+        # init (e.g. Adagrad initial accumulator), never a raw 0.
+        self.slot_fills = tuple(slot_fills or ())
+
+    # --------------------------------------------------------- packed rows
+
+    def _ensure_tiers(self, state: TableState) -> None:
+        if self._slot_layout is not None:
+            return
+        cfg = self.table.cfg
+        C = state.capacity
+        self._slot_layout = tuple(
+            (name, int(arr.shape[1]) if arr.ndim > 1 else 1)
+            for name, arr in sorted(state.slots.items())
+            if arr.shape[0] == C  # per-row slots only (not table scalars)
+        )
+        width = cfg.dim + sum(w for _, w in self._slot_layout)
+        self._packed_dim = width
+        if self.host is not None:  # pre-created by load(): widths must agree
+            if self.host.dim != width:
+                raise ValueError(
+                    f"loaded tier rows are {self.host.dim} wide but this "
+                    f"optimizer's packed layout needs {width} (values "
+                    f"{cfg.dim} + slots {self._slot_layout}) — the spill "
+                    "was written under a different optimizer"
+                )
+        else:
+            self.host = HostKV(dim=width, initial_capacity=cfg.capacity)
+        if self.disk is not None and self.disk.dim != width:
+            raise ValueError(
+                f"existing disk-tier log rows are {self.disk.dim} wide but "
+                f"this optimizer's packed layout needs {width} — the log "
+                "was written under a different optimizer"
+            )
+        if self.disk is None and (
+            cfg.ev.storage.storage_type == StorageType.HBM_DRAM_SSD
+        ):
             if self.storage_path:
                 path = self.storage_path + ".ssd"
             else:
@@ -190,11 +321,36 @@ class MultiTierTable:
                     prefix=f"deeprec_{cfg.name}_", suffix=".ssd"
                 )
                 os.close(fd)
-            self.disk = DiskKV(path, cfg.dim)
-        # Optimizer slot init values ((name, fill), ...) threaded into every
-        # rebuild so rows reborn in freed slots restart from the optimizer's
-        # init (e.g. Adagrad initial accumulator), never a raw 0.
-        self.slot_fills = tuple(slot_fills or ())
+            self.disk = DiskKV(path, width)
+
+    def _pack_rows(self, state: TableState, row_ix: np.ndarray) -> np.ndarray:
+        """[n, D + slot widths]: values then per-row slot columns."""
+        cols = [np.asarray(state.values, np.float32)[row_ix]]
+        for name, w in self._slot_layout:
+            arr = np.asarray(state.slots[name], np.float32)[row_ix]
+            cols.append(arr.reshape(len(row_ix), w))
+        return np.concatenate(cols, axis=1)
+
+    def _unpack_rows(self, state: TableState, row_ix: np.ndarray,
+                     packed: np.ndarray) -> TableState:
+        """Restore values AND per-row optimizer slots at row_ix."""
+        D = self.table.cfg.dim
+        ix = jnp.asarray(row_ix, jnp.int32)
+        state = state.replace(
+            values=state.values.at[ix].set(
+                jnp.asarray(packed[:, :D], state.values.dtype)
+            )
+        )
+        off = D
+        slots = dict(state.slots)
+        for name, w in self._slot_layout:
+            tgt = slots[name]
+            chunk = packed[:, off:off + w].reshape(
+                (len(row_ix),) + tgt.shape[1:]
+            )
+            slots[name] = tgt.at[ix].set(jnp.asarray(chunk, tgt.dtype))
+            off += w
+        return state.replace(slots=slots)
 
     # ------------------------------------------------------------------ sync
 
@@ -207,6 +363,7 @@ class MultiTierTable:
         healing probe chains and resetting insert_fails — when there was
         nothing to demote."""
         stats = TierStats()
+        self._ensure_tiers(state)
         keys = np.asarray(state.keys)
         occ = keys != empty_key(self.table.cfg)
         freq = np.asarray(state.freq)
@@ -238,11 +395,12 @@ class MultiTierTable:
                 # freshly re-created rows have tiny device freq vs host freq
                 refreshed = df <= hf
                 if refreshed.any():
+                    # packed host rows restore values AND optimizer slots
+                    state = self._unpack_rows(
+                        state, dev_ix[refreshed], hv[refreshed]
+                    )
                     ix = jnp.asarray(dev_ix[refreshed], jnp.int32)
                     state = state.replace(
-                        values=state.values.at[ix].set(
-                            jnp.asarray(hv[refreshed], state.values.dtype)
-                        ),
                         freq=state.freq.at[ix].add(
                             jnp.asarray(hf[refreshed], jnp.int32)
                         ),
@@ -266,7 +424,7 @@ class MultiTierTable:
             out_keys = keys[out_ix].astype(np.int64)
             self.host.put(
                 out_keys,
-                np.asarray(state.values)[out_ix],
+                self._pack_rows(state, out_ix),
                 freq[out_ix],
                 version[out_ix],
             )
@@ -317,8 +475,15 @@ class MultiTierTable:
         tier) for misses — the serving-path equivalent of HbmDram's
         CopyEmbeddingsFromCPUToGPU."""
         emb = np.array(self.table.lookup_readonly(state, ids))  # writable copy
+        if self.host is None and self.disk is None:  # nothing ever demoted
+            return jnp.asarray(emb)
+        D = self.table.cfg.dim
         flat_ids = np.asarray(ids).reshape(-1).astype(np.int64)
-        h_vals, _, _, found = self.host.get(flat_ids)
+        if self.host is not None:
+            h_vals, _, _, found = self.host.get(flat_ids)
+        else:
+            h_vals = np.zeros((len(flat_ids), self.disk.dim), np.float32)
+            found = np.zeros(len(flat_ids), bool)
         if self.disk is not None and (~found).any():
             miss = ~found
             d_vals, _, _, d_found = self.disk.get(flat_ids[miss])
@@ -328,7 +493,7 @@ class MultiTierTable:
                 found[mix] = True
         if found.any():
             emb = emb.reshape(len(flat_ids), -1)
-            emb[found] = h_vals[found]
+            emb[found] = h_vals[found][:, :D]  # packed rows: values first
             emb = emb.reshape(*np.asarray(ids).shape, -1)
         return jnp.asarray(emb)
 
@@ -336,9 +501,29 @@ class MultiTierTable:
 
     def spill(self, path: Optional[str] = None) -> None:
         """Persist the host tier (and the disk tier's index)."""
-        self.host.save(path or self.storage_path or "host_tier.bin")
+        if self.host is not None:
+            self.host.save(path or self.storage_path or "host_tier.bin")
         if self.disk is not None:
             self.disk.save()
 
     def load(self, path: Optional[str] = None) -> None:
-        self.host.load(path or self.storage_path or "host_tier.bin")
+        """Restore spilled tiers into a fresh instance (the serving flow —
+        no sync() has run yet). A missing host spill is an empty tier (the
+        writer may have spilled before anything was demoted); an existing
+        disk log reopens using its header's row width. The first sync()
+        validates both widths against the live optimizer's slot layout."""
+        p = path or self.storage_path or "host_tier.bin"
+        try:
+            width = _spill_dim(p)
+        except FileNotFoundError:
+            width = None  # nothing was ever spilled: empty tier
+        if width is not None:
+            if self.host is None:
+                self.host = HostKV(
+                    dim=width, initial_capacity=self.table.cfg.capacity
+                )
+            self.host.load(p)
+        if self.disk is None and self.storage_path:
+            ssd = self.storage_path + ".ssd"
+            if os.path.exists(ssd) and os.path.getsize(ssd) >= 8:
+                self.disk = DiskKV(ssd)  # width from the log header

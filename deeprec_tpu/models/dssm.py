@@ -67,6 +67,17 @@ class DSSM:
         """Item tower over [N, F*D] stacked item features."""
         return self._normalize(nn.mlp_apply(params["item"], item_embs))
 
+    def apply_with_user(self, params, user_vec, inputs):
+        """Forward given precomputed user vectors (the serving-side
+        sample-aware-compression hook: the predictor runs `user_vector`
+        once per distinct user via nn.apply_grouped and finishes the row
+        with this). Row-for-row equal to apply()."""
+        v = self.item_vectors(
+            params,
+            jnp.concatenate([inputs.pooled[n] for n in self.item_feats], -1),
+        )
+        return jnp.sum(user_vec * v, axis=-1) * params["temp"]
+
     def score_items(self, params, user_vec, item_vecs):
         """Score a user against N candidate items at once — the
         sample-aware-compression pattern (user subgraph computed once per

@@ -165,7 +165,17 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         try:
-            probs = server.request(batch)
+            if payload.get("group_users"):
+                # sample-aware compression: a <user, N items> batch runs
+                # the user tower once per distinct user. Direct predictor
+                # call — a grouped request is already a batch, coalescing
+                # it with strangers' rows would dilute the dedup.
+                try:
+                    probs = server.predictor.predict(batch, group_users=True)
+                except ValueError as e:  # no tower split: client error
+                    return self._send(400, {"error": str(e)})
+            else:
+                probs = server.request(batch)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}
             else:

@@ -124,6 +124,9 @@ class Predictor:
         self._lock = threading.RLock()
         self.stores = dict(stores or {})
         self._predict_step = jax.jit(self._predict_impl)
+        self._predict_grouped_step = jax.jit(
+            self._predict_grouped_impl, static_argnums=2
+        )
         self._forward_step = jax.jit(self._forward_impl)
         self._lookup_step = jax.jit(self._lookup_views)
         self.reload()
@@ -181,11 +184,55 @@ class Predictor:
 
     # ------------------------------------------------------------- predict
 
-    def predict(self, batch: Dict[str, np.ndarray]):
+    def predict(self, batch: Dict[str, np.ndarray], group_users: bool = False):
         """Probabilities for one batch (dict keyed per task for MTL).
         Label-free: the serving path runs lookup + forward + sigmoid only —
-        no loss, no dummy labels, no training machinery."""
+        no loss, no dummy labels, no training machinery.
+
+        group_users=True enables serving-side sample-aware compression for
+        tower models (the reference's graph-optimizer rewrite,
+        serving/processor/framework/graph_optimizer.cc, spec
+        docs/docs_en/Sample-awared-Graph-Compression.md): rows of a
+        ``<user, N items>`` batch that share identical user-feature values
+        run the user tower ONCE per distinct user (G rows instead of B)
+        and broadcast the user vector. Requires the model to expose
+        ``user_feats`` / ``user_vector`` / ``apply_with_user`` (DSSM
+        does). Outputs are row-for-row identical to the plain path.
+        Ignores feature stores (read-through is a per-row correction that
+        the grouped trace doesn't carry)."""
         state = self._state  # atomic reference read
+        if group_users:
+            if not hasattr(self.model, "apply_with_user"):
+                raise ValueError(
+                    f"{type(self.model).__name__} has no user/item tower "
+                    "split (needs user_feats/user_vector/apply_with_user)"
+                )
+            cols = np.concatenate(
+                [
+                    np.asarray(batch[n]).reshape(len(np.asarray(batch[n])), -1)
+                    for n in self.model.user_feats
+                ],
+                axis=1,
+            )
+            b = cols.shape[0]
+            # Bucket BOTH shapes to powers of two — one compile per
+            # (row-bucket, group-bucket), not one per client batch size.
+            # Pad rows by repeating the last row: its user already exists,
+            # so the distinct-user count is unchanged.
+            bp = 1 << max(b - 1, 0).bit_length()
+            distinct = len(np.unique(cols, axis=0))
+            g = min(1 << max(distinct - 1, 0).bit_length(), bp)
+            def pad(v):
+                v = np.asarray(v)
+                if bp > b:
+                    v = np.concatenate(
+                        [v, np.repeat(v[-1:], bp - b, axis=0)]
+                    )
+                return jnp.asarray(v)
+
+            batch = {k: pad(v) for k, v in batch.items()}
+            probs = self._predict_grouped_step(state, batch, g)
+            return jax.tree.map(lambda a: np.asarray(a)[:b], probs)
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self.stores:
             probs = self._predict_with_stores(state, batch)
@@ -201,6 +248,37 @@ class Predictor:
     def _predict_impl(self, state, batch):
         views, _ = self._lookup_views(state, batch)
         return self._trainer.probs_from_views(state, views, batch)[1]
+
+    def _predict_grouped_impl(self, state, batch, num_groups: int):
+        """Sample-aware compressed forward: user tower on G deduped rows,
+        item tower + scoring on all B rows. Group identity is exact (id
+        columns compared row-wise, not hashed), so equal outputs are
+        guaranteed; apply_grouped returns NaN rows on group overflow,
+        which cannot happen because predict() sizes num_groups from the
+        host-side distinct count."""
+        from deeprec_tpu import nn as _nn
+
+        m = self.model
+        views, _ = self._lookup_views(state, batch)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+        inputs = self._trainer._build_inputs(embs, views, batch)
+        ucols = jnp.concatenate(
+            [batch[n].reshape(batch[n].shape[0], -1) for n in m.user_feats],
+            axis=1,
+        )
+        _, gids = jnp.unique(
+            ucols, axis=0, size=num_groups, return_inverse=True
+        )
+        uvec = _nn.apply_grouped(
+            lambda ins: m.user_vector(state.dense, ins),
+            inputs,
+            gids.reshape(-1),
+            num_groups,
+        )
+        out = m.apply_with_user(state.dense, uvec, inputs)
+        if isinstance(out, dict):
+            return {k: jax.nn.sigmoid(v) for k, v in out.items()}
+        return jax.nn.sigmoid(out)
 
     def _forward_impl(self, state, views, batch):
         return self._trainer.probs_from_views(state, views, batch)[1]

@@ -224,3 +224,108 @@ def test_spill_and_load(tmp_path):
     t2, mt2 = make()
     mt2.load(p)
     assert len(mt2.host) == stats.host_size
+
+
+def test_demote_promote_preserves_optimizer_slots():
+    """A demoted-then-promoted key resumes its Adagrad accumulator (host
+    tier rows pack values + per-row slots, like DeepRec's DRAM tier
+    storing full ValuePtrs — hbm_dram_storage.h), instead of restarting
+    optimizer state at init."""
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.optim.apply import ensure_slots
+
+    t, _ = make()
+    opt = Adagrad(lr=0.1, initial_accumulator_value=0.1)
+    fills = tuple(
+        (name, init) for name, (_, init) in opt.slot_specs(t.cfg.dim).items()
+    )
+    mt = MultiTierTable(t, high_watermark=0.75, low_watermark=0.5,
+                        slot_fills=fills)
+    s = ensure_slots(t, t.create(), opt)
+    # touch 52 keys; give key 7 a DISTINCTIVE accumulator + value
+    s, res = t.lookup_unique(s, jnp.arange(52, dtype=jnp.int32), step=0)
+    keys = np.asarray(s.keys)
+    slot7 = int(np.nonzero(keys == 7)[0][0])
+    occ0 = np.asarray(t.occupied(s))
+    s = s.replace(
+        values=s.values.at[slot7].set(2.5),
+        slots={**s.slots, "accum": s.slots["accum"].at[slot7].set(7.75)},
+        # make key 7 STRICTLY the coldest so LFU must demote it
+        freq=jnp.where(jnp.asarray(occ0), 5, s.freq).at[slot7].set(1),
+    )
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0
+    assert 7 not in set(np.asarray(s.keys)[np.asarray(t.occupied(s))].tolist())
+
+    # key 7 comes back (fresh slot, init values/slots)...
+    s, _ = t.lookup_unique(s, jnp.asarray([7], jnp.int32), step=2)
+    s, stats2 = mt.sync(s, step=3)
+    assert stats2.promoted >= 1
+    keys = np.asarray(s.keys)
+    occ = np.asarray(t.occupied(s))
+    slot7 = int(np.nonzero((keys == 7) & occ)[0][0])
+    # ...with its exact values AND accumulator restored
+    np.testing.assert_allclose(np.asarray(s.values)[slot7], 2.5)
+    np.testing.assert_allclose(np.asarray(s.slots["accum"])[slot7], 7.75)
+
+
+def test_diskkv_compaction_bounds_log(tmp_path):
+    """Repeated updates to the same keys must not grow the log without
+    bound: compaction rewrites live records once garbage dominates
+    (reference ssd_hash_kv.h manages its record files the same way)."""
+    from deeprec_tpu.embedding.multi_tier import DiskKV
+
+    path = str(tmp_path / "log.ssd")
+    kv = DiskKV(path, dim=4)
+    keys = np.arange(256, dtype=np.int64)
+    for round_ in range(16):  # 16x overwrite: 4096 records, 256 live
+        kv.put(keys, np.full((256, 4), float(round_), np.float32),
+               np.full(256, round_, np.int32), np.zeros(256, np.int32))
+    total_recs = os.path.getsize(path) // kv.rec_bytes
+    assert total_recs <= 2 * 256 + 256  # bounded, not 4096
+    vals, freqs, _, found = kv.get(keys)
+    assert found.all()
+    np.testing.assert_allclose(vals, 15.0)  # latest round survives
+
+    # erase-heavy workload compacts too (force): after dropping most keys
+    kv.erase(keys[8:])
+    kv.compact(force=True)
+    assert os.path.getsize(path) // kv.rec_bytes == 8
+    vals, _, _, found = kv.get(keys[:8])
+    assert found.all() and np.allclose(vals, 15.0)
+
+    # reopen after compaction: index rebuilds cleanly from the new log
+    kv.save()
+    kv.close()
+    kv2 = DiskKV(path, dim=4)
+    assert len(kv2) == 8
+    vals, _, _, found = kv2.get(keys[:8])
+    assert found.all() and np.allclose(vals, 15.0)
+
+
+def test_fresh_instance_load_serves_all_tiers(tmp_path):
+    """Serving flow: a FRESH MultiTierTable (no sync ever run) that
+    load()s a prior run's spill serves host-tier AND disk-tier rows
+    through lookup_with_fallback — the disk log reopens via its header's
+    row width."""
+    t, mt = make_3tier(tmp_path)
+    s = t.create()
+    ids = jnp.arange(52, dtype=jnp.int32)
+    s, res = t.lookup_unique(s, ids, step=0)
+    s = t.scatter_update(s, res.slot_ix,
+                         jnp.full_like(res.embeddings, 4.5), mask=res.valid)
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0 and stats.spilled > 0
+    p = str(tmp_path / "host.spill")
+    mt.spill(p)
+
+    t2, mt2 = make_3tier(tmp_path)
+    mt2.load(p)
+    assert mt2.disk is not None and len(mt2.disk) == stats.spilled
+    emb = np.asarray(mt2.lookup_with_fallback(s, ids))
+    np.testing.assert_allclose(emb[:, 0], 4.5, rtol=1e-6)
+
+    # load of a never-spilled path = empty tier, not an error
+    t3, mt3 = make(capacity=64)[0], make(capacity=64)[1]
+    mt3.load(str(tmp_path / "never_written.bin"))
+    assert mt3.host is None
