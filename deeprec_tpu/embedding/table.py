@@ -100,19 +100,37 @@ class EmbeddingTable:
     @property
     def use_pallas(self) -> bool:
         """Fused Pallas kernels for the row gather/scatter hot path.
-        "auto" resolves to pallas: tools/bench_lookup.py on v5e measured the
-        DMA kernels ahead wherever they're eligible (dim%128==0, f32 tables:
-        gather 494 vs 362 GB/s, scatter 1117 vs 726 — docs/perf.md), and the
-        ops self-gate back to XLA for ineligible shapes/backends, so "auto"
-        is always the measured winner."""
-        return self.cfg.kernel in ("pallas", "auto")
+        "auto" resolves to pallas where the measured-winners flag says the
+        bench crowned it: tools/bench_lookup.py on v5e measured the DMA
+        kernels ahead wherever they're eligible (dim%128==0, f32 tables:
+        gather 494 vs 362 GB/s, scatter 1117 vs 726 — docs/perf.md), and
+        the ops self-gate back to XLA for ineligible shapes/backends, so
+        "auto" is always the measured winner (AUTO_TRUSTS_F32_ROW flips
+        it off if a re-bench ever disagrees)."""
+        from deeprec_tpu.ops.fused_lookup import AUTO_TRUSTS_F32_ROW
+
+        return self.cfg.kernel == "pallas" or (
+            self.cfg.kernel == "auto" and AUTO_TRUSTS_F32_ROW
+        )
+
+    @property
+    def pair_kernels(self) -> bool:
+        """bf16 pair-granule kernels (gather + in-kernel-SR scatter): on
+        for explicit kernel="pallas"; "auto" keeps XLA for bf16 until a
+        hardware bench crowns the pair kernels (AUTO_TRUSTS_BF16_PAIR —
+        the measured-winners policy)."""
+        from deeprec_tpu.ops.fused_lookup import AUTO_TRUSTS_BF16_PAIR
+
+        return self.cfg.kernel == "pallas" or (
+            self.cfg.kernel == "auto" and AUTO_TRUSTS_BF16_PAIR
+        )
 
     def _gather(self, values: jnp.ndarray, ix: jnp.ndarray) -> jnp.ndarray:
         """values[ix] with clip semantics through the configured kernel."""
         if self.use_pallas:
             from deeprec_tpu.ops.fused_lookup import gather_rows
 
-            return gather_rows(values, ix)
+            return gather_rows(values, ix, pair_kernels=self.pair_kernels)
         return values.at[ix].get(mode="clip")
 
     # Hashable-by-config so EmbeddingTable can ride through jit as a static

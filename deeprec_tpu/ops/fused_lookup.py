@@ -45,16 +45,40 @@ def _on_tpu() -> bool:
 
 
 def _dma_ok(dim: int, dtype) -> bool:
-    """Row-DMA kernels slice single rows out of the HBM-resident table;
-    Mosaic requires those slices aligned to the HBM tiling, so the Pallas
-    path only exists for f32 tables with dim % 128 == 0 (measured on v5e:
+    """Single-row DMA eligibility: f32 tables with dim % 128 == 0 —
+    Mosaic requires HBM slices aligned to the tiling (measured on v5e:
     misaligned widths are a compile error, not a slowdown — dim 64 fails
-    "must be aligned to tiling (128)"; bf16 tiles (2, 128) so a dynamic
-    single-row slice fails "index in dimension 0 is a multiple of 2").
-    Narrower tables take the XLA gather/scatter path, which is
-    bandwidth-bound anyway at small rows (a D<128 row underfills even one
-    DMA granule)."""
+    "must be aligned to tiling (128)"; bf16 tiles pack 2 sublanes per
+    32-bit word so a dynamic single-row slice fails "index in dimension 0
+    is a multiple of 2"). bf16 tables with dim % 128 == 0 have their own
+    PAIR-granule kernels (gather_rows / apply_rows_sr route them via
+    _dma_pair_ok); narrower tables take the XLA path (a D<128 row
+    underfills even one DMA granule — beating XLA there needs a packed
+    storage layout, not a better kernel; see docs/perf.md)."""
     return dim % _LANES == 0 and jnp.dtype(dtype).itemsize == 4
+
+
+def _dma_pair_ok(shape, dtype) -> bool:
+    """bf16 pair-granule eligibility: rows ride 2-row granules (the bf16
+    packing unit), so the table needs dim % 128 == 0 AND an even row
+    count — checked here, not assumed, since the ops are public (an odd
+    C would let a clamped index DMA one row past the array)."""
+    C, dim = shape
+    return (
+        dim % _LANES == 0
+        and C % 2 == 0
+        and jnp.dtype(dtype) == jnp.bfloat16
+    )
+
+
+# Which (kernel, shape-class) combos "auto" trusts. The policy is that
+# auto only resolves to Pallas where a live-hardware bench crowned it
+# (tools/bench_lookup.py, docs/perf.md); the bf16 pair kernels are
+# implemented + oracle-tested but NOT yet measured on hardware, so auto
+# keeps XLA for them until a measurement flips these flags. Both flags
+# are consulted by EmbeddingTable.use_pallas / .pair_kernels.
+AUTO_TRUSTS_F32_ROW = True     # measured round 2: +37% gather, +54% scatter
+AUTO_TRUSTS_BF16_PAIR = False  # pending hardware window
 
 
 def _pad_rows(ix: jnp.ndarray, block: int, fill: int = 0) -> jnp.ndarray:
@@ -65,14 +89,217 @@ def _pad_rows(ix: jnp.ndarray, block: int, fill: int = 0) -> jnp.ndarray:
     return ix
 
 
+def _pad_updates(slot_ix, new_rows, block):
+    """Shared scatter preamble: pad slot indices (-1 = skip) and update
+    rows to a block multiple."""
+    ixp = _pad_rows(
+        jnp.where(slot_ix >= 0, slot_ix, -1).astype(jnp.int32).reshape(-1),
+        block, fill=-1,
+    )
+    if ixp.shape[0] != new_rows.shape[0]:
+        new_rows = jnp.concatenate([
+            new_rows,
+            jnp.zeros(
+                (ixp.shape[0] - new_rows.shape[0], new_rows.shape[1]),
+                new_rows.dtype,
+            ),
+        ])
+    return ixp, new_rows
+
+
+def _sr_bits(seed, shape):
+    """The one seed-derivation for stochastic-rounding bits: every SR
+    path (XLA fallback, row kernel, pair kernel) must use this so their
+    numerics stay interchangeable."""
+    key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
+    return jax.random.bits(key, shape, jnp.uint32)
+
+
+def _sr_round_in_kernel(row_f32, bits_u32):
+    """In-kernel stochastic rounding f32 -> bf16-representable f32
+    (same bit-twiddle as stochastic_round): add uniform noise below the
+    mantissa cut, truncate. Shared by both scatter kernels."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    u = pltpu.bitcast(row_f32, jnp.uint32)
+    u = u + (bits_u32 & jnp.uint32(0xFFFF))
+    u = u & jnp.uint32(0xFFFF0000)
+    return pltpu.bitcast(u, jnp.float32)
+
+
+# ------------------------------------------------- bf16 pair-granule ops
+
+
+def gather_rows_pair(values: jnp.ndarray, ix: jnp.ndarray, *,
+                     block: int = _BLOCK,
+                     interpret: bool = False) -> jnp.ndarray:
+    """bf16 gather via 2-row granules: values [C, D] bf16 (D % 128 == 0,
+    C even), ix [n] int32 -> [n, D]. A dynamic single-row HBM slice is
+    not expressible for bf16 (rows pack 2 sublanes per 32-bit word), so
+    each lookup DMAs the even-aligned PAIR containing the row and emits
+    the wanted half — 2x the HBM read volume of an f32 row gather, but
+    the pair shares the granule the hardware reads anyway."""
+    n = ix.shape[0]
+    C, D = values.shape
+    if not interpret and not (
+        _on_tpu() and _dma_pair_ok(values.shape, values.dtype)
+    ):
+        return values.at[ix].get(mode="clip")
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ixp = _pad_rows(ix.astype(jnp.int32), block)
+    np_ = ixp.shape[0]
+
+    def kernel(ix_ref, values_ref, out_ref, scratch, sems):
+        base = pl.program_id(0) * block
+
+        def pair_dma(slot, i):
+            idx = jnp.clip(ix_ref[base + i], 0, C - 1)
+            g = (idx // 2) * 2  # even-aligned granule base
+            return pltpu.make_async_copy(
+                values_ref.at[pl.ds(g, 2), :],
+                scratch.at[slot],
+                sems.at[slot],
+            )
+
+        pair_dma(0, 0).start()
+
+        def body(i, _):
+            cur = i % 2
+
+            @pl.when(i + 1 < block)
+            def _():
+                pair_dma((i + 1) % 2, i + 1).start()
+
+            pair_dma(cur, i).wait()
+            idx = jnp.clip(ix_ref[base + i], 0, C - 1)
+            out_ref[i, :] = scratch[cur, idx % 2, :]
+            return 0
+
+        jax.lax.fori_loop(0, block, body, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(np_ // block,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (block, D), lambda i, ix_ref: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, D), values.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((np_, D), values.dtype),
+        interpret=interpret,
+    )(ixp, values)
+    return out[:n]
+
+
+def apply_rows_sr_pair(values: jnp.ndarray, slot_ix: jnp.ndarray,
+                       new_rows: jnp.ndarray, seed: jnp.ndarray, *,
+                       interpret: bool = False) -> jnp.ndarray:
+    """bf16 scatter with IN-KERNEL stochastic rounding via 2-row
+    granules: read-modify-write the even-aligned pair containing each
+    target row. Fully serialized (one granule in flight): consecutive
+    updates may share a granule, and the read of update i+1 must observe
+    the write of update i. new_rows [U, D] f32; values [C, D] bf16."""
+    U, D = new_rows.shape
+    C = values.shape[0]
+    if not interpret and not (
+        _on_tpu() and _dma_pair_ok(values.shape, values.dtype)
+    ):
+        return apply_rows_sr(values, slot_ix, new_rows, seed,
+                             use_pallas=False, interpret=False)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ixp, new_rows = _pad_updates(slot_ix, new_rows, _BLOCK)
+    Up = ixp.shape[0]
+    bits = _sr_bits(seed, (Up, D))
+
+    def kernel(ix_ref, rows_ref, bits_ref, vin_ref, vout_ref, scratch, sem):
+        del vin_ref  # aliased with vout_ref
+        g0 = pl.program_id(0) * _BLOCK
+
+        def body(i, _):
+            idx = ix_ref[g0 + i]
+
+            @pl.when(idx >= 0)
+            def _():
+                g = (idx // 2) * 2
+                rd = pltpu.make_async_copy(
+                    vout_ref.at[pl.ds(g, 2), :], scratch, sem.at[0]
+                )
+                rd.start()
+                rd.wait()
+                row = _sr_round_in_kernel(
+                    rows_ref[pl.ds(i, 1), :].astype(jnp.float32),
+                    bits_ref[pl.ds(i, 1), :],
+                )
+                scratch[pl.ds(idx % 2, 1), :] = row.astype(scratch.dtype)
+                wr = pltpu.make_async_copy(
+                    scratch, vout_ref.at[pl.ds(g, 2), :], sem.at[0]
+                )
+                wr.start()
+                wr.wait()
+
+            return 0
+
+        jax.lax.fori_loop(0, _BLOCK, body, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Up // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec(
+                (_BLOCK, D), lambda i, ix_ref: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_BLOCK, D), lambda i, ix_ref: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, D), values.dtype),
+            pltpu.SemaphoreType.DMA((1,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(values.shape, values.dtype),
+        input_output_aliases={3: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(ixp, new_rows, bits, values)
+
+
 # ------------------------------------------------------------- gather_rows
 
 
 def gather_rows(values: jnp.ndarray, ix: jnp.ndarray, *,
-                block: int = _BLOCK, interpret: bool = False) -> jnp.ndarray:
+                block: int = _BLOCK, interpret: bool = False,
+                pair_kernels: bool = False) -> jnp.ndarray:
     """values [C, D], ix [n] int32 -> [n, D]; out-of-range ix clamp (the
-    'clip' semantics of the jnp fallback). Rows ride a 2-deep DMA pipeline."""
+    'clip' semantics of the jnp fallback). Rows ride a 2-deep DMA pipeline.
+    pair_kernels=True additionally routes eligible bf16 tables through the
+    pair-granule kernel (explicit kernel="pallas" or a measured-winners
+    flag — see AUTO_TRUSTS_BF16_PAIR)."""
     n = ix.shape[0]
+    if pair_kernels and _dma_pair_ok(values.shape, values.dtype) and (
+        interpret or _on_tpu()
+    ):
+        return gather_rows_pair(values, ix, block=block, interpret=interpret)
     if not interpret and not (_on_tpu() and _dma_ok(values.shape[1], values.dtype)):
         return values.at[ix].get(mode="clip")
 
@@ -236,13 +463,21 @@ def stochastic_round(x: jnp.ndarray, key: jnp.ndarray,
 def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
                   new_rows: jnp.ndarray, seed: jnp.ndarray, *,
                   block: int = _BLOCK, interpret: bool = False,
-                  use_pallas: bool = True) -> jnp.ndarray:
+                  use_pallas: bool = True,
+                  pair_kernels: bool = False) -> jnp.ndarray:
     """Scatter new_rows [U, D] f32 into values [C, D] at slot_ix [U]
     (< 0 = skip). bf16 tables round stochastically; f32 tables store exact.
     Returns the updated values array (aliased in-place under jit on TPU).
-    use_pallas=False keeps the XLA scatter (still stochastic-rounding bf16)."""
+    use_pallas=False keeps the XLA scatter (still stochastic-rounding bf16);
+    pair_kernels=True routes eligible bf16 tables through the pair-granule
+    read-modify-write kernel with IN-KERNEL stochastic rounding."""
     U, D = new_rows.shape
     C = values.shape[0]
+    if use_pallas and pair_kernels and _dma_pair_ok(values.shape, values.dtype) and (
+        interpret or _on_tpu()
+    ):
+        return apply_rows_sr_pair(values, slot_ix, new_rows, seed,
+                                  interpret=interpret)
     if not interpret and not (use_pallas and _on_tpu() and _dma_ok(D, values.dtype)):
         if values.dtype == jnp.bfloat16:
             key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
@@ -256,20 +491,14 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
     from jax.experimental.pallas import tpu as pltpu
 
     # Pad with -1 (skip): a 0-fill would scatter garbage rows into slot 0.
-    ixp = _pad_rows(jnp.where(slot_ix >= 0, slot_ix, -1).astype(jnp.int32)
-                    .reshape(-1), block, fill=-1)
-    if ixp.shape[0] != U:
-        new_rows = jnp.concatenate(
-            [new_rows, jnp.zeros((ixp.shape[0] - U, D), new_rows.dtype)]
-        )
+    ixp, new_rows = _pad_updates(slot_ix, new_rows, block)
     Up = ixp.shape[0]
     sr = values.dtype == jnp.bfloat16
     # Random bits come in as a tensor (not in-kernel PRNG): identical
     # numerics across compiled TPU and interpret mode, at the cost of
     # U*D*4 extra bytes of traffic — negligible next to the row writes.
     if sr:
-        key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
-        bits = jax.random.bits(key, (Up, D), jnp.uint32)
+        bits = _sr_bits(seed, (Up, D))
         bits_dim = D
     else:
         # f32 path never reads the bits: ship a 1-wide dummy, not U*D zeros.
@@ -284,10 +513,7 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
             slot = i % 2
             row = rows_ref[pl.ds(i, 1), :].astype(jnp.float32)  # (1, D)
             if sr:
-                u = pltpu.bitcast(row, jnp.uint32)
-                u = u + (bits_ref[pl.ds(i, 1), :] & jnp.uint32(0xFFFF))
-                u = u & jnp.uint32(0xFFFF0000)
-                row = pltpu.bitcast(u, jnp.float32)
+                row = _sr_round_in_kernel(row, bits_ref[pl.ds(i, 1), :])
             scratch[pl.ds(slot, 1), :] = row.astype(scratch.dtype)
             idx = ix_ref[g * block + i]
 

@@ -77,7 +77,7 @@ def apply_gradients(
 
     values = apply_rows_sr(
         state.values, jnp.where(ok, res.slot_ix, -1), new_value, step,
-        use_pallas=table.use_pallas,
+        use_pallas=table.use_pallas, pair_kernels=table.pair_kernels,
     )
     slots = dict(state.slots)
     for name, rows in new_slots.items():

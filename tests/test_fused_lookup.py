@@ -167,3 +167,71 @@ def test_gather_rows_xla_fallback_identical():
     a = gather_rows(vals, ix)  # XLA path on CPU
     b = gather_rows(vals, ix, interpret=True)  # Pallas interpreter
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_gather_rows_pair_bf16_matches_oracle():
+    """bf16 pair-granule gather == XLA gather, including odd indices,
+    duplicates, clamping, and non-block-multiple n."""
+    from deeprec_tpu.ops.fused_lookup import gather_rows_pair
+
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(
+        rng.normal(0, 1, (256, 128)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    ix = jnp.asarray([1, 1, 0, 255, 254, 7, -3, 300, 13, 13, 12, 200, 77],
+                     jnp.int32)
+    out = gather_rows_pair(vals, ix, block=8, interpret=True)
+    expect = np.asarray(vals)[np.clip(np.asarray(ix), 0, 255)]
+    assert out.dtype == jnp.bfloat16 and out.shape == (13, 128)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+    # dispatch: gather_rows(pair_kernels=True) routes bf16 here under
+    # interpret, and to XLA when pair_kernels=False
+    out2 = gather_rows(vals, ix, block=8, interpret=True, pair_kernels=True)
+    np.testing.assert_array_equal(np.asarray(out2), expect)
+
+
+def test_apply_rows_sr_pair_bf16_matches_semantics():
+    """Pair-granule RMW scatter: written rows round to a bf16 neighbor of
+    the f32 target, untouched rows (including the OTHER half of a touched
+    granule) are bit-identical, skips (<0) skip, and consecutive updates
+    sharing a granule both land."""
+    from deeprec_tpu.ops.fused_lookup import apply_rows_sr_pair
+
+    rng = np.random.default_rng(4)
+    vals = jnp.asarray(
+        rng.normal(0, 1, (64, 128)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    before = np.asarray(vals).copy()
+    # rows 6 and 7 share a granule; 11 is odd-half-only; 20 even-half-only
+    slot_ix = jnp.asarray([6, 7, 11, 20, -1], jnp.int32)
+    new = jnp.asarray(rng.normal(0, 1, (5, 128)).astype(np.float32))
+    out = np.asarray(
+        apply_rows_sr_pair(vals, slot_ix, new, jnp.int32(9), interpret=True)
+    )
+    newf = np.asarray(new, np.float32)
+    for row, target in ((6, 0), (7, 1), (11, 2), (20, 3)):
+        lo = np.asarray(jnp.asarray(newf[target]).astype(jnp.bfloat16))
+        # stochastic rounding: each element equals a bf16 neighbor of the
+        # f32 value (nextafter up or the truncation down)
+        got = out[row]
+        down = np.asarray(
+            jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(
+                    jnp.asarray(newf[target]), jnp.uint32
+                ) & jnp.uint32(0xFFFF0000), jnp.float32
+            ).astype(jnp.bfloat16)
+        )
+        up = np.asarray(
+            jax.lax.bitcast_convert_type(
+                (jax.lax.bitcast_convert_type(
+                    jnp.asarray(newf[target]), jnp.uint32
+                ) & jnp.uint32(0xFFFF0000)) + jnp.uint32(0x10000),
+                jnp.float32,
+            ).astype(jnp.bfloat16)
+        )
+        ok = (got == down) | (got == up)
+        assert ok.all(), (row, np.nonzero(~ok))
+    # untouched rows — ESPECIALLY granule-mates 10 and 21 — unchanged
+    untouched = [i for i in range(64) if i not in (6, 7, 11, 20)]
+    np.testing.assert_array_equal(out[untouched], before[untouched])

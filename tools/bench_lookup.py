@@ -53,13 +53,15 @@ def main(argv=None):
     backend = jax.default_backend()
     if backend != "tpu":
         print(f"WARNING: running on {backend}; TPU is the question", file=sys.stderr)
-    from deeprec_tpu.ops.fused_lookup import _dma_ok
+    from deeprec_tpu.ops.fused_lookup import _dma_ok, _dma_pair_ok
 
-    if not _dma_ok(args.dim, jnp.dtype(args.dtype)):
+    pair = _dma_pair_ok((1 << args.capacity, args.dim), jnp.dtype(args.dtype))
+    if not _dma_ok(args.dim, jnp.dtype(args.dtype)) and not pair:
         print(
             f"WARNING: dim={args.dim} dtype={args.dtype} is ineligible for the "
-            "Pallas row-DMA kernels (needs f32, dim%128==0) — the 'pallas' "
-            "rows below fall back to XLA, so the verdict is XLA-vs-XLA",
+            "Pallas row-DMA kernels (f32 dim%128==0) and the bf16 pair "
+            "kernels (bf16 dim%128==0) — the 'pallas' rows below fall back "
+            "to XLA, so the verdict is XLA-vs-XLA",
             file=sys.stderr,
         )
 
@@ -72,12 +74,13 @@ def main(argv=None):
     seed = jnp.int32(0)
 
     xla_gather = jax.jit(lambda v, i: v.at[i].get(mode="clip"))
-    pallas_gather = jax.jit(lambda v, i: gather_rows(v, i))
+    pallas_gather = jax.jit(lambda v, i: gather_rows(v, i, pair_kernels=pair))
     xla_scatter = jax.jit(
         lambda v, i, r: apply_rows_sr(v, i, r, seed, use_pallas=False)
     )
     pallas_scatter = jax.jit(
-        lambda v, i, r: apply_rows_sr(v, i, r, seed, use_pallas=True)
+        lambda v, i, r: apply_rows_sr(v, i, r, seed, use_pallas=True,
+                                      pair_kernels=pair)
     )
 
     bytes_g = U * D * dt.itemsize  # rows read
@@ -99,6 +102,12 @@ def main(argv=None):
         x, pl_ = results[f"{op}/xla"], results[f"{op}/pallas"]
         winner = "pallas" if pl_ > x * 1.05 else ("xla" if x > pl_ * 1.05 else "tie")
         print(f"verdict[{op}]: {winner} (xla {x:.1f} vs pallas {pl_:.1f} GB/s)")
+    if pair:
+        print(
+            "note: bf16 pair kernels measured — if pallas won both ops, flip "
+            "AUTO_TRUSTS_BF16_PAIR in ops/fused_lookup.py (measured-winners "
+            "policy) so kernel='auto' serves them."
+        )
 
 
 if __name__ == "__main__":
