@@ -1,16 +1,29 @@
 #!/usr/bin/env bash
-# CI entry (the cibuild/*.sh analog): native build, full test suite on the
+# CI entry (the cibuild/*.sh analog): native build, test suite on the
 # virtual 8-device CPU mesh, driver entry checks, CPU bench smoke.
+#
+# Test tiers (single-core box: compile time dominates):
+#   cibuild/smoke.sh          — curated subset, quick green (~2.5 min)
+#   pytest -q                 — everything but slow-marked (~10-15 min)
+#   DEEPREC_FULL_TESTS=1 ...  — the full grid incl. multi-process launches
+# This script runs the default tier; pass SMOKE=1 for the quick tier.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== native build =="
 make -C deeprec_tpu/native
 
-echo "== tests (virtual 8-device CPU mesh) =="
-env PYTHONPATH= JAX_PLATFORMS=cpu \
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python -m pytest tests/ -q
+if [[ "${SMOKE:-0}" == "1" ]]; then
+  echo "== tests (smoke tier) =="
+  env PYTHONPATH= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      bash cibuild/smoke.sh
+else
+  echo "== tests (virtual 8-device CPU mesh) =="
+  env PYTHONPATH= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python -m pytest tests/ -q
+fi
 
 echo "== driver entries =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \

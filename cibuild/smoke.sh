@@ -1,0 +1,24 @@
+#!/bin/bash
+# Quick green: one representative test per subsystem, target < 5 min on a
+# single core. The default `pytest -q` run (~10 min serial) covers
+# everything but the slow-marked grid; DEEPREC_FULL_TESTS=1 runs that too.
+set -e
+cd "$(dirname "$0")/.."
+exec python -m pytest -q -p no:cacheprovider \
+  tests/test_table.py \
+  tests/test_fused_lookup.py \
+  tests/test_predict_pb.py \
+  tests/test_kafka.py \
+  tests/test_data.py::test_determinism_same_seed_same_results \
+  tests/test_train_e2e.py::test_wdl_learns_synthetic_criteo \
+  tests/test_sharded.py::test_sharded_matches_single_device \
+  tests/test_a2a.py::test_a2a_matches_allgather_and_local \
+  tests/test_checkpoint.py::test_full_save_restore_roundtrip \
+  tests/test_multi_tier.py \
+  tests/test_serving.py::test_http_server_end_to_end \
+  tests/test_serving.py::test_protobuf_wire_end_to_end \
+  tests/test_processor_cabi.py \
+  tests/test_elastic_live.py::test_coordinator_plan_epoch_and_acks \
+  tests/test_attention.py::test_flash_matches_reference \
+  tests/test_feature_demos.py::test_kafka_streaming_demo \
+  "$@"

@@ -1,0 +1,57 @@
+"""Shared scaffolding for the feature demos: tiny WDL on synthetic
+Criteo, a train loop with loss/AUC logging — the MonitoredTrainingSession
+shape of the reference demos, minus the boilerplate."""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def parse_args(extra=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--vocab", type=int, default=5000)
+    for fn in extra or ():
+        fn(p)
+    return p.parse_args()
+
+
+def train(model, args, sparse_opt=None, dense_opt=None, hook=None,
+          batches=None):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.metrics import AucState, auc_compute, auc_update
+
+    tr = Trainer(model, sparse_opt or Adagrad(lr=0.1),
+                 dense_opt or optax.adam(2e-3))
+    st = tr.init(0)
+    num_cat = len([f for f in model.features if hasattr(f, "table")])
+    gen = batches or SyntheticCriteo(
+        batch_size=args.batch, num_cat=num_cat or 4, num_dense=2,
+        vocab=args.vocab, seed=3,
+    )
+    it = iter(gen) if not hasattr(gen, "batch") else None
+    t0 = time.time()
+    for step in range(args.steps):
+        raw = next(it) if it is not None else gen.batch()
+        b = {k: jnp.asarray(v) for k, v in raw.items()}
+        st, mets = tr.train_step(st, b)
+        if hook is not None:
+            st = hook(tr, st, step) or st
+        if step % 50 == 0 or step == args.steps - 1:
+            print(f"step {step:5d}  loss {float(mets['loss']):.4f}  "
+                  f"({(step + 1) / (time.time() - t0):.1f} steps/s)")
+    auc = AucState.create()
+    for _ in range(5):
+        raw = next(it) if it is not None else gen.batch()
+        b = {k: jnp.asarray(v) for k, v in raw.items()}
+        _, p = tr.eval_step(st, b)
+        auc = auc_update(auc, p, b["label"])
+    print(f"eval AUC {float(auc_compute(auc)):.4f}")
+    return tr, st

@@ -1,0 +1,25 @@
+"""AdagradDecay demo (reference features/adagraddecay_optimizer):
+Adagrad whose accumulator decays every N global steps, so old gradients
+stop dominating long-running streams."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+from _demo import parse_args, train  # noqa: E402
+
+from deeprec_tpu.models import WDL  # noqa: E402
+from deeprec_tpu.optim import AdagradDecay  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    model = WDL(emb_dim=16, capacity=1 << 14, hidden=(64, 32), num_cat=4,
+                num_dense=2)
+    train(model, args,
+          sparse_opt=AdagradDecay(lr=0.1, accumulator_decay_step=100,
+                                 accumulator_decay_rate=0.9))
+
+
+if __name__ == "__main__":
+    main()

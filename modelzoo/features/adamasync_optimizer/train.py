@@ -1,0 +1,23 @@
+"""AdamAsync sparse optimizer demo (reference
+features/adamasync_optimizer): per-key Adam with per-row beta-power
+slots — the PS-free translation of DeepRec's AdamAsync."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+from _demo import parse_args, train  # noqa: E402
+
+from deeprec_tpu.models import WDL  # noqa: E402
+from deeprec_tpu.optim import AdamAsync  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    model = WDL(emb_dim=16, capacity=1 << 14, hidden=(64, 32), num_cat=4,
+                num_dense=2)
+    train(model, args, sparse_opt=AdamAsync(lr=0.01))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Dynamic-dimension EV demo (reference
+features/dynamic_dimension_embedding_variable): rare keys train/serve a
+PREFIX of the embedding vector; the dim steps up with frequency."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deeprec_tpu import EmbeddingTable, TableConfig  # noqa: E402
+from deeprec_tpu.embedding.compose import DynamicDimEmbedding  # noqa: E402
+
+
+def main():
+    t = EmbeddingTable(TableConfig(name="dyn", dim=32, capacity=1 << 12))
+    dyn = DynamicDimEmbedding(t, dim_tiers=(8, 16, 32), freq_tiers=(3, 10))
+    s = t.create()
+    rng = np.random.default_rng(0)
+    for step in range(12):
+        # zipf-ish stream: id 1 is hot, tail ids rare
+        ids = jnp.asarray(np.minimum(rng.zipf(1.5, 512), 4000), jnp.int32)
+        s, res = dyn.lookup_unique(s, ids, step=step)
+    eff = dyn.effective_dim(s, res)
+    uids = np.asarray(res.uids)[np.asarray(res.valid)]
+    effv = np.asarray(eff)[np.asarray(res.valid)]
+    hot = effv[uids == 1]
+    print(f"hot id dim: {hot[0] if len(hot) else '-'}; "
+          f"tail ids at dim 8: {(effv == 8).sum()}/{len(effv)}")
+    assert len(hot) and hot[0] == 32  # hot key graduated to full width
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""EmbeddingVariable demo (reference features/embedding_variable):
+hash-table embeddings with a counter admission filter and TTL eviction —
+no vocabulary size planning, cold ids filtered, stale ids evicted."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+from _demo import parse_args, train  # noqa: E402
+
+from deeprec_tpu.config import (  # noqa: E402
+    CounterFilter,
+    EmbeddingVariableOption,
+    GlobalStepEvict,
+)
+from deeprec_tpu.models import WDL  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    ev = EmbeddingVariableOption(
+        counter_filter=CounterFilter(filter_freq=2),   # admit at 2nd sight
+        global_step_evict=GlobalStepEvict(steps_to_live=500),
+    )
+    model = WDL(emb_dim=16, capacity=1 << 14, hidden=(64, 32), num_cat=4,
+                num_dense=2, ev=ev)
+
+    def evict_hook(tr, st, step):
+        if step and step % 100 == 0:
+            st = tr.evict_tables(st)
+            sizes = {n: int(t.size(tr.table_state(st, n)))
+                     for n, t in tr.tables.items()}
+            print(f"  evict @ {step}: table sizes {sizes}")
+        return st
+
+    train(model, args, hook=evict_hook)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Fused-kernel demo (reference features/gpu_fused_embedding): opt a
+table into the Pallas DMA kernels + bf16 values with stochastic
+rounding. On CPU every path falls back to identical-semantics XLA; on
+TPU kernel eligibility is dim%128==0 (f32 rows / bf16 pair granules)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+from _demo import parse_args, train  # noqa: E402
+
+import dataclasses  # noqa: E402
+
+from deeprec_tpu.features import SparseFeature  # noqa: E402
+from deeprec_tpu.models import WDL  # noqa: E402
+
+
+def main():
+    args = parse_args(extra=[lambda p: p.add_argument(
+        "--bf16", action="store_true")])
+    model = WDL(emb_dim=128, capacity=1 << 14, hidden=(64, 32), num_cat=4,
+                num_dense=2)
+    over = {"kernel": "pallas"}
+    if args.bf16:
+        over["value_dtype"] = "bfloat16"
+    model.features = [
+        dataclasses.replace(f, table=dataclasses.replace(f.table, **over))
+        if isinstance(f, SparseFeature) else f
+        for f in model.features
+    ]
+    train(model, args)
+
+
+if __name__ == "__main__":
+    main()

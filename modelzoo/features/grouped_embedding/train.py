@@ -1,0 +1,24 @@
+"""Grouped embedding demo (reference features/grouped_embedding):
+same-config tables auto-bundle into ONE stacked [T, C, D] table and one
+vmapped probe — the group_embedding_lookup analog with zero user code."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+from _demo import parse_args, train  # noqa: E402
+
+from deeprec_tpu.models import WDL  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    model = WDL(emb_dim=16, capacity=1 << 14, hidden=(64, 32), num_cat=8,
+                num_dense=2)
+    tr, st = train(model, args)
+    print("bundles:", {n: len(b.features) for n, b in tr.bundles.items()},
+          "(8 features -> 1 stacked probe)")
+
+
+if __name__ == "__main__":
+    main()
