@@ -34,6 +34,66 @@ class StorageType(enum.Enum):
     # bounded host DRAM tier, log-structured disk tier below it
     HBM_DRAM_SSD = "hbm_dram_ssd"
 
+    @classmethod
+    def from_reference(cls, name) -> "StorageType":
+        """Map any of the reference's 13 StorageType values — proto
+        names OR field numbers (embedding/config.proto:5-27) — onto the
+        TPU tiers, so configs
+        written against DeepRec resolve without edits. The physical
+        reality on a TPU-VM: compute reads come from HBM, the host has
+        DRAM, and below that there is a filesystem — PMEM does not exist
+        and LevelDB/SSDHASH are both \"a disk-backed log\", so
+          * PMEM_* tiers map to the host DRAM tier,
+          * SSDHASH / LEVELDB tiers map to the log-structured disk tier,
+          * every multi-level combo keeps its LEVEL STRUCTURE with each
+            level mapped as above (e.g. DRAM_PMEM -> HBM_DRAM: a fast
+            working set over a larger colder store).
+        """
+        if isinstance(name, cls):
+            return name
+        # DeepRec's canonical config form is the proto ENUM VALUE (an int
+        # in Python: config_pb2.StorageType.DRAM_SSDHASH == 12) — accept
+        # the field numbers as well as the names.
+        by_number = {
+            0: "DEFAULT", 1: "DRAM", 2: "PMEM_MEMKIND", 3: "PMEM_LIBPMEM",
+            4: "SSDHASH", 5: "LEVELDB", 6: "HBM", 11: "DRAM_PMEM",
+            12: "DRAM_SSDHASH", 13: "HBM_DRAM", 14: "DRAM_LEVELDB",
+            101: "DRAM_PMEM_SSDHASH", 102: "HBM_DRAM_SSDHASH",
+        }
+        if isinstance(name, int) and not isinstance(name, bool):
+            if name not in by_number:
+                raise ValueError(
+                    f"unknown reference StorageType number {name}; known "
+                    f"field numbers: {sorted(by_number)}"
+                )
+            name = by_number[name]
+        key = str(name).strip().upper()
+        table = {
+            "DEFAULT": cls.HBM,
+            "HBM": cls.HBM,
+            "DRAM": cls.DRAM,
+            "PMEM_MEMKIND": cls.DRAM,
+            "PMEM_LIBPMEM": cls.DRAM,
+            "SSDHASH": cls.HBM_DRAM_SSD,
+            "LEVELDB": cls.HBM_DRAM_SSD,
+            "DRAM_PMEM": cls.HBM_DRAM,
+            "DRAM_SSDHASH": cls.HBM_DRAM_SSD,
+            "HBM_DRAM": cls.HBM_DRAM,
+            "DRAM_LEVELDB": cls.HBM_DRAM_SSD,
+            "DRAM_PMEM_SSDHASH": cls.HBM_DRAM_SSD,
+            "HBM_DRAM_SSDHASH": cls.HBM_DRAM_SSD,
+        }
+        if key in table:
+            return table[key]
+        try:  # our own value strings ("hbm_dram", ...)
+            return cls(str(name).lower())
+        except ValueError:
+            raise ValueError(
+                f"unknown storage type {name!r}; reference names "
+                f"{sorted(table)} and native values "
+                f"{[m.value for m in cls]} are accepted"
+            ) from None
+
 
 @dataclasses.dataclass(frozen=True)
 class InitializerOption:
@@ -133,6 +193,15 @@ class StorageOption:
     # HBM_DRAM_SSD: max rows held in the host DRAM tier before the coldest
     # spill to the disk tier (0 = unbounded, disk tier unused)
     host_capacity: int = 0
+
+    def __post_init__(self):
+        # Accept reference StorageType names and plain strings (configs
+        # written against DeepRec's enum resolve without edits).
+        if not isinstance(self.storage_type, StorageType):
+            object.__setattr__(
+                self, "storage_type",
+                StorageType.from_reference(self.storage_type),
+            )
 
 
 @dataclasses.dataclass(frozen=True)

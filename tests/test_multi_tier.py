@@ -329,3 +329,42 @@ def test_fresh_instance_load_serves_all_tiers(tmp_path):
     t3, mt3 = make(capacity=64)[0], make(capacity=64)[1]
     mt3.load(str(tmp_path / "never_written.bin"))
     assert mt3.host is None
+
+
+def test_reference_storage_type_names_resolve():
+    """All 13 reference StorageType values — names AND proto field
+    numbers (embedding/config.proto:5-27) — resolve to the TPU tiers, so
+    DeepRec-written configs need no edits."""
+    from deeprec_tpu import StorageOption
+    from deeprec_tpu.config import StorageType as S
+
+    expect = {
+        "DEFAULT": S.HBM, "HBM": S.HBM, "DRAM": S.DRAM,
+        "PMEM_MEMKIND": S.DRAM, "PMEM_LIBPMEM": S.DRAM,
+        "SSDHASH": S.HBM_DRAM_SSD, "LEVELDB": S.HBM_DRAM_SSD,
+        "DRAM_PMEM": S.HBM_DRAM, "DRAM_SSDHASH": S.HBM_DRAM_SSD,
+        "HBM_DRAM": S.HBM_DRAM, "DRAM_LEVELDB": S.HBM_DRAM_SSD,
+        "DRAM_PMEM_SSDHASH": S.HBM_DRAM_SSD,
+        "HBM_DRAM_SSDHASH": S.HBM_DRAM_SSD,
+    }
+    for name, want in expect.items():
+        assert S.from_reference(name) is want, name
+        # StorageOption accepts the raw string too
+        assert StorageOption(storage_type=name).storage_type is want
+    # proto field NUMBERS (DeepRec's canonical config form) work too
+    numbers = {0: S.HBM, 1: S.DRAM, 2: S.DRAM, 3: S.DRAM,
+               4: S.HBM_DRAM_SSD, 5: S.HBM_DRAM_SSD, 6: S.HBM,
+               11: S.HBM_DRAM, 12: S.HBM_DRAM_SSD, 13: S.HBM_DRAM,
+               14: S.HBM_DRAM_SSD, 101: S.HBM_DRAM_SSD,
+               102: S.HBM_DRAM_SSD}
+    for num, want in numbers.items():
+        assert S.from_reference(num) is want, num
+        assert StorageOption(storage_type=num).storage_type is want
+    with __import__("pytest").raises(ValueError, match="field numbers"):
+        S.from_reference(57)
+    # our own lowercase values still work, unknown names fail loudly
+    assert StorageOption(storage_type="hbm_dram").storage_type is S.HBM_DRAM
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown storage type"):
+        S.from_reference("FLOPPY_DISK")

@@ -5,5 +5,5 @@ OUT=$(timeout 90 python -c "import jax; d=jax.devices(); print('UP', d)" 2>&1 | 
 if echo "$OUT" | grep -q "^UP"; then
   echo "$TS UP $OUT" >> /tmp/tpu_probe.log
 else
-  echo "$TS DOWN" >> /tmp/tpu_probe.log
+  echo "$TS DOWN ${OUT:0:160}" >> /tmp/tpu_probe.log
 fi
