@@ -548,6 +548,32 @@ def test_sample_aware_compression_grouped_users(tmp_path):
     assert max(seen) == B
     assert min(seen) == n_users  # fewer user-tower FLOPs: 4 rows, not 32
 
+    # the HTTP frontend routes the flag end-to-end (and a tower-less
+    # model would get a 400 through the same route)
+    import json as _json
+    import urllib.request
+
+    from deeprec_tpu.serving import HttpServer, ModelServer
+
+    server = ModelServer(pred, max_batch=64, max_wait_ms=1)
+    http = HttpServer(server, port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/predict",
+            data=_json.dumps({
+                "features": {k: v.tolist() for k, v in batch.items()},
+                "group_users": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            via_http = _json.loads(r.read())["predictions"]
+        np.testing.assert_allclose(np.asarray(via_http), plain,
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        http.stop()
+        server.close()
+
     # odd client batch sizes ride the power-of-two bucket ladder (no
     # per-size compile storm) and slice back to the client row count
     odd = {k: v[:29] for k, v in batch.items()}
