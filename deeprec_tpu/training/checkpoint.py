@@ -185,18 +185,31 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, trainer: Trainer, keep: int = 3,
-                 sharded_io: Optional[bool] = None):
+                 sharded_io: Optional[bool] = None,
+                 datasets: Optional[Dict[str, object]] = None):
         """sharded_io: write per-process shard-part files instead of the
         gathered single-file format (pod-scale: no process_allgather on
         save, no host-side global materialization on restore). Default None
         = auto: parts when the trainer is sharded AND multi-process; the
         gathered format is kept for single-process runs where it is cheap
         and produces fewer files. Either format restores onto any topology;
-        sharded trainers also restore either format."""
+        sharded trainers also restore either format.
+
+        datasets: {name: reader} of input-state carriers (anything with
+        ``save() -> dict`` / ``restore(dict)`` — KafkaStreamReader,
+        TCPStreamReader, FileTailReader, WorkQueue). Their positions are
+        written with every checkpoint and restored with the model, the
+        reference's dataset-state-in-checkpoint behavior (KafkaDataset
+        offsets ride TF checkpoints, kafka_dataset_op.cc SaveInternal).
+        Positions are PER-PROCESS (each process checkpoints its own
+        readers); after an elastic topology change a missing per-process
+        file is skipped — data rebalancing across a rescale is the shared
+        WorkQueue's job, not a byte-offset's."""
         self.dir = directory
         self.trainer = trainer
         self.keep = keep
         self.sharded_io = sharded_io
+        self.datasets = dict(datasets or {})
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------- helpers
@@ -431,7 +444,7 @@ class CheckpointManager:
         # validates against the new one.
         getattr(self, "_manifest_cache", {}).pop(path, None)
         try:
-            if write or parts:
+            if write or parts or self.datasets:
                 os.makedirs(path, exist_ok=True)
             if parts:
                 # Pod-scale path: every process writes ONLY its addressable
@@ -454,8 +467,15 @@ class CheckpointManager:
                     mf = os.path.join(path, "manifest.json")
                     if os.path.exists(mf):
                         os.remove(mf)
-                    # table_*.npz matches gathered AND .partNNNNN.npz files
-                    for stale in _glob.glob(os.path.join(path, "table_*.npz")):
+                    # table_*.npz matches gathered AND .partNNNNN.npz
+                    # files; stale dataset positions (e.g. pids beyond a
+                    # downscaled topology) must go too, or a later wider
+                    # restore rewinds readers to a dead run's offsets
+                    for stale in _glob.glob(
+                        os.path.join(path, "table_*.npz")
+                    ) + _glob.glob(
+                        os.path.join(path, "datasets.part*.json")
+                    ):
                         os.remove(stale)
                 self._sync(f"ckpt-{kind}-{step}-clear")
                 for bname in self.trainer.bundles:
@@ -469,9 +489,10 @@ class CheckpointManager:
                             ),
                             **arrays,
                         )
+                self._write_datasets(path)
                 # The manifest is the completeness marker (_list() ignores
                 # dirs without one): it must not exist until every process
-                # has finished writing its part files.
+                # has finished writing its part files AND dataset positions.
                 self._sync(f"ckpt-{kind}-{step}-parts")
             else:
                 for bname in self.trainer.bundles:
@@ -482,6 +503,11 @@ class CheckpointManager:
                                 os.path.join(path, f"table_{bname}_{tag}.npz"),
                                 **arrays,
                             )
+            if not parts:
+                # parts mode wrote positions before its pre-manifest
+                # barrier above; the gathered path writes them here.
+                self._write_datasets(path)
+                self._sync(f"ckpt-{kind}-{step}-datasets")
             if write:
                 np.savez(os.path.join(path, "dense.npz"),
                          **_tree_to_npz_dict(state.dense))
@@ -509,6 +535,19 @@ class CheckpointManager:
             # which beats a silent deadlock.)
             self._sync(f"ckpt-{kind}-{step}")
         return self._clear_dirty(state), path
+
+    def _write_datasets(self, path: str) -> None:
+        """Every process writes its OWN readers' positions
+        (dataset-state-in-checkpoint, KafkaDataset parity)."""
+        if not self.datasets:
+            return
+        dpath = os.path.join(
+            path, f"datasets.part{jax.process_index():05d}.json"
+        )
+        with open(dpath, "w") as f:
+            json.dump(
+                {name: r.save() for name, r in self.datasets.items()}, f
+            )
 
     # ------------------------------------------------------------- restore
 
@@ -542,6 +581,7 @@ class CheckpointManager:
         ]
         with open(os.path.join(self.dir, self._latest_dir(), "manifest.json")) as f:
             step = json.load(f)["step"]
+        self._restore_datasets(chain)
         if self._is_sharded() and (
             jax.process_count() > 1 or self._use_parts()
         ):
@@ -555,6 +595,26 @@ class CheckpointManager:
             dense=state.dense,
             opt_state=state.opt_state,
         )
+
+    def _restore_datasets(self, chain: List[str]) -> None:
+        """Rewind registered input readers to the NEWEST chain dir that
+        carries this process's dataset positions. Missing files (pre-
+        datasets checkpoints, or a rescaled topology) are skipped — the
+        model state still restores; data rebalancing across topologies is
+        the WorkQueue's job."""
+        if not self.datasets:
+            return
+        fname = f"datasets.part{jax.process_index():05d}.json"
+        for path in reversed(chain):
+            p = os.path.join(path, fname)
+            if not os.path.exists(p):
+                continue
+            with open(p) as f:
+                saved = json.load(f)
+            for name, reader in self.datasets.items():
+                if name in saved:
+                    reader.restore(saved[name])
+            return
 
     @staticmethod
     def _get_member(sub, m):

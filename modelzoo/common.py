@@ -127,6 +127,9 @@ def make_data(args, kind: str):
                 )
             q = D.WorkQueue(paths, num_epochs=args.epochs, shuffle=True,
                             seed=args.seed, num_slices=args.num_slices)
+            # registered with the CheckpointManager in run(): queue
+            # position checkpoints WITH the model
+            args._datasets = {"workqueue": q}
             # training wants one compiled batch shape: drop per-slice
             # remainders (size the slices >= batch_size)
             return D.staged(
@@ -205,16 +208,19 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
         put = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
 
     state = trainer.init(args.seed)
+    # data FIRST: make_data registers input-state carriers (WorkQueue) in
+    # args._datasets, which the CheckpointManager must know about BEFORE
+    # restore() so queue positions rewind with the model.
+    data = make_data(args, data_kind)
     ck = None
     if args.checkpoint:
-        ck = CheckpointManager(args.checkpoint, trainer)
+        ck = CheckpointManager(args.checkpoint, trainer,
+                               datasets=getattr(args, "_datasets", None))
         try:
             state = ck.restore()
             print(f"restored from step {int(state.step)}")
         except FileNotFoundError:
             pass
-
-    data = make_data(args, data_kind)
     eval_batches = [put(next(iter(data))) for _ in range(args.eval_batches)]
 
     tracer = None

@@ -115,3 +115,65 @@ def test_sharded_save_restore_and_reshard(tmp_path):
     st1 = CheckpointManager(str(tmp_path), tr1).restore()
     _, p1 = tr1.eval_step(st1, batches[0])
     np.testing.assert_allclose(np.asarray(p8), np.asarray(p1), atol=1e-5)
+
+
+def test_dataset_state_rides_checkpoints(tmp_path):
+    """Input positions checkpoint WITH the model (the reference stores
+    KafkaDataset offsets in TF checkpoints — kafka_dataset_op.cc
+    SaveInternal): register readers with the CheckpointManager, save,
+    restore into FRESH readers, and consumption resumes exactly."""
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo, WorkQueue
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    model = WDL(emb_dim=8, capacity=1 << 10, hidden=(16,), num_cat=3,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=64, num_cat=3, num_dense=2, vocab=500,
+                          seed=9)
+    q = WorkQueue([f"file{i}" for i in range(10)], shuffle=False)
+    for _ in range(4):
+        q.take()
+    for _ in range(2):
+        st, _ = tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+
+    ck = CheckpointManager(str(tmp_path), tr, datasets={"queue": q})
+    st, _ = ck.save(st)
+    for _ in range(2):
+        q.take()  # post-save progress: NOT saved
+
+    q2 = WorkQueue([f"file{i}" for i in range(10)], shuffle=False)
+    tr2 = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    ck2 = CheckpointManager(str(tmp_path), tr2, datasets={"queue": q2})
+    st2 = ck2.restore()
+    assert int(st2.step) == int(st.step)
+    # the restored queue resumes at the SAVED position (file4), replaying
+    # the post-save items
+    assert q2.take() == "file4"
+
+    # incremental saves carry positions too, and restore uses the NEWEST
+    st, _ = tr.train_step(
+        st, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+    st, _ = ck.save_incremental(st)
+    q3 = WorkQueue([f"file{i}" for i in range(10)], shuffle=False)
+    ck3 = CheckpointManager(str(tmp_path), tr2, datasets={"queue": q3})
+    ck3.restore()
+    assert q3.take() == "file6"  # position at the incremental save
+
+    # a checkpoint from BEFORE datasets existed restores cleanly (file
+    # missing -> skipped)
+    import os as _os
+
+    for d in sorted(_os.listdir(str(tmp_path))):
+        p = _os.path.join(str(tmp_path), d, "datasets.part00000.json")
+        if _os.path.exists(p):
+            _os.remove(p)
+    q4 = WorkQueue([f"file{i}" for i in range(10)], shuffle=False)
+    ck4 = CheckpointManager(str(tmp_path), tr2, datasets={"queue": q4})
+    ck4.restore()
+    assert q4.take() == "file0"  # untouched
