@@ -51,8 +51,9 @@ def _dma_ok(dim: int, dtype) -> bool:
     "must be aligned to tiling (128)"; bf16 tiles pack 2 sublanes per
     32-bit word so a dynamic single-row slice fails "index in dimension 0
     is a multiple of 2"). bf16 tables with dim % 128 == 0 have their own
-    PAIR-granule kernels (gather_rows / apply_rows_sr route them via
-    _dma_pair_ok); narrower tables take the XLA path (a D<128 row
+    PAIR-granule kernels (gather_rows / apply_rows_sr /
+    fused_gather_combine route them via _dma_pair_ok); narrower tables
+    take the XLA path (a D<128 row
     underfills even one DMA granule — beating XLA there needs a packed
     storage layout, not a better kernel; see docs/perf.md)."""
     return dim % _LANES == 0 and jnp.dtype(dtype).itemsize == 4
@@ -360,17 +361,25 @@ def gather_rows(values: jnp.ndarray, ix: jnp.ndarray, *,
 
 def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
                          weights: jnp.ndarray, *, block_b: int = 8,
-                         interpret: bool = False) -> jnp.ndarray:
+                         interpret: bool = False,
+                         pair_kernels: bool = False) -> jnp.ndarray:
     """Pooled bags straight from the table.
 
     values [C, D]; row_ix [B, L] int32 slot per position (< 0 = skip);
     weights [B, L] f32 per-position weight (carry the combiner here: 1 for
     sum, 1/n_b for mean, 1/sqrt(n_b) for sqrtn, 0 for pad/blocked).
     Returns [B, D] f32: out[b] = sum_l weights[b, l] * values[row_ix[b, l]].
+    pair_kernels routes eligible bf16 tables through 2-row granule DMAs
+    (same rationale as gather_rows_pair).
     """
     B, L = row_ix.shape
     C, D = values.shape
-    if not interpret and not (_on_tpu() and _dma_ok(D, values.dtype)):
+    pair = pair_kernels and _dma_pair_ok(values.shape, values.dtype) and (
+        interpret or _on_tpu()
+    )
+    if not pair and not interpret and not (
+        _on_tpu() and _dma_ok(D, values.dtype)
+    ):
         e = values.at[jnp.clip(row_ix, 0, C - 1)].get(mode="clip")
         w = jnp.where(row_ix >= 0, weights, 0.0)
         return jnp.sum(e.astype(jnp.float32) * w[..., None], axis=1)
@@ -398,6 +407,12 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
 
         def row_dma(slot, j):
             idx = jnp.clip(ix_ref[base + j], 0, C - 1)
+            if pair:
+                g = (idx // 2) * 2  # even-aligned bf16 granule
+                return pltpu.make_async_copy(
+                    values_ref.at[pl.ds(g, 2), :], scratch.at[slot],
+                    sems.at[slot],
+                )
             return pltpu.make_async_copy(
                 values_ref.at[idx], scratch.at[slot], sems.at[slot]
             )
@@ -415,7 +430,12 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
             row_dma(cur, j).wait()
             b = j // L
             w = jnp.where(ix_ref[base + j] >= 0, w_ref[base + j], 0.0)
-            out_ref[b, :] = out_ref[b, :] + w * scratch[cur].astype(jnp.float32)
+            if pair:
+                idx = jnp.clip(ix_ref[base + j], 0, C - 1)
+                row = scratch[cur, idx % 2, :]
+            else:
+                row = scratch[cur]
+            out_ref[b, :] = out_ref[b, :] + w * row.astype(jnp.float32)
             return 0
 
         jax.lax.fori_loop(0, rows_per_blk, body, 0)
@@ -431,7 +451,7 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, D), values.dtype),
+            pltpu.VMEM(((2, 2, D) if pair else (2, D)), values.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )

@@ -235,3 +235,25 @@ def test_apply_rows_sr_pair_bf16_matches_semantics():
     # untouched rows — ESPECIALLY granule-mates 10 and 21 — unchanged
     untouched = [i for i in range(64) if i not in (6, 7, 11, 20)]
     np.testing.assert_array_equal(out[untouched], before[untouched])
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_fused_gather_combine_pair_bf16(combiner):
+    """bf16 pair-granule bag pooling == XLA oracle (weights carry the
+    combiner; skips at -1; odd/even slots both land)."""
+    rng = np.random.default_rng(6)
+    vals = jnp.asarray(
+        rng.normal(0, 1, (128, 128)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    B, L = 6, 5
+    ix = rng.integers(-1, 128, (B, L)).astype(np.int32)
+    n = np.maximum((ix >= 0).sum(axis=1), 1)
+    w = np.where(ix >= 0, 1.0 / n[:, None] if combiner == "mean" else 1.0,
+                 0.0).astype(np.float32)
+    out = fused_gather_combine(
+        vals, jnp.asarray(ix), jnp.asarray(w), block_b=4, interpret=True,
+        pair_kernels=True,
+    )
+    e = np.asarray(vals, np.float32)[np.clip(ix, 0, 127)]
+    expect = (e * w[..., None]).sum(axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-2, atol=2e-2)
