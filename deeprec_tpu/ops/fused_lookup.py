@@ -18,16 +18,18 @@ DMA pipeline, so the next row's fetch overlaps the current row's compute:
     rounding when the table is bf16 (plain round-to-nearest silently drops
     small gradient updates once |update| < ulp(value)/2).
 
-Eligibility (measured on v5e): the DMA kernels require **f32 tables with
-dim % 128 == 0** — Mosaic's HBM tiling constraint, see ``_dma_ok``. With
-``TableConfig.kernel = "auto"`` (the default) eligible tables take the
-Pallas path (bench-crowned winner: gather 494 vs 362 GB/s, scatter 1117 vs
-726 — tools/bench_lookup.py, docs/perf.md) and everything else falls back
-to the identical-semantics XLA path, including bf16 stochastic rounding,
-which on hardware therefore always runs the XLA branch of apply_rows_sr.
-Off-TPU all calls are XLA, so every caller is oracle-testable on CPU (the
-kernels themselves via interpret mode, where the in-kernel SR branch is
-also covered).
+Eligibility: the single-row DMA kernels require **f32 tables with
+dim % 128 == 0** (Mosaic's HBM tiling constraint, ``_dma_ok``; measured
+winners on v5e — gather 494 vs 362 GB/s, scatter 1117 vs 726). **bf16
+tables with dim % 128 == 0** ride the PAIR-granule variants
+(``gather_rows_pair`` / ``apply_rows_sr_pair`` / the pair branch of
+``fused_gather_combine``): 2-row even-aligned DMAs with the half-select
+or read-modify-write done in VMEM, including IN-KERNEL stochastic
+rounding — gated behind kernel="pallas" / AUTO_TRUSTS_BF16_PAIR until a
+hardware bench crowns them. Everything else falls back to the
+identical-semantics XLA path. Off-TPU all calls are XLA, so every caller
+is oracle-testable on CPU (the kernels themselves via interpret mode,
+where the in-kernel SR branches are also covered).
 """
 from __future__ import annotations
 
