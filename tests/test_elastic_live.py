@@ -268,3 +268,19 @@ def test_coordinator_plan_epoch_and_acks(tmp_path):
     coord.wait_acked(e, 2, timeout=1)
     # the supervisor scans for the workers' epoch, not the latest plan
     assert coord.wait_acked_after(e - 1, 2, timeout=1) == (e, 2)
+
+
+def test_supervisor_aborts_on_worker_failure(tmp_path):
+    """A worker exiting with a non-RESCALE failure code must abort the
+    job loudly (no silent respawn loop)."""
+    from deeprec_tpu.launch import supervise_elastic
+
+    script = str(tmp_path / "bad_worker.py")
+    with open(script, "w") as f:
+        f.write("import sys; sys.exit(3)\n")
+    with pytest.raises(RuntimeError,
+                       match=r"elastic workers failed: \[\(0, 3\)\]"):
+        supervise_elastic(
+            script, [], 1, str(tmp_path / "edir"),
+            env_extra={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        )
