@@ -28,6 +28,10 @@ NATIVE = os.path.join(
     "deeprec_tpu", "native",
 )
 SO = os.path.join(NATIVE, "libdeeprec_processor.so")
+# One source of truth for the served model's hyperparameters (fixture +
+# the pure-C host test restore the same checkpoint).
+MODEL_ARGS = dict(emb_dim=8, capacity=1 << 12, hidden=(32,), num_cat=4,
+                  num_dense=2)
 
 
 def _build_lib():
@@ -77,8 +81,7 @@ def _call_json(lib, fn, handle, payload=None):
 @pytest.fixture(scope="module")
 def served(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("cabi")
-    model_args = dict(emb_dim=8, capacity=1 << 12, hidden=(32,), num_cat=4,
-                      num_dense=2)
+    model_args = MODEL_ARGS
     tr = Trainer(WDL(**model_args), Adagrad(lr=0.1), optax.adam(1e-3))
     st = tr.init(0)
     g = SyntheticCriteo(batch_size=128, num_cat=4, num_dense=2, vocab=900,
@@ -268,3 +271,54 @@ def test_process_protobuf_payload(served):
     probs = resp.outputs["probabilities"].to_numpy()
     assert probs.shape[0] == 4
     assert np.all((probs >= 0) & (probs <= 1))
+
+
+@pytest.mark.slow
+def test_pure_c_host_boots_embedded_interpreter(served, tmp_path):
+    """The EAS integration path for real: a PURE C program (no Python
+    running) dlopens libdeeprec_processor.so, which must boot the
+    embedded CPython interpreter itself (the initialize() branch the
+    ctypes fixture short-circuits), serve a request, and shut down."""
+    import sys
+
+    lib, handle, tr, st, ck, batches = served  # reuse the trained ckpt dir
+    r = subprocess.run(["make", "-s", "chost"], cwd=NATIVE,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+    cfg = {
+        "model": "wdl",
+        "ckpt_dir": str(ck.dir),
+        "model_args": {**MODEL_ARGS, "hidden": list(MODEL_ARGS["hidden"])},
+        "max_wait_ms": 1.0,
+    }
+    b0 = {k: np.asarray(v)[:2].tolist() for k, v in batches[0].items()
+          if k != "label"}
+    (tmp_path / "config.json").write_text(json.dumps(cfg))
+    (tmp_path / "request.json").write_text(
+        json.dumps({"features": b0}))
+
+    import sysconfig
+
+    repo = os.path.dirname(os.path.dirname(NATIVE.rstrip(os.sep)))
+    env = {
+        **os.environ,
+        # The embedded interpreter needs the BASE install for the stdlib
+        # (a venv prefix has no encodings/), plus the venv site-packages
+        # and the repo on PYTHONPATH; jax pinned to CPU (the tunnel
+        # plugin would wedge a TPU init).
+        "PYTHONHOME": sys.base_prefix,
+        "PYTHONPATH": os.pathsep.join(
+            [repo, sysconfig.get_paths()["purelib"]]
+        ),
+        "JAX_PLATFORMS": "cpu",
+    }
+    r = subprocess.run(
+        [os.path.join(NATIVE, "chost_demo"), SO,
+         str(tmp_path / "config.json"), str(tmp_path / "request.json")],
+        capture_output=True, text=True, timeout=280, env=env,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "process rc=200" in r.stdout
+    body = json.loads(r.stdout.split("body=", 1)[1])
+    assert len(body["predictions"]) == 2
