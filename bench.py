@@ -46,10 +46,20 @@ def _probe_once(timeout: int):
             # transient tunnel failure — tell the caller not to retry.
             return False, "notpu: probe ran on %s, not tpu" % (
                 platform[0] if platform else "?")
-        tail = (r.stderr or r.stdout or "").strip().splitlines()
-        return False, "rc=%d: %s" % (r.returncode, tail[-1][-200:] if tail else "")
+        return False, "rc=%d: %s" % (
+            r.returncode, _error_line(r.stderr or r.stdout or ""))
     except subprocess.TimeoutExpired:
         return False, "probe timed out after %ds" % timeout
+
+
+def _error_line(text: str) -> str:
+    """The most informative line of a crashed subprocess's output: prefer
+    the exception line over jax's traceback-filtering boilerplate."""
+    lines = [l.strip() for l in text.strip().splitlines() if l.strip()]
+    for l in reversed(lines):
+        if "Error" in l or "Exception" in l or "FAILED" in l:
+            return l[-200:]
+    return lines[-1][-200:] if lines else ""
 
 
 def _probe_with_retry():
@@ -83,8 +93,8 @@ def _run_worker(extra_env, timeout):
     except subprocess.TimeoutExpired:
         return None, "workload timed out after %ds" % timeout
     if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()
-        return None, "workload rc=%d: %s" % (r.returncode, tail[-1][-200:] if tail else "")
+        return None, "workload rc=%d: %s" % (
+            r.returncode, _error_line(r.stderr or ""))
     for line in reversed(r.stdout.strip().splitlines()):
         try:
             return json.loads(line), None
