@@ -26,6 +26,7 @@ is consulted only to fail loudly when a future broker drops one.
 """
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import time
@@ -64,6 +65,13 @@ class KafkaError(RuntimeError):
         super().__init__(
             f"{where}: kafka error {code} ({_ERR_NAMES.get(code, 'unknown')})"
         )
+
+
+class KafkaOffsetGapError(RuntimeError):
+    """A restored/requested offset no longer exists on the broker — the
+    topic's retention (or compaction) outran the checkpoint. Restart with
+    offset_reset="earliest" to accept the data loss and resume from the
+    oldest retained record, or re-point the reader at a fresh offset."""
 
 
 # ------------------------------------------------------------ primitives
@@ -494,6 +502,7 @@ class KafkaStreamReader:
         reconnect_secs: float = 1.0,
         num_dense: int = 13,
         num_cat: int = 26,
+        offset_reset: str = "error",
     ):
         if topic_spec is not None:
             parts = topic_spec.split(":")
@@ -506,8 +515,16 @@ class KafkaStreamReader:
                 limit = int(parts[3])
         if topic is None:
             raise ValueError("topic required (topic_spec or topic=)")
-        host, _, port = servers.partition(",")[0].partition(":")
-        self.client = KafkaClient(host, int(port or 9092))
+        if offset_reset not in ("error", "earliest"):
+            raise ValueError(
+                f"offset_reset must be 'error' or 'earliest', got "
+                f"{offset_reset!r}"
+            )
+        self.servers = [s.strip() for s in servers.split(",") if s.strip()]
+        if not self.servers:
+            raise ValueError("at least one bootstrap server required")
+        self.client: Optional[KafkaClient] = None  # leader, connected lazily
+        self.offset_reset = offset_reset
         self.topic = topic
         self.partition = partition
         self.group = group
@@ -522,19 +539,56 @@ class KafkaStreamReader:
         self._start = offset
         self.offset: Optional[int] = None  # resolved lazily
 
+    # -- broker connection (leader-aware)
+
+    def _ensure_client(self) -> KafkaClient:
+        if self.client is None:
+            self.client = self._connect_leader()
+        return self.client
+
+    def _connect_leader(self) -> KafkaClient:
+        """Locate the partition leader via Metadata — what the reference
+        gets for free from librdkafka (kafka_dataset_op.cc's consumer
+        follows leader redirects). Falls back to the bootstrap connection
+        itself when metadata is unhelpful (single-broker/dev setups)."""
+        last: Optional[Exception] = None
+        for srv in self.servers:
+            host, _, port = srv.partition(":")
+            cand = KafkaClient(host, int(port or 9092))
+            try:
+                brokers, topics = cand.metadata([self.topic])
+            except (OSError, ValueError, KafkaError) as e:
+                cand.close()
+                last = e
+                continue
+            info = (
+                topics.get(self.topic, {})
+                .get("partitions", {})
+                .get(self.partition)
+            )
+            if info and not info.get("error") and info.get("leader") in brokers:
+                lh, lp = brokers[info["leader"]]
+                if (lh, int(lp)) != (cand.host, cand.port):
+                    cand.close()
+                    return KafkaClient(lh, int(lp))
+            return cand
+        assert last is not None
+        raise last
+
     # -- offsets
 
     def _resolve_start(self) -> int:
         if self._start >= 0:
             return self._start
+        client = self._ensure_client()
         if self._start == -1:  # group offset, else earliest
-            stored = self.client.offset_fetch(
+            stored = client.offset_fetch(
                 self.group, self.topic, self.partition
             )
             if stored >= 0:
                 return stored
-            return self.client.list_offsets(self.topic, self.partition, -2)
-        return self.client.list_offsets(self.topic, self.partition, -2)
+            return client.list_offsets(self.topic, self.partition, -2)
+        return client.list_offsets(self.topic, self.partition, -2)
 
     def save(self) -> dict:
         return {
@@ -559,12 +613,14 @@ class KafkaStreamReader:
         """Store the next-unyielded offset broker-side (consumer group)."""
         off = self.offset if self.offset is not None else self._start
         if off >= 0:
-            self.client.offset_commit(
+            self._ensure_client().offset_commit(
                 self.group, self.topic, self.partition, off
             )
 
     def close(self) -> None:
-        self.client.close()
+        if self.client is not None:
+            self.client.close()
+            self.client = None
 
     # -- iterate
 
@@ -577,19 +633,54 @@ class KafkaStreamReader:
         # a batch is HANDED OUT, so a crash re-fetches buffered rows
         # instead of dropping them.
         fetch_pos = self.offset
+        leader_retries = 0
         while True:
             try:
-                hw, records = self.client.fetch(
+                hw, records = self._ensure_client().fetch(
                     self.topic, self.partition, fetch_pos,
                     max_wait_ms=self.max_wait_ms,
                 )
+                leader_retries = 0
             except ValueError:
                 # Permanent (unparseable/compressed data): retrying the
                 # same offset would stall training silently. Always raise.
-                self.client.close()
+                self.close()
+                raise
+            except KafkaError as e:
+                self.close()
+                if e.code == ERR_NOT_LEADER and leader_retries < 8:
+                    # Leadership moved (rebalance/broker restart): re-resolve
+                    # via Metadata and retry the same position — librdkafka's
+                    # automatic leader redirect, bounded so a sick cluster
+                    # surfaces instead of spinning forever.
+                    leader_retries += 1
+                    time.sleep(self.reconnect_secs)
+                    continue
+                if e.code == ERR_OFFSET_OUT_OF_RANGE:
+                    if self.offset_reset == "earliest":
+                        earliest = self._ensure_client().list_offsets(
+                            self.topic, self.partition, -2
+                        )
+                        logging.getLogger(__name__).warning(
+                            "kafka %s:%d: offset %d is outside the broker's "
+                            "retained range; resetting to earliest=%d "
+                            "(offset_reset='earliest') — records in between "
+                            "are lost",
+                            self.topic, self.partition, fetch_pos, earliest,
+                        )
+                        fetch_pos = earliest
+                        self.offset = max(self.offset, earliest)
+                        continue
+                    raise KafkaOffsetGapError(
+                        f"kafka {self.topic}:{self.partition}: offset "
+                        f"{fetch_pos} no longer exists on the broker (topic "
+                        "retention or compaction outran this checkpoint). "
+                        "Pass offset_reset='earliest' to resume from the "
+                        "oldest retained record, accepting the gap."
+                    ) from e
                 raise
             except OSError:
-                self.client.close()
+                self.close()
                 if self.stop_at_eof:
                     raise
                 time.sleep(self.reconnect_secs)

@@ -74,6 +74,7 @@ class DiskKV:
         self.dim = dim
         self.rec_bytes = 8 + 4 + 4 + 4 * dim
         self.index: dict = {}
+        self.last_reads = 0  # coalesced read count of the last get()
         self._dtype = np.dtype(
             [("key", "<i8"), ("freq", "<i4"), ("ver", "<i4"),
              ("val", "<f4", (dim,))]
@@ -185,14 +186,32 @@ class DiskKV:
         # for actual hits.
         idx_keys = np.fromiter(self.index.keys(), np.int64, len(self.index))
         hit_ix = np.nonzero(np.isin(keys, idx_keys))[0]
-        for i in hit_ix:
-            off = self.index[int(keys[i])]
-            self._f.seek(off)
-            rec = np.fromfile(self._f, self._dtype, 1)[0]
-            vals[i] = rec["val"]
-            freqs[i] = rec["freq"]
-            vers[i] = rec["ver"]
-            found[i] = True
+        if len(hit_ix) == 0:
+            return vals, freqs, vers, found
+        # Batched reads: sort hits by log offset and coalesce runs of
+        # ADJACENT records into one sequential read — a restore-after-crash
+        # promote burst against a freshly compacted log (live records
+        # contiguous) collapses to a single read instead of a Python
+        # seek+fromfile per row (the reference's SSD tier batches the same
+        # way — ssd_hash_kv.h). `last_reads` is the run count, for tests
+        # and tier diagnostics.
+        offs = np.fromiter(
+            (self.index[int(keys[i])] for i in hit_ix), np.int64,
+            len(hit_ix),
+        )
+        order = np.argsort(offs, kind="stable")
+        sorted_offs = offs[order]
+        starts = np.nonzero(np.diff(sorted_offs) != self.rec_bytes)[0] + 1
+        bounds = np.concatenate([[0], starts, [len(sorted_offs)]])
+        self.last_reads = len(bounds) - 1
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            self._f.seek(int(sorted_offs[a]))
+            recs = np.fromfile(self._f, self._dtype, int(b - a))
+            ii = hit_ix[order[a:b]]
+            vals[ii] = recs["val"]
+            freqs[ii] = recs["freq"]
+            vers[ii] = recs["ver"]
+            found[ii] = True
         return vals, freqs, vers, found
 
     def erase(self, keys) -> None:
@@ -283,10 +302,15 @@ class MultiTierTable:
             return
         cfg = self.table.cfg
         C = state.capacity
+        from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+        # Per-row slots only (not table scalars), by NAME — shapes are
+        # ambiguous under the packed small-dim layout, where a [C, w] slot
+        # stores as [C // P, P * w]; the logical width is size // C.
         self._slot_layout = tuple(
-            (name, int(arr.shape[1]) if arr.ndim > 1 else 1)
+            (name, int(np.prod(arr.shape)) // C)
             for name, arr in sorted(state.slots.items())
-            if arr.shape[0] == C  # per-row slots only (not table scalars)
+            if not name.startswith(SCALAR_PREFIX)
         )
         width = cfg.dim + sum(w for _, w in self._slot_layout)
         self._packed_dim = width
@@ -324,31 +348,37 @@ class MultiTierTable:
             self.disk = DiskKV(path, width)
 
     def _pack_rows(self, state: TableState, row_ix: np.ndarray) -> np.ndarray:
-        """[n, D + slot widths]: values then per-row slot columns."""
-        cols = [np.asarray(state.values, np.float32)[row_ix]]
+        """[n, D + slot widths]: values then per-row slot columns (LOGICAL
+        rows — packed small-dim storage unpacks via a free numpy view)."""
+        from deeprec_tpu.ops.packed import unpack_array
+
+        C = state.capacity
+        cols = [
+            unpack_array(np.asarray(state.values, np.float32), C)[row_ix]
+        ]
         for name, w in self._slot_layout:
-            arr = np.asarray(state.slots[name], np.float32)[row_ix]
-            cols.append(arr.reshape(len(row_ix), w))
+            arr = unpack_array(np.asarray(state.slots[name], np.float32), C)
+            cols.append(arr[row_ix].reshape(len(row_ix), w))
         return np.concatenate(cols, axis=1)
 
     def _unpack_rows(self, state: TableState, row_ix: np.ndarray,
                      packed: np.ndarray) -> TableState:
         """Restore values AND per-row optimizer slots at row_ix."""
+        from deeprec_tpu.ops.packed import scatter_rows_any
+
         D = self.table.cfg.dim
+        C = state.capacity
         ix = jnp.asarray(row_ix, jnp.int32)
         state = state.replace(
-            values=state.values.at[ix].set(
-                jnp.asarray(packed[:, :D], state.values.dtype)
+            values=scatter_rows_any(
+                state.values, ix, jnp.asarray(packed[:, :D], jnp.float32), C
             )
         )
         off = D
         slots = dict(state.slots)
         for name, w in self._slot_layout:
-            tgt = slots[name]
-            chunk = packed[:, off:off + w].reshape(
-                (len(row_ix),) + tgt.shape[1:]
-            )
-            slots[name] = tgt.at[ix].set(jnp.asarray(chunk, tgt.dtype))
+            chunk = jnp.asarray(packed[:, off:off + w], jnp.float32)
+            slots[name] = scatter_rows_any(slots[name], ix, chunk, C)
             off += w
         return state.replace(slots=slots)
 

@@ -70,7 +70,9 @@ class TableState:
 
     @property
     def dim(self) -> int:
-        return self.values.shape[1]
+        """Logical embedding dim. Robust to the packed small-dim layout
+        (values [C // P, P * D] — ops/packed.py): D * rows stays C * dim."""
+        return self.values.shape[-1] * self.values.shape[-2] // self.keys.shape[-1]
 
 
 @struct.dataclass
@@ -125,13 +127,39 @@ class EmbeddingTable:
             self.cfg.kernel == "auto" and AUTO_TRUSTS_BF16_PAIR
         )
 
-    def _gather(self, values: jnp.ndarray, ix: jnp.ndarray) -> jnp.ndarray:
-        """values[ix] with clip semantics through the configured kernel."""
-        if self.use_pallas:
-            from deeprec_tpu.ops.fused_lookup import gather_rows
+    def pack(self, capacity: Optional[int] = None) -> int:
+        """Pack factor for the values array at this capacity (ops/packed.py:
+        P rows per 128-lane granule when dim < 128 divides 128). Packing is
+        a storage-layout decision independent of the kernel choice — it
+        saves P x HBM (XLA pads the minor dim to 128 lanes) and makes the
+        table eligible for the fused DMA kernels at any kernel= setting."""
+        from deeprec_tpu.ops.packed import pack_factor
 
-            return gather_rows(values, ix, pair_kernels=self.pair_kernels)
-        return values.at[ix].get(mode="clip")
+        return pack_factor(self.cfg.dim,
+                           self.cfg.capacity if capacity is None else capacity)
+
+    def _gather(self, values: jnp.ndarray, ix: jnp.ndarray,
+                capacity: int) -> jnp.ndarray:
+        """values[ix] with clip semantics through the configured kernel,
+        packed-layout aware."""
+        from deeprec_tpu.ops.packed import gather_rows_any
+
+        return gather_rows_any(
+            values, ix, capacity,
+            use_pallas=self.use_pallas, pair_kernels=self.pair_kernels,
+        )
+
+    def _scatter(self, values: jnp.ndarray, slot_ix: jnp.ndarray,
+                 rows: jnp.ndarray, capacity: int,
+                 seed: jnp.ndarray | int = 0) -> jnp.ndarray:
+        """Write rows at logical slot_ix (< 0 = skip) through the configured
+        kernel, packed-layout aware; bf16 tables stochastic-round."""
+        from deeprec_tpu.ops.packed import scatter_rows_any
+
+        return scatter_rows_any(
+            values, slot_ix, rows, capacity, seed,
+            use_pallas=self.use_pallas, pair_kernels=self.pair_kernels,
+        )
 
     # Hashable-by-config so EmbeddingTable can ride through jit as a static
     # argument (the jitted public methods below rely on this).
@@ -151,9 +179,10 @@ class EmbeddingTable:
         bloom = None
         if cfg.ev.cbf_filter is not None:
             bloom = jnp.zeros((cfg.ev.cbf_filter.num_cells(),), jnp.int32)
+        P = self.pack()
         return TableState(
             keys=jnp.full((C,), empty_key(cfg), kdt),
-            values=jnp.zeros((C, D), vdt),
+            values=jnp.zeros((C // P, P * D), vdt),
             freq=jnp.zeros((C,), jnp.int32),
             version=jnp.full((C,), -1, jnp.int32),
             slots={},
@@ -363,16 +392,19 @@ class EmbeddingTable:
         version = state.version
         dirty = state.dirty
         if train:
-            # Initialize newly created rows.
+            # Initialize newly created rows (bf16 tables stochastic-round
+            # the initializer, same as every later write).
             init_rows = self._init_rows(uids, salt)
-            scatter_ix = jnp.where(created, slot_ix, state.capacity)
-            values = values.at[scatter_ix].set(init_rows, mode="drop")
+            values = self._scatter(
+                values, jnp.where(created, slot_ix, -1), init_rows,
+                state.capacity, seed=step,
+            )
             upd_ix = jnp.where(present, slot_ix, state.capacity)
             freq = freq.at[upd_ix].add(counts, mode="drop")
             version = version.at[upd_ix].set(step, mode="drop")
             dirty = dirty.at[upd_ix].set(True, mode="drop")
 
-        emb = self._gather(values, safe_ix)
+        emb = self._gather(values, safe_ix, state.capacity)
 
         # Admission: counter filter gates on the (just updated) frequency.
         admitted = present
@@ -431,7 +463,9 @@ class EmbeddingTable:
         )
         del keys  # unchanged: no creation
         present = slot_ix >= 0
-        emb = self._gather(state.values, jnp.where(present, slot_ix, 0))
+        emb = self._gather(
+            state.values, jnp.where(present, slot_ix, 0), state.capacity
+        )
         emb = jnp.where(present[:, None], emb, self._init_rows(flat, salt))
         emb = jnp.where(is_pad[:, None], 0.0, emb)
         return emb.reshape(*shape, cfg.dim)
@@ -444,15 +478,19 @@ class EmbeddingTable:
         slot_ix: jnp.ndarray,
         new_values: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
+        seed: jnp.ndarray | int = 0,
     ) -> TableState:
-        """Write rows back (optimizers use this through their own slot logic)."""
+        """Write rows back (optimizers use this through their own slot logic).
+        Pass the global step as `seed` when the table is bf16 so stochastic
+        rounding draws fresh bits each step."""
         ok = slot_ix >= 0
         if mask is not None:
             ok = ok & mask
-        ix = jnp.where(ok, slot_ix, state.capacity)
-        values = state.values.at[ix].set(
-            new_values.astype(state.values.dtype), mode="drop"
+        values = self._scatter(
+            state.values, jnp.where(ok, slot_ix, -1), new_values,
+            state.capacity, seed=seed,
         )
+        ix = jnp.where(ok, slot_ix, state.capacity)
         dirty = state.dirty.at[ix].set(True, mode="drop")
         return state.replace(values=values, dirty=dirty)
 
@@ -478,8 +516,12 @@ class EmbeddingTable:
             )
         l2e = cfg.ev.l2_weight_evict
         if l2e is not None and l2e.l2_weight_threshold >= 0:
+            from deeprec_tpu.ops.packed import unpack_array
+
             norm2 = jnp.sum(
-                state.values.astype(jnp.float32) ** 2, axis=1
+                unpack_array(state.values, state.capacity).astype(jnp.float32)
+                ** 2,
+                axis=1,
             )
             drop = drop | (norm2 < l2e.l2_weight_threshold)
         return occ & drop
@@ -512,13 +554,27 @@ class EmbeddingTable:
         # surface it if it happens.
         ix = jnp.where(slot_ix >= 0, slot_ix, C_new)
 
+        from deeprec_tpu.ops.packed import (
+            pack_array, pack_factor, unpack_array,
+        )
+
         def move(arr, fill):
             out = jnp.full((C_new,) + arr.shape[1:], fill, arr.dtype)
             return out.at[ix].set(arr, mode="drop")
 
+        def move_rows(arr, fill):
+            """Per-row 2-D arrays relocate in LOGICAL layout, then repack
+            at the new capacity's factor (growth can change eligibility —
+            rebuild runs at checkpoint cadence, the relayout is fine)."""
+            logical = unpack_array(arr, state.capacity)
+            moved = move(logical, fill)
+            return pack_array(moved, pack_factor(logical.shape[1], C_new))
+
+        from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
         return TableState(
             keys=fresh_keys,
-            values=move(state.values, 0),
+            values=move_rows(state.values, 0),
             freq=move(state.freq, 0),
             version=move(state.version, -1),
             slots={
@@ -528,9 +584,9 @@ class EmbeddingTable:
                 # (slot_fills), not 0 — an Adagrad accumulator reborn at 0
                 # would rsqrt(0) into NaN on a zero-grad dim.
                 k: (
-                    move(v, dict(slot_fills or ()).get(k, 0))
-                    if v.shape[0] == state.capacity
-                    else v
+                    v
+                    if k.startswith(SCALAR_PREFIX)
+                    else move_rows(v, dict(slot_fills or ()).get(k, 0))
                 )
                 for k, v in state.slots.items()
             },

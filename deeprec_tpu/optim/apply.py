@@ -24,6 +24,8 @@ def ensure_slots(
     The analog of slot-variable creation in DeepRec's optimizers
     (python/training/adam_async.py etc.), with slots packed next to values.
     """
+    from deeprec_tpu.ops.packed import pack_factor
+
     C, D = state.capacity, state.dim
     slots = dict(state.slots)
     for name, (shape, init) in opt.slot_specs(D).items():
@@ -32,7 +34,12 @@ def ensure_slots(
         if name.startswith(SCALAR_PREFIX):
             slots[name] = jnp.full((1, 1), init, jnp.float32)
         else:
-            slots[name] = jnp.full((C,) + tuple(shape), init, jnp.float32)
+            # Per-row slots share the packed small-dim layout of the values
+            # array (ops/packed.py): a [C, 1] accumulator padded to 128
+            # lanes would waste 128x HBM.
+            (w,) = tuple(shape)
+            P = pack_factor(w, C)
+            slots[name] = jnp.full((C // P, P * w), init, jnp.float32)
     return state.replace(slots=slots)
 
 
@@ -59,32 +66,43 @@ def apply_gradients(
     if grad_averaging:
         grad = grad / jnp.maximum(res.counts.astype(jnp.float32), 1.0)[:, None]
 
-    value = table._gather(state.values, safe_ix).astype(jnp.float32)
+    value = table._gather(state.values, safe_ix, state.capacity).astype(
+        jnp.float32
+    )
+    from deeprec_tpu.ops.packed import gather_rows_any, scatter_rows_any
+
     row_slots: Dict[str, jnp.ndarray] = {}
     for name, arr in state.slots.items():
         if name.startswith(SCALAR_PREFIX):
             row_slots[name] = arr  # [1, 1] per-table scalar, passed through
         else:
-            row_slots[name] = arr.at[safe_ix].get(mode="clip")
+            row_slots[name] = gather_rows_any(
+                arr, safe_ix, state.capacity,
+                use_pallas=table.use_pallas,
+                pair_kernels=table.pair_kernels,
+            )
 
     new_value, new_slots = opt.update(value, row_slots, grad, res.counts, step, lr)
 
-    # The values write-back goes through apply_rows_sr: bf16 tables get
-    # stochastic rounding (plain round-to-nearest silently drops updates
-    # smaller than ulp/2), f32 tables an exact masked scatter; the Pallas
-    # DMA kernel serves tables opted into it.
-    from deeprec_tpu.ops.fused_lookup import apply_rows_sr
-
-    values = apply_rows_sr(
-        state.values, jnp.where(ok, res.slot_ix, -1), new_value, step,
-        use_pallas=table.use_pallas, pair_kernels=table.pair_kernels,
+    # The values write-back goes through apply_rows_sr (packed-layout
+    # aware): bf16 tables get stochastic rounding (plain round-to-nearest
+    # silently drops updates smaller than ulp/2), f32 tables an exact
+    # masked scatter; the Pallas DMA kernel serves tables opted into it.
+    values = table._scatter(
+        state.values, jnp.where(ok, res.slot_ix, -1), new_value,
+        state.capacity, seed=step,
     )
     slots = dict(state.slots)
     for name, rows in new_slots.items():
         if name.startswith(SCALAR_PREFIX):
             slots[name] = rows
         else:
-            slots[name] = state.slots[name].at[drop_ix].set(rows, mode="drop")
+            slots[name] = scatter_rows_any(
+                state.slots[name], jnp.where(ok, res.slot_ix, -1), rows,
+                state.capacity, seed=step,
+                use_pallas=table.use_pallas,
+                pair_kernels=table.pair_kernels,
+            )
     dirty = state.dirty.at[drop_ix].set(True, mode="drop")
     version = state.version.at[drop_ix].set(step, mode="drop")
     return state.replace(values=values, slots=slots, dirty=dirty, version=version)

@@ -98,15 +98,30 @@ def process_request(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:
     JSON still works via the fallback below."""
     if not payload or payload[:1] == b"{":
         return process_json(server, payload)
-    code, body = process_proto(server, payload)
-    if (
-        code == 400
-        and body.startswith(b"bad PredictRequest")
-        and payload.lstrip()[:1] == b"{"
-    ):
-        # Not protobuf after all; a JSON object behind leading whitespace.
-        return process_json(server, payload)
-    return code, body
+    if payload.lstrip()[:1] == b"{":
+        # Ambiguous: whitespace-prefixed '{' is either JSON or a protobuf
+        # whose first tag byte happens to be ASCII whitespace. Proto3
+        # "successfully" parses many JSON-ish byte strings by skipping
+        # unknown fields, yielding an empty-inputs request and a misleading
+        # parse_features 400 — so the proto path wins only when the parse
+        # yields actual inputs; otherwise a payload that IS a JSON object
+        # routes to the JSON path, and non-JSON bytes keep the protobuf
+        # path's error reporting (e.g. an inputs-less proto request still
+        # 400s with the proto-side message).
+        from deeprec_tpu.serving import predict_pb as pb
+
+        try:
+            has_inputs = bool(pb.PredictRequest.parse(bytes(payload)).inputs)
+        except Exception:
+            has_inputs = False
+        if not has_inputs:
+            try:
+                is_json = isinstance(json.loads(payload), dict)
+            except Exception:
+                is_json = False
+            if is_json:
+                return process_json(server, payload)
+    return process_proto(server, payload)
 
 
 def process_proto(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:

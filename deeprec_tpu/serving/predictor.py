@@ -110,13 +110,19 @@ class Predictor:
     initializer value.
     """
 
-    def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None):
+    def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None,
+                 device=None):
         self.model = model
         # Serving needs no optimizer; slot-less sparse opt keeps restore lean
         # (checkpointed slot arrays are skipped when the template has none).
         self._trainer = Trainer(model, GradientDescent(), optax.identity())
         self._ck = CheckpointManager(ckpt_dir, self._trainer)
         self._state: Optional[TrainState] = None
+        # Replica pinning (ServerGroup): committing the state to `device`
+        # makes every jitted predict follow it there — N replicas on N
+        # devices serve concurrently (uncommitted request arrays follow
+        # the committed state under JAX placement rules).
+        self._device = device
         self._applied: set = set()
         # Reentrant: poll_updates holds it across its check-then-act (a
         # concurrent full reload must not interleave with a delta replay)
@@ -141,6 +147,8 @@ class Predictor:
             # restore() already consumed is idempotent, missing one is not).
             dirs = set(self._dirs())
             state = self._ck.restore()
+            if self._device is not None:
+                state = jax.device_put(state, self._device)
             self._state = state
             self._applied = dirs
 
@@ -174,12 +182,15 @@ class Predictor:
                 )
                 last_step = max(last_step, int(d.split("-")[1]))
                 self._applied.add(d)
-            self._state = TrainState(
+            state = TrainState(
                 step=jnp.asarray(last_step, jnp.int32),
                 tables=state.tables,
                 dense=state.dense,
                 opt_state=state.opt_state,
             )
+            if self._device is not None:
+                state = jax.device_put(state, self._device)
+            self._state = state
         return True
 
     # ------------------------------------------------------------- predict
@@ -349,6 +360,26 @@ class Predictor:
         return {"step": int(state.step), "table_sizes": sizes}
 
 
+def _run_poll_loop(owner, stop: threading.Event, secs: float) -> None:
+    """Shared checkpoint-watch loop (ModelServer + ServerGroup): poll
+    `owner.predictor` for updates every `secs`, surfacing failures via a
+    consecutive-failure counter + log — a corrupt checkpoint must not
+    silently freeze the served model."""
+    while not stop.is_set():
+        time.sleep(secs)
+        try:
+            owner.predictor.poll_updates()
+            owner.update_failures = 0
+        except Exception as e:
+            owner.update_failures = getattr(owner, "update_failures", 0) + 1
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "model update poll failed (%d consecutive): %s",
+                owner.update_failures, e,
+            )
+
+
 class ModelServer:
     """Micro-batching front: coalesce single requests into device batches.
 
@@ -378,21 +409,7 @@ class ModelServer:
             self._poller.start()
 
     def _poll_loop(self, secs):
-        while not self._stop.is_set():
-            time.sleep(secs)
-            try:
-                self.predictor.poll_updates()
-                self.update_failures = 0
-            except Exception as e:
-                # surfaced via consecutive-failure counter + log: a corrupt
-                # checkpoint must not silently freeze the served model
-                self.update_failures = getattr(self, "update_failures", 0) + 1
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "model update poll failed (%d consecutive): %s",
-                    self.update_failures, e,
-                )
+        _run_poll_loop(self, self._stop, secs)
 
     def _run(self):
         while not self._stop.is_set():
@@ -488,3 +505,95 @@ class ModelServer:
     def close(self):
         self._stop.set()
         self._worker.join(timeout=2)
+
+
+class _GroupPredictor:
+    """Predictor facade over a replica group: reads delegate to replica 0,
+    `poll_updates` rolls across EVERY replica (the single checkpoint
+    watcher the group shares). Lets ServerGroup slot into anything built
+    for ModelServer (HttpServer routes use `server.predictor`)."""
+
+    def __init__(self, members: List[Predictor]):
+        self._members = members
+
+    def __getattr__(self, name):
+        return getattr(self._members[0], name)
+
+    def poll_updates(self) -> bool:
+        # Rolling update: replicas refresh one at a time, the others keep
+        # serving the previous version — SessionGroup's model-update story
+        # without a serving gap.
+        changed = False
+        for m in self._members:
+            changed = bool(m.poll_updates()) or changed
+        return changed
+
+    def reload(self) -> None:
+        for m in self._members:
+            m.reload()
+
+    def model_info(self) -> Dict:
+        info = self._members[0].model_info()
+        info["replicas"] = len(self._members)
+        return info
+
+
+class ServerGroup:
+    """N serving replicas sharing one checkpoint watcher — the
+    DirectSessionGroup analog (direct_session_group.h:28,
+    docs/docs_en/SessionGroup.md). Each replica is a full ModelServer
+    (own coalescing queue + worker thread) whose Predictor state is
+    committed to its own device; requests go to the least-loaded replica.
+
+    On a multi-device host this is true device parallelism; on a single
+    chip it still removes host-side head-of-line blocking (request
+    parsing/concat of a big batch no longer stalls every later arrival —
+    the reference's per-session threadpool rationale).
+    """
+
+    def __init__(self, model, ckpt_dir: str, *, replicas: int = 2,
+                 devices=None, stores: Optional[Dict] = None,
+                 max_batch: int = 256, max_wait_ms: float = 2.0,
+                 poll_updates_secs: float = 0.0):
+        if devices is None:
+            avail = jax.local_devices()
+            devices = [avail[i % len(avail)] for i in range(replicas)]
+        self.members = [
+            ModelServer(
+                Predictor(model, ckpt_dir, stores=stores, device=d),
+                max_batch=max_batch, max_wait_ms=max_wait_ms,
+            )
+            for d in devices
+        ]
+        self.predictor = _GroupPredictor([s.predictor for s in self.members])
+        self._rr = 0
+        self._stop = threading.Event()
+        self._poller = None
+        if poll_updates_secs > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, args=(poll_updates_secs,),
+                daemon=True,
+            )
+            self._poller.start()
+
+    def _poll_loop(self, secs: float):
+        _run_poll_loop(self, self._stop, secs)
+
+    def _pick(self) -> "ModelServer":
+        """Least-loaded replica; round-robin breaks ties so idle groups
+        still spread arrivals across devices."""
+        n = len(self.members)
+        self._rr = (self._rr + 1) % n
+        order = self.members[self._rr:] + self.members[: self._rr]
+        return min(order, key=lambda s: s._q.qsize())
+
+    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
+        return self._pick().request(features, timeout=timeout)
+
+    def warmup(self, example: Dict[str, np.ndarray]) -> int:
+        return sum(s.warmup(example) for s in self.members)
+
+    def close(self):
+        self._stop.set()
+        for s in self.members:
+            s.close()

@@ -52,9 +52,25 @@ def is_per_row(name: str) -> bool:
 def export_table_arrays(
     table: EmbeddingTable, state_np: Dict[str, np.ndarray], only_dirty: bool
 ) -> Dict[str, np.ndarray]:
-    """Compact one LOCAL table state (host numpy arrays) to its live rows."""
+    """Compact one LOCAL table state (host numpy arrays) to its live rows.
+
+    The checkpoint format is LOGICAL rows — packed small-dim arrays
+    (ops/packed.py) unpack via a free numpy reshape here, so checkpoints
+    are portable across layout choices."""
+    from deeprec_tpu.ops.packed import unpack_array
+
     cfg = table.cfg
     keys = state_np["keys"]
+    C = keys.shape[0]
+    state_np = {
+        name: (
+            unpack_array(arr, C)
+            if name == "values"
+            or (name.startswith("slot:") and is_per_row(name))
+            else arr
+        )
+        for name, arr in state_np.items()
+    }
     occ = keys != empty_key(cfg)
     if only_dirty:
         occ = occ & state_np["dirty"]
@@ -132,9 +148,16 @@ def import_rows(
             f"table {table.cfg.name}: {int(jnp.sum(failed))} keys failed to "
             f"insert on restore — grow the capacity"
         )
+    from deeprec_tpu.ops.packed import scatter_rows_any
+
     ix = jnp.where(slot_ix >= 0, slot_ix, state.capacity)
-    values = state.values.at[ix].set(
-        jnp.asarray(rows["values"]).astype(state.values.dtype), mode="drop"
+    put_ix = jnp.where(slot_ix >= 0, slot_ix, -1)
+    # Restored rows are LOGICAL; scatter_rows_any re-packs on the way in.
+    # Exact restore for f32; bf16 values round stochastically (identity
+    # for rows that came out of a bf16 table — already representable).
+    values = scatter_rows_any(
+        state.values, put_ix, jnp.asarray(rows["values"], np.float32),
+        state.capacity,
     )
     freq = state.freq.at[ix].set(jnp.asarray(rows["freqs"]), mode="drop")
     version = state.version.at[ix].set(jnp.asarray(rows["versions"]), mode="drop")
@@ -145,7 +168,9 @@ def import_rows(
             continue
         r = jnp.asarray(rows[key])
         if is_per_row(key):
-            slots[sname] = arr.at[ix].set(r, mode="drop")
+            slots[sname] = scatter_rows_any(
+                arr, put_ix, r.astype(jnp.float32), state.capacity
+            )
         else:
             slots[sname] = r
     bloom = state.bloom

@@ -95,12 +95,21 @@ class BrokerStub:
     record wire format; `page` limits records per fetch response to force
     multi-fetch consumption."""
 
-    def __init__(self, records, encoding="v2", page=7):
+    def __init__(self, records, encoding="v2", page=7, leader_addr=None,
+                 fetch_err=0, earliest=0):
         self.records = list(records)
         self.encoding = encoding
         self.page = page
         self.committed = {}  # group -> offset
         self.requests = []   # (api_key, api_version) log
+        # Multi-broker scripting: metadata reports `leader_addr` (host,
+        # port) as the partition leader (default: this broker);
+        # `fetch_err` != 0 makes every fetch fail with that Kafka error
+        # code; offsets below `earliest` fetch OFFSET_OUT_OF_RANGE (code
+        # 1) like a retention-trimmed topic.
+        self.leader_addr = leader_addr
+        self.fetch_err = fetch_err
+        self.earliest = earliest
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -166,9 +175,9 @@ class BrokerStub:
         return out
 
     def _metadata(self, body):
+        host, port = self.leader_addr or ("127.0.0.1", self.port)
         out = struct.pack(">i", 1)  # brokers
-        out += struct.pack(">i", 0) + _s("127.0.0.1") + struct.pack(
-            ">i", self.port)
+        out += struct.pack(">i", 0) + _s(host) + struct.pack(">i", port)
         out += struct.pack(">i", 1)  # topics
         out += struct.pack(">h", 0) + _s(TOPIC)
         out += struct.pack(">i", 1)  # partitions
@@ -179,7 +188,7 @@ class BrokerStub:
 
     def _list_offsets(self, body):
         when = struct.unpack(">q", body[-12:-4])[0]
-        off = len(self.records) if when == -1 else 0
+        off = len(self.records) if when == -1 else self.earliest
         return (struct.pack(">i", 1) + _s(TOPIC) + struct.pack(">i", 1)
                 + struct.pack(">ih", 0, 0)
                 + struct.pack(">i", 1) + struct.pack(">q", off))
@@ -191,11 +200,14 @@ class BrokerStub:
         (tlen,) = struct.unpack(">h", body[r + 4:r + 6])
         p = r + 6 + tlen + 4
         pid, offset = struct.unpack(">iq", body[p:p + 12])
-        page = self.records[offset:offset + self.page]
+        err = self.fetch_err
+        if not err and offset < self.earliest:
+            err = 1  # OFFSET_OUT_OF_RANGE
+        page = [] if err else self.records[offset:offset + self.page]
         enc = message_set_v1 if self.encoding == "v1" else record_batch_v2
         blob = enc(page, offset) if page else b""
         return (struct.pack(">i", 1) + _s(TOPIC) + struct.pack(">i", 1)
-                + struct.pack(">i", pid) + struct.pack(">h", 0)
+                + struct.pack(">i", pid) + struct.pack(">h", err)
                 + struct.pack(">q", len(self.records))
                 + struct.pack(">i", len(blob)) + blob)
 
@@ -342,3 +354,97 @@ def test_compressed_batch_raises():
     blob[22] = 1  # gzip
     with pytest.raises(ValueError, match="compress"):
         parse_records(bytes(blob))
+
+
+def test_reader_resolves_partition_leader_via_metadata():
+    """Bootstrap != leader: the reader must follow Metadata to the broker
+    that owns the partition (librdkafka does this automatically for the
+    reference's consumer; a pinned bootstrap connection would fetch
+    NOT_LEADER forever)."""
+    rows = tsv_rows(30)
+    leader = BrokerStub(rows, encoding="v2", page=30)
+    try:
+        # the bootstrap broker has NO data and fails every fetch; its
+        # metadata points at the real leader
+        boot = BrokerStub([], fetch_err=6,
+                          leader_addr=("127.0.0.1", leader.port))
+        try:
+            reader = KafkaStreamReader(
+                f"127.0.0.1:{boot.port}", f"{TOPIC}:0:0",
+                batch_size=10, stop_at_eof=True, num_dense=2, num_cat=2,
+            )
+            out = list(reader)
+            assert sum(b["label"].shape[0] for b in out) == 30
+            # the bootstrap broker answered metadata only — never a fetch
+            assert 1 not in [k for k, _ in boot.requests]
+            assert any(k == 1 for k, _ in leader.requests)
+            reader.close()
+        finally:
+            boot.stop()
+    finally:
+        leader.stop()
+
+
+def test_reader_reresolves_leader_on_not_leader_error():
+    """Mid-stream leadership move: the old leader starts answering
+    NOT_LEADER_FOR_PARTITION; the reader re-resolves via Metadata and
+    resumes on the new leader at the same offset."""
+    rows = tsv_rows(40)
+    new_leader = BrokerStub(rows, encoding="v2", page=40)
+    old_leader = BrokerStub(rows, encoding="v2", page=10)
+    try:
+        reader = KafkaStreamReader(
+            f"127.0.0.1:{old_leader.port}", f"{TOPIC}:0:0",
+            batch_size=10, stop_at_eof=True, num_dense=2, num_cat=2,
+            reconnect_secs=0.01,
+        )
+        it = iter(reader)
+        first = next(it)
+        assert first["I1"][0, 0] == 0.5
+        # leadership moves: old broker now errors and redirects
+        old_leader.fetch_err = 6
+        old_leader.leader_addr = ("127.0.0.1", new_leader.port)
+        rest = list(it)
+        got = sum(b["label"].shape[0] for b in rest)
+        assert got == 30  # no loss, no duplicates across the failover
+        assert rest[0]["I1"][0, 0] == 10.5
+        reader.close()
+    finally:
+        old_leader.stop()
+        new_leader.stop()
+
+
+def test_reader_offset_out_of_range_default_raises():
+    """A checkpoint older than the topic's retention must fail LOUDLY by
+    default (the silent alternative re-trains on a hole)."""
+    from deeprec_tpu.data.kafka import KafkaOffsetGapError
+
+    broker = BrokerStub(tsv_rows(50), encoding="v2", page=50, earliest=20)
+    try:
+        reader = KafkaStreamReader(
+            f"127.0.0.1:{broker.port}", f"{TOPIC}:0:5",  # 5 < earliest=20
+            batch_size=10, stop_at_eof=True, num_dense=2, num_cat=2,
+        )
+        with pytest.raises(KafkaOffsetGapError, match="retention"):
+            list(reader)
+        reader.close()
+    finally:
+        broker.stop()
+
+
+def test_reader_offset_out_of_range_reset_earliest():
+    """offset_reset='earliest' clamps to the oldest retained record with
+    a warning — the reference consumer's auto.offset.reset semantics."""
+    broker = BrokerStub(tsv_rows(50), encoding="v2", page=50, earliest=20)
+    try:
+        reader = KafkaStreamReader(
+            f"127.0.0.1:{broker.port}", f"{TOPIC}:0:5",
+            batch_size=10, stop_at_eof=True, num_dense=2, num_cat=2,
+            offset_reset="earliest",
+        )
+        out = list(reader)
+        assert sum(b["label"].shape[0] for b in out) == 30  # [20, 50)
+        assert out[0]["I1"][0, 0] == 20.5
+        reader.close()
+    finally:
+        broker.stop()

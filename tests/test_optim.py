@@ -195,6 +195,10 @@ def test_slots_survive_rebuild():
     t2 = EmbeddingTable(TableConfig(name="t", dim=4, capacity=256,
         ev=t.cfg.ev))
     _, res2 = t2.lookup_unique(s2, ids, step=1)
+    from deeprec_tpu.ops.packed import unpack_array
+
     ok = np.asarray(res2.valid)
-    acc = np.asarray(s2.slots["accum"])[np.asarray(res2.slot_ix)[ok]]
+    acc = unpack_array(np.asarray(s2.slots["accum"]), s2.capacity)[
+        np.asarray(res2.slot_ix)[ok]
+    ]
     np.testing.assert_allclose(acc, 1.0, rtol=1e-6)  # g^2 carried over

@@ -73,8 +73,10 @@ def _key_value_map(tr, st):
         keys = np.asarray(ts.keys)
         values = np.asarray(ts.values)
         sentinel = np.iinfo(keys.dtype).min
+        # reshape to one LOGICAL row per key — works for both plain [C, D]
+        # and packed [C//P, P*D] layouts (row-major packing, ops/packed.py)
         flatk = keys.reshape(-1)
-        flatv = values.reshape(-1, values.shape[-1])
+        flatv = values.reshape(flatk.shape[0], -1)
         for i in np.nonzero(flatk != sentinel)[0]:
             out[(bname, int(flatk[i]), i // keys.shape[-1])] = flatv[i]
     return out

@@ -1,0 +1,289 @@
+"""RESP (Redis protocol) feature store tests against a scripted RESP
+server — socket-level, like the Kafka wire tests: no redis dependency,
+the bytes on the wire are the spec.
+
+Reference contract being pinned (redis_feature_store.cc):
+  * binary row keys: LE u64 model_version ++ LE u64 feature2id ++ LE i64 id
+  * raw-f32 row values, MGET/MSET batches, nil => missing
+  * literal metadata commands: GET/SET model_version ("full,latest"),
+    GET/SET active, SET model_lock <v> ex <t> nx
+"""
+import socketserver
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.serving.resp_store import (
+    RedisFeatureStore,
+    RespConnection,
+    RespError,
+    encode_command,
+)
+
+
+class FakeRedis:
+    """In-memory RESP server: AUTH/SELECT/GET/SET[ex/nx]/MGET/MSET/DEL —
+    the command subset the feature store uses."""
+
+    def __init__(self, password=None):
+        self.data = {}
+        self.password = password
+        self.commands = []  # uppercased command names, in arrival order
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                authed = outer.password is None
+                while True:
+                    try:
+                        args = outer._read_command(self.rfile)
+                    except (ConnectionError, ValueError):
+                        return
+                    if args is None:
+                        return
+                    cmd = args[0].upper().decode()
+                    outer.commands.append(cmd)
+                    if cmd == "AUTH":
+                        if args[1].decode() == (outer.password or ""):
+                            authed = True
+                            self.wfile.write(b"+OK\r\n")
+                        else:
+                            self.wfile.write(b"-ERR invalid password\r\n")
+                    elif not authed:
+                        self.wfile.write(b"-NOAUTH Authentication required.\r\n")
+                    elif cmd == "SELECT":
+                        self.wfile.write(b"+OK\r\n")
+                    elif cmd == "GET":
+                        v = outer.data.get(args[1])
+                        self.wfile.write(outer._bulk(v))
+                    elif cmd == "SET":
+                        key, val = args[1], args[2]
+                        opts = [a.upper() for a in args[3:]]
+                        if b"NX" in opts and key in outer.data:
+                            self.wfile.write(b"$-1\r\n")  # nil: not set
+                        else:
+                            outer.data[key] = val
+                            self.wfile.write(b"+OK\r\n")
+                    elif cmd == "MGET":
+                        out = b"*%d\r\n" % (len(args) - 1)
+                        for k in args[1:]:
+                            out += outer._bulk(outer.data.get(k))
+                        self.wfile.write(out)
+                    elif cmd == "MSET":
+                        for i in range(1, len(args) - 1, 2):
+                            outer.data[args[i]] = args[i + 1]
+                        self.wfile.write(b"+OK\r\n")
+                    elif cmd == "DEL":
+                        n = 0
+                        for k in args[1:]:
+                            n += 1 if outer.data.pop(k, None) is not None else 0
+                        self.wfile.write(b":%d\r\n" % n)
+                    else:
+                        self.wfile.write(b"-ERR unknown command\r\n")
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    @staticmethod
+    def _bulk(v):
+        return b"$-1\r\n" if v is None else b"$%d\r\n%s\r\n" % (len(v), v)
+
+    @staticmethod
+    def _read_command(rfile):
+        line = rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError(f"inline commands unsupported: {line!r}")
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            hdr = rfile.readline()
+            if not hdr.startswith(b"$"):
+                raise ValueError(f"expected bulk string, got {hdr!r}")
+            ln = int(hdr[1:].strip())
+            data = rfile.read(ln)
+            rfile.read(2)  # CRLF
+            if len(data) != ln:
+                raise ConnectionError("short read")
+            args.append(data)
+        return args
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_encode_command_resp_bytes():
+    assert encode_command(b"GET", b"k") == b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+    assert encode_command("SET", "k", 12) == (
+        b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$2\r\n12\r\n"
+    )
+
+
+def test_connection_roundtrip_and_pipeline():
+    srv = FakeRedis()
+    try:
+        c = RespConnection("127.0.0.1", srv.port)
+        assert c.command(b"SET", b"a", b"1") == b"OK"
+        assert c.command(b"GET", b"a") == b"1"
+        assert c.command(b"GET", b"missing") is None
+        replies = c.pipeline([
+            (b"SET", b"b", b"2"), (b"GET", b"b"), (b"DEL", b"b"),
+            (b"GET", b"b"),
+        ])
+        assert replies == [b"OK", b"2", 1, None]
+        with pytest.raises(RespError, match="unknown"):
+            c.command(b"NOSUCH")
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_auth_and_select_on_connect():
+    srv = FakeRedis(password="sekrit")
+    try:
+        good = RespConnection("127.0.0.1", srv.port, password="sekrit", db=3)
+        assert good.command(b"SET", b"x", b"y") == b"OK"
+        # AUTH and SELECT happened before the first user command
+        assert srv.commands[:2] == ["AUTH", "SELECT"]
+        good.close()
+
+        bad = RespConnection("127.0.0.1", srv.port, password="wrong")
+        with pytest.raises(RespError, match="invalid password"):
+            bad.command(b"GET", b"x")
+        bad.close()
+    finally:
+        srv.stop()
+
+
+def test_store_put_get_reference_key_scheme():
+    """Rows land under the reference's exact binary key layout and read
+    back with a correct found mask."""
+    srv = FakeRedis()
+    try:
+        store = RedisFeatureStore("127.0.0.1", srv.port, dim=4,
+                                  model_version=7, feature2id=3)
+        keys = np.asarray([5, -2, 1 << 40], np.int64)
+        rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+        store.put(keys, rows)
+
+        # the wire keys are memcpy(model_version) ++ memcpy(feature2id)
+        # ++ memcpy(key) — exactly what redis_feature_store.cc builds
+        want_key = struct.pack("<QQq", 7, 3, 5)
+        assert want_key in srv.data
+        assert srv.data[want_key] == rows[0].tobytes()
+
+        vals, freqs, vers, found = store.get(
+            np.asarray([5, 99, -2, 1 << 40], np.int64)
+        )
+        assert found.tolist() == [True, False, True, True]
+        np.testing.assert_array_equal(vals[0], rows[0])
+        np.testing.assert_array_equal(vals[2], rows[1])
+        np.testing.assert_array_equal(vals[3], rows[2])
+        np.testing.assert_array_equal(vals[1], 0.0)
+        assert freqs.tolist() == [0, 0, 0, 0] and vers.tolist() == [0, 0, 0, 0]
+
+        # a different model_version namespace misses
+        other = RedisFeatureStore("127.0.0.1", srv.port, dim=4,
+                                  model_version=8, feature2id=3,
+                                  conn=store.conn)
+        _, _, _, found2 = other.get(keys)
+        assert not found2.any()
+        assert store.delete(keys) == 3
+        store.close()
+    finally:
+        srv.stop()
+
+
+def test_store_dim_mismatch_is_loud():
+    srv = FakeRedis()
+    try:
+        w = RedisFeatureStore("127.0.0.1", srv.port, dim=8)
+        w.put(np.asarray([1], np.int64), np.ones((1, 8), np.float32))
+        r = RedisFeatureStore("127.0.0.1", srv.port, dim=4, conn=w.conn)
+        with pytest.raises(ConnectionError, match="dim mismatch"):
+            r.get(np.asarray([1], np.int64))
+        w.close()
+    finally:
+        srv.stop()
+
+
+def test_store_metadata_commands():
+    """model_version / active / lock: the literal reference commands."""
+    srv = FakeRedis()
+    try:
+        store = RedisFeatureStore("127.0.0.1", srv.port, dim=2)
+        assert store.get_model_version() == (-1, -1)
+        store.set_model_version(41, 42)
+        assert srv.data[b"model_version"] == b"41,42"
+        assert store.get_model_version() == (41, 42)
+
+        assert store.get_active() is False
+        store.set_active(True)
+        assert store.get_active() is True
+        assert srv.data[b"active"] == b"1"
+
+        assert store.acquire_lock(1, 30) is True
+        assert store.acquire_lock(2, 30) is False  # NX: already held
+        store.release_lock()
+        assert store.acquire_lock(2, 30) is True
+        store.close()
+    finally:
+        srv.stop()
+
+
+def test_predictor_read_through_via_resp(tmp_path):
+    """End-to-end: a Redis-protocol store plugs into Predictor(stores=...)
+    exactly like the bespoke RemoteKVClient — missing device keys serve
+    the Redis row (redis_feature_store.h read-through parity)."""
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.serving import Predictor
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(32,), num_cat=4,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=64, num_cat=4, num_dense=2, vocab=500,
+                          seed=3)
+    batch = {k: jnp.asarray(v) for k, v in gen.batch().items()}
+    for _ in range(3):
+        st, _ = tr.train_step(st, batch)
+    CheckpointManager(str(tmp_path), tr).save(st)
+
+    srv = FakeRedis()
+    try:
+        tname = sorted(tr.tables)[0]
+        dim = tr.tables[tname].cfg.dim
+        store = RedisFeatureStore("127.0.0.1", srv.port, dim=dim)
+        novel = 999_999
+        store.put(np.asarray([novel], np.int64),
+                  np.full((1, dim), 2.5, np.float32))
+
+        req = {k: np.asarray(v) for k, v in batch.items()
+               if not k.startswith("label")}
+        req[tname] = np.full_like(req[tname], novel)
+        out_plain = Predictor(model, str(tmp_path)).predict(req)
+        out_store = Predictor(
+            model, str(tmp_path), stores={tname: store}
+        ).predict(req)
+        assert np.abs(np.asarray(out_store) - np.asarray(out_plain)).max() \
+            > 1e-6
+        store.close()
+    finally:
+        srv.stop()

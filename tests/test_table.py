@@ -170,8 +170,13 @@ def test_l2_eviction():
     s, res = t.lookup_unique(s, jnp.array([1, 2], jnp.int32))
     # force key 1 tiny, key 2 large
     ix = {int(u): int(sl) for u, sl in zip(np.asarray(res.uids), np.asarray(res.slot_ix))}
-    vals = s.values.at[ix[1]].set(0.001).at[ix[2]].set(1.0)
-    s = s.replace(values=vals)
+    # Write through scatter_update so the (possibly packed) layout is honored.
+    dim = t.cfg.dim
+    s = t.scatter_update(
+        s,
+        jnp.array([ix[1], ix[2]], jnp.int32),
+        jnp.stack([jnp.full((dim,), 0.001), jnp.full((dim,), 1.0)]),
+    )
     s = t.evict(s, step=0)
     assert int(t.size(s)) == 1
 

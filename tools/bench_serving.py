@@ -1,0 +1,162 @@
+"""Serving latency bench: p50/p99 through the HTTP server under
+concurrent load, single ModelServer vs ServerGroup replicas.
+
+The measurement SessionGroup exists for (docs/docs_en/SessionGroup.md:
+tail-latency under concurrency). Run:
+
+    python tools/bench_serving.py [--replicas 2] [--clients 8] \
+        [--seconds 5] [--rows 8]
+
+Prints one JSON line per configuration:
+    {"config": "group-2", "rps": ..., "p50_ms": ..., "p99_ms": ...}
+
+On a TPU host run WITHOUT JAX_PLATFORMS=cpu to serve from the chip.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build(tmp, emb_dim=16, steps=5):
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=emb_dim, capacity=1 << 14, hidden=(128, 64),
+                num_cat=8, num_dense=4)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=256, num_cat=8, num_dense=4,
+                          vocab=5000, seed=11)
+    for _ in range(steps):
+        st, _ = tr.train_step(st, {k: jnp.asarray(v)
+                                   for k, v in gen.batch().items()})
+    CheckpointManager(tmp, tr).save(st)
+    req = {k: v for k, v in gen.batch().items() if not k.startswith("label")}
+    return model, req
+
+
+def drive(port, payloads, seconds, clients):
+    """Concurrent closed-loop clients; returns sorted latencies (s).
+    Any request failure aborts the bench loudly — silent drops would
+    report flattering numbers from a broken server."""
+    lat = []
+    errors = []
+    lock = threading.Lock()
+    stop = time.monotonic() + seconds
+
+    def worker(i):
+        body = payloads[i % len(payloads)]
+        mine = []
+        try:
+            while time.monotonic() < stop and not errors:
+                t0 = time.monotonic()
+                r = urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}/v1/predict", data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    ),
+                    timeout=60,
+                )
+                r.read()
+                mine.append(time.monotonic() - t0)
+        except Exception as e:
+            with lock:
+                errors.append(e)
+        finally:
+            with lock:
+                lat.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"{len(errors)} client(s) failed") from errors[0]
+    if not lat:
+        raise RuntimeError("no requests completed within the window")
+    return sorted(lat)
+
+
+def pct(lat, q):
+    return lat[min(int(q * len(lat)), len(lat) - 1)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--rows", type=int, default=8,
+                    help="rows per client request")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from deeprec_tpu.serving import (
+        HttpServer, ModelServer, Predictor, ServerGroup,
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model, req = build(tmp)
+        payloads = []
+        for off in range(args.clients):
+            sl = {k: np.asarray(v)[off * args.rows:(off + 1) * args.rows]
+                  for k, v in req.items()}
+            payloads.append(json.dumps(
+                {"features": {k: v.tolist() for k, v in sl.items()}}
+            ).encode())
+
+        results = []
+        configs = [
+            ("single", lambda: ModelServer(
+                Predictor(model, tmp), max_batch=256, max_wait_ms=1.0)),
+            (f"group-{args.replicas}", lambda: ServerGroup(
+                model, tmp, replicas=args.replicas, max_batch=256,
+                max_wait_ms=1.0)),
+        ]
+        for name, make in configs:
+            server = make()
+            server.warmup({k: np.asarray(v)[:args.rows]
+                           for k, v in req.items()})
+            http = HttpServer(server, port=0).start()
+            try:
+                # settle, then measure
+                drive(http.port, payloads, 0.5, 2)
+                lat = drive(http.port, payloads, args.seconds, args.clients)
+            finally:
+                http.stop()
+                server.close()
+            out = {
+                "config": name,
+                "clients": args.clients,
+                "rows_per_req": args.rows,
+                "requests": len(lat),
+                "rps": round(len(lat) / args.seconds, 1),
+                "p50_ms": round(1e3 * pct(lat, 0.50), 2),
+                "p90_ms": round(1e3 * pct(lat, 0.90), 2),
+                "p99_ms": round(1e3 * pct(lat, 0.99), 2),
+                "backend": __import__("jax").default_backend(),
+            }
+            results.append(out)
+            print(json.dumps(out), flush=True)
+        return results
+
+
+if __name__ == "__main__":
+    main()
