@@ -142,6 +142,21 @@ def workload():
         best_dt = min(best_dt, time.perf_counter() - t0)
 
     ex_per_sec = steps * B / best_dt
+
+    # Record the program actually measured — backend, storage layout, and
+    # kernel-trust flags — so round-over-round numbers are comparable (the
+    # r03->r04 regression was an unrecorded layout change). The layout is
+    # read off the measured model's own table configs, not a hardcoded
+    # probe shape.
+    from deeprec_tpu.embedding.table import EmbeddingTable
+    from deeprec_tpu.features import table_configs
+    from deeprec_tpu.ops import fused_lookup as _fl
+
+    packs = {
+        EmbeddingTable(c).pack()
+        for c in table_configs(model.features).values()
+    }
+    pack = max(packs) if packs else 1
     print(
         json.dumps(
             {
@@ -150,6 +165,12 @@ def workload():
                 "unit": "examples/sec",
                 "vs_baseline": round(ex_per_sec / BASELINE_EXAMPLES_PER_SEC, 4),
                 "device": jax.devices()[0].platform,
+                "backend": jax.default_backend(),
+                "layout": "packed_x%d" % pack if pack > 1 else "unpacked",
+                "flags": {
+                    "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
+                    "bf16_pair": _fl.AUTO_TRUSTS_BF16_PAIR,
+                },
             }
         )
     )

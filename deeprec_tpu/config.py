@@ -260,6 +260,14 @@ class TableConfig:
     # ops self-gate ineligible shapes back to XLA. Off-TPU every choice
     # falls back to identical-semantics XLA.
     kernel: str = "auto"  # auto | xla | pallas
+    # Packed small-dim storage layout (ops/packed.py): "auto" packs only on
+    # TPU, where the layout's rationale holds — XLA pads a [C, dim<128] f32
+    # array's minor dim to 128 lanes, so packing saves 128/dim x HBM and
+    # gather bandwidth. On CPU there is no lane padding and the pack/unpack
+    # shuffle is pure overhead (measured: -36% DLRM train throughput, BENCH_r04
+    # vs r03), so "auto" resolves to unpacked there. "on"/"off" force it
+    # either way (tests exercise the packed path on CPU via "on").
+    packed: str = "auto"  # auto | on | off
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
     def __post_init__(self):
@@ -269,6 +277,8 @@ class TableConfig:
             raise ValueError("dim must be positive")
         if self.kernel not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.packed not in ("auto", "on", "off"):
+            raise ValueError(f"unknown packed mode {self.packed!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,6 +26,7 @@ donation makes the updates in-place in practice.
 from __future__ import annotations
 
 import dataclasses
+import functools as _ft
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -38,6 +39,16 @@ from deeprec_tpu.utils import hashing
 
 def _key_dtype(cfg: TableConfig):
     return jnp.dtype(cfg.key_dtype)
+
+
+@_ft.lru_cache(maxsize=1)
+def _backend_is_tpu() -> bool:
+    """Whether jax resolves to a TPU backend (cached — the backend cannot
+    change within a process). The packed layout's "auto" gate."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
 
 
 def empty_key(cfg: TableConfig) -> int:
@@ -127,16 +138,28 @@ class EmbeddingTable:
             self.cfg.kernel == "auto" and AUTO_TRUSTS_BF16_PAIR
         )
 
+    def pack_width(self, width: int, capacity: Optional[int] = None) -> int:
+        """Pack factor for a [C, width] per-row array under this table's
+        layout policy. cfg.packed="auto" packs only where the layout can
+        win — TPU, where XLA pads the minor dim to 128 lanes; on CPU there
+        is no padding and packing measured -34% (BENCH_r04 vs r03), so auto
+        resolves to unpacked. "on"/"off" force it either way."""
+        mode = self.cfg.packed
+        if mode == "off" or (mode == "auto" and not _backend_is_tpu()):
+            return 1
+        from deeprec_tpu.ops.packed import pack_factor
+
+        return pack_factor(width,
+                           self.cfg.capacity if capacity is None else capacity)
+
     def pack(self, capacity: Optional[int] = None) -> int:
         """Pack factor for the values array at this capacity (ops/packed.py:
         P rows per 128-lane granule when dim < 128 divides 128). Packing is
         a storage-layout decision independent of the kernel choice — it
         saves P x HBM (XLA pads the minor dim to 128 lanes) and makes the
-        table eligible for the fused DMA kernels at any kernel= setting."""
-        from deeprec_tpu.ops.packed import pack_factor
-
-        return pack_factor(self.cfg.dim,
-                           self.cfg.capacity if capacity is None else capacity)
+        table eligible for the fused DMA kernels at any kernel= setting.
+        Gated per-backend by cfg.packed (see pack_width)."""
+        return self.pack_width(self.cfg.dim, capacity)
 
     def _gather(self, values: jnp.ndarray, ix: jnp.ndarray,
                 capacity: int) -> jnp.ndarray:
@@ -554,9 +577,7 @@ class EmbeddingTable:
         # surface it if it happens.
         ix = jnp.where(slot_ix >= 0, slot_ix, C_new)
 
-        from deeprec_tpu.ops.packed import (
-            pack_array, pack_factor, unpack_array,
-        )
+        from deeprec_tpu.ops.packed import pack_array, unpack_array
 
         def move(arr, fill):
             out = jnp.full((C_new,) + arr.shape[1:], fill, arr.dtype)
@@ -565,10 +586,11 @@ class EmbeddingTable:
         def move_rows(arr, fill):
             """Per-row 2-D arrays relocate in LOGICAL layout, then repack
             at the new capacity's factor (growth can change eligibility —
-            rebuild runs at checkpoint cadence, the relayout is fine)."""
+            rebuild runs at checkpoint cadence, the relayout is fine).
+            pack_width applies the cfg.packed backend gate."""
             logical = unpack_array(arr, state.capacity)
             moved = move(logical, fill)
-            return pack_array(moved, pack_factor(logical.shape[1], C_new))
+            return pack_array(moved, self.pack_width(logical.shape[1], C_new))
 
         from deeprec_tpu.optim.sparse import SCALAR_PREFIX
 

@@ -24,8 +24,6 @@ def ensure_slots(
     The analog of slot-variable creation in DeepRec's optimizers
     (python/training/adam_async.py etc.), with slots packed next to values.
     """
-    from deeprec_tpu.ops.packed import pack_factor
-
     C, D = state.capacity, state.dim
     slots = dict(state.slots)
     for name, (shape, init) in opt.slot_specs(D).items():
@@ -34,11 +32,11 @@ def ensure_slots(
         if name.startswith(SCALAR_PREFIX):
             slots[name] = jnp.full((1, 1), init, jnp.float32)
         else:
-            # Per-row slots share the packed small-dim layout of the values
-            # array (ops/packed.py): a [C, 1] accumulator padded to 128
-            # lanes would waste 128x HBM.
+            # Per-row slots share the packed small-dim layout policy of the
+            # values array (ops/packed.py, gated by cfg.packed): a [C, 1]
+            # accumulator padded to 128 lanes would waste 128x HBM on TPU.
             (w,) = tuple(shape)
-            P = pack_factor(w, C)
+            P = table.pack_width(w, C)
             slots[name] = jnp.full((C // P, P * w), init, jnp.float32)
     return state.replace(slots=slots)
 

@@ -146,6 +146,63 @@ def test_scatter_packed_width1():
     np.testing.assert_array_equal(back, rows)
 
 
+def test_packed_knob_resolution():
+    """cfg.packed gates the layout: "auto" is backend-dependent (unpacked
+    off-TPU — packing measured -36% train throughput on CPU, BENCH_r04 vs
+    r03), "on"/"off" force it. Slots follow the same policy."""
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.embedding.table import EmbeddingTable, _backend_is_tpu
+    from deeprec_tpu.optim.apply import ensure_slots
+    from deeprec_tpu.optim.sparse import Adagrad
+
+    on = EmbeddingTable(TableConfig(name="a", dim=16, capacity=256,
+                                    packed="on"))
+    off = EmbeddingTable(TableConfig(name="b", dim=16, capacity=256,
+                                     packed="off"))
+    auto = EmbeddingTable(TableConfig(name="c", dim=16, capacity=256))
+    assert auto.cfg.packed == "auto"
+    assert on.pack() == 8
+    assert off.pack() == 1
+    # tests run with JAX_PLATFORMS=cpu (conftest) -> auto stays unpacked
+    assert auto.pack() == (8 if _backend_is_tpu() else 1)
+    assert not _backend_is_tpu()
+
+    s_on, s_off = on.create(), off.create()
+    assert s_on.values.shape == (32, 128)
+    assert s_off.values.shape == (256, 16)
+    # layout is invisible to semantics: same lookups, same rows
+    ids = jnp.asarray([5, 9, 700, 12], jnp.int32)
+    s_on, r_on = on.lookup_unique(s_on, ids, step=1)
+    s_off, r_off = off.lookup_unique(s_off, ids, step=1)
+    assert r_on.embeddings.shape == r_off.embeddings.shape == (4, 16)
+    # slot layout follows the knob too
+    s_on = ensure_slots(on, s_on, Adagrad(lr=0.1))
+    s_off = ensure_slots(off, s_off, Adagrad(lr=0.1))
+    assert s_on.slots["accum"].shape == (32, 128)
+    assert s_off.slots["accum"].shape == (256, 16)
+
+    with pytest.raises(ValueError):
+        TableConfig(name="x", dim=16, capacity=256, packed="maybe")
+
+
+def test_packed_off_grow_stays_unpacked():
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.embedding.table import EmbeddingTable
+
+    t = EmbeddingTable(TableConfig(name="g0", dim=16, capacity=64,
+                                   packed="off"))
+    s = t.create()
+    ids = jnp.arange(10, dtype=jnp.int32) * 3 + 1
+    s, res = t.lookup_unique(s, ids, step=1)
+    grown = t.grow(s, 256)
+    assert grown.values.shape == (256, 16)
+    np.testing.assert_allclose(
+        np.asarray(t.lookup_readonly(grown, ids)),
+        np.asarray(res.embeddings)[np.asarray(res.inverse)],
+        rtol=0, atol=0,
+    )
+
+
 def test_table_dim16_end_to_end_packed():
     """The flagship shape: a dim-16 table stores packed and trains."""
     from deeprec_tpu.config import TableConfig
@@ -153,7 +210,7 @@ def test_table_dim16_end_to_end_packed():
     from deeprec_tpu.optim.apply import apply_gradients, ensure_slots
     from deeprec_tpu.optim.sparse import Adagrad
 
-    cfg = TableConfig(name="pk", dim=16, capacity=256)
+    cfg = TableConfig(name="pk", dim=16, capacity=256, packed="on")
     t = EmbeddingTable(cfg)
     assert t.pack() == 8
     s = t.create()
@@ -193,7 +250,7 @@ def test_table_dim16_checkpoint_roundtrip_packed():
         import_rows,
     )
 
-    cfg = TableConfig(name="ck", dim=16, capacity=256)
+    cfg = TableConfig(name="ck", dim=16, capacity=256, packed="on")
     t = EmbeddingTable(cfg)
     s = t.create()
     ids = jnp.asarray([3, 14, 159, 26, 535], jnp.int32)
@@ -216,7 +273,7 @@ def test_table_rebuild_grow_packed():
     from deeprec_tpu.config import TableConfig
     from deeprec_tpu.embedding.table import EmbeddingTable
 
-    cfg = TableConfig(name="gr", dim=16, capacity=64)
+    cfg = TableConfig(name="gr", dim=16, capacity=64, packed="on")
     t = EmbeddingTable(cfg)
     s = t.create()
     ids = jnp.arange(20, dtype=jnp.int32) * 7 + 1
