@@ -186,17 +186,19 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    """Bind one ModelServer — or a {name: ModelServer} dict for multi-model
-    serving — to a TCP port. start() is non-blocking. With a dict, the
-    TF-Serving routes address each model by name and the bare routes hit
-    `default_model` (first name if unset)."""
+    """Bind one server — a ModelServer, a ServerGroup, or a {name: server}
+    dict for multi-model serving — to a TCP port. start() is non-blocking.
+    Servers are duck-typed: anything with `.request()` and `.predictor`
+    works (ServerGroup routes requests to its least-loaded replica). With
+    a dict, the TF-Serving routes address each model by name and the bare
+    routes hit `default_model` (first name if unset)."""
 
     def __init__(self, model_server, port: int = 8500,
                  host: str = "127.0.0.1", default_model: Optional[str] = None):
-        if isinstance(model_server, ModelServer):
-            servers = {"default": model_server}
-        else:
+        if isinstance(model_server, dict):
             servers = dict(model_server)
+        else:
+            servers = {"default": model_server}
         if not servers:
             raise ValueError("need at least one ModelServer")
         default = default_model or next(iter(servers))

@@ -1,14 +1,20 @@
 """Serving latency bench: p50/p99 through the HTTP server under
-concurrent load, single ModelServer vs ServerGroup replicas.
+concurrent load — single ModelServer vs ServerGroup replicas, batching
+on/off, and the rolling-update blip.
 
 The measurement SessionGroup exists for (docs/docs_en/SessionGroup.md:
-tail-latency under concurrency). Run:
+tail latency under concurrency, plus model updates without a serving
+gap). Run:
 
-    python tools/bench_serving.py [--replicas 2] [--clients 8] \
-        [--seconds 5] [--rows 8]
+    python tools/bench_serving.py [--groups 2,4] [--clients 8] \
+        [--seconds 5] [--rows 8] [--out SERVING_BENCH.json]
 
 Prints one JSON line per configuration:
     {"config": "group-2", "rps": ..., "p50_ms": ..., "p99_ms": ...}
+and, for the largest group, an extra phase where a new checkpoint lands
+mid-load and rolls across the replicas:
+    {"config": "group-4+rolling-update", ..., "during_update_p99_ms": ...,
+     "model_version_advanced": true}
 
 On a TPU host run WITHOUT JAX_PLATFORMS=cpu to serve from the chip.
 """
@@ -43,25 +49,46 @@ def build(tmp, emb_dim=16, steps=5):
     for _ in range(steps):
         st, _ = tr.train_step(st, {k: jnp.asarray(v)
                                    for k, v in gen.batch().items()})
-    CheckpointManager(tmp, tr).save(st)
+    ck = CheckpointManager(tmp, tr)
+    ck.save(st)
     req = {k: v for k, v in gen.batch().items() if not k.startswith("label")}
-    return model, req
+
+    def save_next():
+        """Train a few more steps and land a NEW checkpoint (the rolling-
+        update stimulus)."""
+        nonlocal st
+        for _ in range(3):
+            st, _ = tr.train_step(st, {k: jnp.asarray(v)
+                                       for k, v in gen.batch().items()})
+        ck.save(st)
+        return int(st.step)
+
+    return model, req, save_next
 
 
-def drive(port, payloads, seconds, clients):
-    """Concurrent closed-loop clients; returns sorted latencies (s).
-    Any request failure aborts the bench loudly — silent drops would
-    report flattering numbers from a broken server."""
-    lat = []
+def drive(port, payloads, seconds, clients, until_event=None):
+    """Concurrent closed-loop clients; returns [(t_start, latency_s)]
+    sorted by start time. Runs for `seconds`, extended while `until_event`
+    (if given) is unset — the rolling-update phase must outlast the
+    update. Any request failure aborts the bench loudly — silent drops
+    would report flattering numbers from a broken server."""
+    recs = []
     errors = []
     lock = threading.Lock()
     stop = time.monotonic() + seconds
+
+    def keep_going():
+        if errors:
+            return False
+        if time.monotonic() < stop:
+            return True
+        return until_event is not None and not until_event.is_set()
 
     def worker(i):
         body = payloads[i % len(payloads)]
         mine = []
         try:
-            while time.monotonic() < stop and not errors:
+            while keep_going():
                 t0 = time.monotonic()
                 r = urllib.request.urlopen(
                     urllib.request.Request(
@@ -72,13 +99,13 @@ def drive(port, payloads, seconds, clients):
                     timeout=60,
                 )
                 r.read()
-                mine.append(time.monotonic() - t0)
+                mine.append((t0, time.monotonic() - t0))
         except Exception as e:
             with lock:
                 errors.append(e)
         finally:
             with lock:
-                lat.extend(mine)
+                recs.extend(mine)
 
     threads = [threading.Thread(target=worker, args=(i,))
                for i in range(clients)]
@@ -88,23 +115,45 @@ def drive(port, payloads, seconds, clients):
         t.join()
     if errors:
         raise RuntimeError(f"{len(errors)} client(s) failed") from errors[0]
-    if not lat:
+    if not recs:
         raise RuntimeError("no requests completed within the window")
-    return sorted(lat)
+    return sorted(recs)
 
 
 def pct(lat, q):
+    lat = sorted(lat)
     return lat[min(int(q * len(lat)), len(lat) - 1)]
+
+
+def summarize(name, recs, seconds, clients, rows, extra=None):
+    lat = [dt for _, dt in recs]
+    out = {
+        "config": name,
+        "clients": clients,
+        "rows_per_req": rows,
+        "requests": len(lat),
+        "rps": round(len(lat) / seconds, 1),
+        "p50_ms": round(1e3 * pct(lat, 0.50), 2),
+        "p90_ms": round(1e3 * pct(lat, 0.90), 2),
+        "p99_ms": round(1e3 * pct(lat, 0.99), 2),
+        "backend": __import__("jax").default_backend(),
+    }
+    out.update(extra or {})
+    return out
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--groups", default="2,4",
+                    help="comma-separated ServerGroup replica counts")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=8,
                     help="rows per client request")
+    ap.add_argument("--out", default=None,
+                    help="also write the result list to this JSON file")
     args = ap.parse_args()
+    groups = [int(g) for g in args.groups.split(",") if g]
 
     import numpy as np
 
@@ -113,7 +162,7 @@ def main():
     )
 
     with tempfile.TemporaryDirectory() as tmp:
-        model, req = build(tmp)
+        model, req, save_next = build(tmp)
         payloads = []
         for off in range(args.clients):
             sl = {k: np.asarray(v)[off * args.rows:(off + 1) * args.rows]
@@ -123,12 +172,17 @@ def main():
             ).encode())
 
         results = []
+        # max_batch=1 disables cross-request coalescing — the "batching
+        # off" baseline SessionGroup docs compare against.
         configs = [
+            ("single-nobatch", lambda: ModelServer(
+                Predictor(model, tmp), max_batch=1, max_wait_ms=0.0)),
             ("single", lambda: ModelServer(
                 Predictor(model, tmp), max_batch=256, max_wait_ms=1.0)),
-            (f"group-{args.replicas}", lambda: ServerGroup(
-                model, tmp, replicas=args.replicas, max_batch=256,
-                max_wait_ms=1.0)),
+        ] + [
+            (f"group-{g}", (lambda g=g: ServerGroup(
+                model, tmp, replicas=g, max_batch=256, max_wait_ms=1.0)))
+            for g in groups
         ]
         for name, make in configs:
             server = make()
@@ -138,24 +192,78 @@ def main():
             try:
                 # settle, then measure
                 drive(http.port, payloads, 0.5, 2)
-                lat = drive(http.port, payloads, args.seconds, args.clients)
+                recs = drive(http.port, payloads, args.seconds, args.clients)
+                out = summarize(name, recs, args.seconds, args.clients,
+                                args.rows)
+                results.append(out)
+                print(json.dumps(out), flush=True)
+
+                if groups and name == f"group-{max(groups)}":
+                    results.append(rolling_update_phase(
+                        server, http, payloads, args, name, save_next))
             finally:
                 http.stop()
                 server.close()
-            out = {
-                "config": name,
-                "clients": args.clients,
-                "rows_per_req": args.rows,
-                "requests": len(lat),
-                "rps": round(len(lat) / args.seconds, 1),
-                "p50_ms": round(1e3 * pct(lat, 0.50), 2),
-                "p90_ms": round(1e3 * pct(lat, 0.90), 2),
-                "p99_ms": round(1e3 * pct(lat, 0.99), 2),
-                "backend": __import__("jax").default_backend(),
-            }
-            results.append(out)
-            print(json.dumps(out), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"results": results,
+                           "protocol": vars(args)}, f, indent=1)
         return results
+
+
+def rolling_update_phase(server, http, payloads, args, name, save_next):
+    """Measure the rolling-update blip: a new checkpoint lands mid-load
+    and poll_updates rolls it across replicas while clients keep
+    hammering. Reports steady vs during-update latency and asserts the
+    model version actually advanced with zero failed requests (drive()
+    raises on any failure)."""
+    v0 = server.predictor.model_info().get("step")
+    window = {}
+    done = threading.Event()
+
+    def updater():
+        try:
+            time.sleep(args.seconds / 3)
+            step = save_next()
+            t0 = time.monotonic()
+            changed = server.predictor.poll_updates()
+            window.update(t0=t0, t1=time.monotonic(), changed=changed,
+                          new_step=step)
+        except Exception as e:  # surfaced below — fail loudly, not KeyError
+            window["error"] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=updater)
+    t_begin = time.monotonic()
+    th.start()
+    recs = drive(http.port, payloads, args.seconds, args.clients,
+                 until_event=done)
+    th.join()
+    elapsed = time.monotonic() - t_begin
+    if "error" in window:
+        raise RuntimeError("rolling-update phase failed") from window["error"]
+
+    t0, t1 = window["t0"] - 0.25, window["t1"] + 0.25
+    during = [dt for ts, dt in recs if t0 <= ts <= t1]
+    steady = [dt for ts, dt in recs if ts < t0 or ts > t1]
+    v1 = server.predictor.model_info().get("step")
+    out = summarize(
+        name + "+rolling-update", recs, elapsed, args.clients,
+        args.rows,
+        extra={
+            "steady_p99_ms": (
+                round(1e3 * pct(steady, 0.99), 2) if steady else None),
+            "during_update_p99_ms": (
+                round(1e3 * pct(during, 0.99), 2) if during else None),
+            "during_update_max_ms": (
+                round(1e3 * max(during), 2) if during else None),
+            "update_window_ms": round(1e3 * (window["t1"] - window["t0"]), 1),
+            "model_version_advanced": bool(window["changed"]) and v1 != v0,
+        },
+    )
+    print(json.dumps(out), flush=True)
+    return out
 
 
 if __name__ == "__main__":
