@@ -1,4 +1,5 @@
 from deeprec_tpu.data.synthetic import (
+    CriteoStats,
     SyntheticBehaviorSequence,
     SyntheticCriteo,
     SyntheticMultiTask,

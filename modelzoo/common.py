@@ -32,7 +32,7 @@ def build_argparser(name: str) -> argparse.ArgumentParser:
                    choices=["sgd", "adagrad", "adagrad_decay", "adam",
                             "adam_async", "adamw", "ftrl"])
     p.add_argument("--data", default="synthetic",
-                   help="'synthetic', a criteo .tsv glob, or a .parquet glob")
+                   help="'synthetic', 'criteo_stats' (pinned Criteo-marginal stream), a criteo .tsv glob, or a .parquet glob")
     p.add_argument("--sharded", action="store_true",
                    help="shard tables + batch over all local devices")
     p.add_argument("--comm", default="allgather", choices=["allgather", "a2a"],
@@ -114,6 +114,23 @@ def make_data(args, kind: str):
 
     from deeprec_tpu import data as D
 
+    if args.data == "criteo_stats":
+        if kind != "criteo":
+            raise ValueError(
+                "criteo_stats generates Criteo-shaped batches; model kind "
+                f"{kind!r} wants a different schema"
+            )
+        # The deterministic Criteo-marginal-matched stream (AUC protocol,
+        # docs/auc_protocol.md): train and eval are disjoint splits of the
+        # same fixed task, so eval AUC is held-out, not memorized.
+        gen = D.CriteoStats(args.batch_size, seed=args.seed, split="train")
+        args._eval_iter = iter(
+            D.CriteoStats(args.batch_size, seed=args.seed, split="eval")
+        )
+        # stream position checkpoints with the model (CriteoStats is a
+        # pure function of index, so a restore must NOT replay batch 0)
+        args._datasets = {"criteo_stats": gen}
+        return D.staged(iter(gen))
     if args.data != "synthetic":
         paths = sorted(glob.glob(args.data))
         if not paths:
@@ -221,7 +238,8 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             print(f"restored from step {int(state.step)}")
         except FileNotFoundError:
             pass
-    eval_batches = [put(next(iter(data))) for _ in range(args.eval_batches)]
+    eval_src = getattr(args, "_eval_iter", None) or iter(data)
+    eval_batches = [put(next(eval_src)) for _ in range(args.eval_batches)]
 
     tracer = None
     if args.timeline:

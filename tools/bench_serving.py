@@ -245,8 +245,10 @@ def rolling_update_phase(server, http, payloads, args, name, save_next):
         raise RuntimeError("rolling-update phase failed") from window["error"]
 
     t0, t1 = window["t0"] - 0.25, window["t1"] + 0.25
-    during = [dt for ts, dt in recs if t0 <= ts <= t1]
-    steady = [dt for ts, dt in recs if ts < t0 or ts > t1]
+    # classify by interval OVERLAP: a request in flight when the update
+    # starts belongs to the update window even if it started before it
+    during = [dt for ts, dt in recs if ts <= t1 and ts + dt >= t0]
+    steady = [dt for ts, dt in recs if ts + dt < t0 or ts > t1]
     v1 = server.predictor.model_info().get("step")
     out = summarize(
         name + "+rolling-update", recs, elapsed, args.clients,
