@@ -87,6 +87,13 @@ class ShardedTrainer(Trainer):
         self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)
         self._eval_step = jax.jit(self._sharded_eval)
 
+    def _stage_put(self, batch):
+        # auto-stage (Trainer.stage) places batches with mesh sharding so
+        # the staged transfer already lands split across devices
+        from deeprec_tpu.parallel.mesh import shard_batch
+
+        return shard_batch(self.mesh, batch, axis=self.axis)
+
     # ------------------------------------------------------------------ init
 
     def init(self, seed: int = 0) -> TrainState:

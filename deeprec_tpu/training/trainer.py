@@ -130,6 +130,7 @@ class Trainer:
         dense_opt: Optional[optax.GradientTransformation] = None,
         grad_averaging: bool = False,
         remat: bool = False,
+        stage: str = "auto",
     ):
         self.model = model
         self.sparse_opt = sparse_opt
@@ -139,6 +140,9 @@ class Trainer:
         # (jax.checkpoint): trades MXU FLOPs for HBM — the rematerialisation
         # lever for big towers / long sequences.
         self.remat = remat
+        if stage not in ("auto", "off"):
+            raise ValueError(f"unknown stage mode {stage!r}")
+        self.stage_mode = stage
         self.sparse_specs = fcol.sparse_features(model.features)
         self.dense_specs = fcol.dense_features(model.features)
         self.bundles = build_bundles(model.features)
@@ -376,6 +380,48 @@ class Trainer:
         out, probs = self.probs_from_views(state, views, batch)
         loss, _ = self._loss_from_logits(out, batch)
         return loss, probs
+
+    # ----------------------------------------------------------- auto-stage
+
+    def input_keys(self) -> frozenset:
+        """Batch keys the jitted step consumes — the model's input
+        signature (sparse + dense feature names; labels ride by the
+        'label*' convention, see _loss_from_logits). This is the
+        SmartStage boundary derivation
+        (/root/reference/tensorflow/core/graph/smart_stage_pass.cc:30)
+        reduced to its JAX form: the reference walks the graph to find
+        the IO-side cut; here the cut IS the batch dict, so the analysis
+        collapses to 'which keys does the step read'."""
+        return frozenset(f.name for f in self.sparse_specs) | frozenset(
+            f.name for f in self.dense_specs
+        )
+
+    def stage_batch(self, batch):
+        """Trim a host batch to the input signature and start its async
+        device transfer (device_put returns immediately). Idempotent —
+        re-staging a staged batch is a cheap no-op."""
+        keep = self.input_keys()
+        return self._stage_put({
+            k: v for k, v in batch.items()
+            if k in keep or k.startswith("label")
+        })
+
+    def _stage_put(self, batch):
+        # ShardedTrainer overrides with mesh placement.
+        return jax.device_put(batch)
+
+    def stage(self, source, depth: int = 2):
+        """Auto-staged input pipeline: wrap any host batch iterator so IO,
+        the host->device transfer, and the train step overlap — zero
+        manual `staged()` calls, boundary derived from the model (the
+        SmartStage user contract). Returns `source` unchanged when the
+        trainer was built with stage="off"."""
+        if self.stage_mode != "auto":
+            return source
+        from deeprec_tpu.data.prefetch import Prefetcher
+
+        return Prefetcher(iter(source), depth=depth,
+                          transform=self.stage_batch)
 
     # --------------------------------------------------------------- public
 

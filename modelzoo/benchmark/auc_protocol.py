@@ -115,8 +115,11 @@ def main():
             res = run_one(model, seed, args)
             print(json.dumps(res), flush=True)
             report["runs"].append(res)
-            with open(args.out, "w") as f:   # survive partial grids
+            # atomic update: a crash mid-dump must not eat prior runs
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump(report, f, indent=1)
+            os.replace(tmp, args.out)
     ok = [r for r in report["runs"] if r["ok"]]
     print(f"done: {len(ok)}/{len(report['runs'])} runs ok -> {args.out}")
 

@@ -130,7 +130,7 @@ def make_data(args, kind: str):
         # stream position checkpoints with the model (CriteoStats is a
         # pure function of index, so a restore must NOT replay batch 0)
         args._datasets = {"criteo_stats": gen}
-        return D.staged(iter(gen))
+        return iter(gen)
     if args.data != "synthetic":
         paths = sorted(glob.glob(args.data))
         if not paths:
@@ -149,15 +149,13 @@ def make_data(args, kind: str):
             args._datasets = {"workqueue": q}
             # training wants one compiled batch shape: drop per-slice
             # remainders (size the slices >= batch_size)
-            return D.staged(
-                q.input_dataset(
-                    args.batch_size, drop_remainder=True,
-                    reader_cls=D.ParquetReader if parquet else None,
-                )
+            return q.input_dataset(
+                args.batch_size, drop_remainder=True,
+                reader_cls=D.ParquetReader if parquet else None,
             )
         if paths[0].endswith(".parquet"):
-            return D.staged(iter(D.ParquetReader(paths, args.batch_size)))
-        return D.staged(iter(D.CriteoCSVReader(paths, args.batch_size)))
+            return iter(D.ParquetReader(paths, args.batch_size))
+        return iter(D.CriteoCSVReader(paths, args.batch_size))
     if kind == "criteo":
         gen = D.SyntheticCriteo(args.batch_size, vocab=args.vocab, seed=args.seed)
     elif kind == "multitask":
@@ -174,7 +172,7 @@ def make_data(args, kind: str):
                                   seed=args.seed)
     else:
         raise ValueError(kind)
-    return D.staged(iter(gen))
+    return iter(gen)
 
 
 def _retable(model, **cfg_overrides):
@@ -214,21 +212,21 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
 
     sparse_opt, dense_opt = make_optimizers(args)
     if args.sharded:
-        from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+        from deeprec_tpu.parallel import ShardedTrainer, make_mesh
 
         mesh = make_mesh()
         trainer = ShardedTrainer(model, sparse_opt, dense_opt, mesh=mesh,
                                  comm=args.comm)
-        put = lambda b: shard_batch(mesh, {k: jnp.asarray(v) for k, v in b.items()})
     else:
         trainer = Trainer(model, sparse_opt, dense_opt)
-        put = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
-
     state = trainer.init(args.seed)
-    # data FIRST: make_data registers input-state carriers (WorkQueue) in
-    # args._datasets, which the CheckpointManager must know about BEFORE
-    # restore() so queue positions rewind with the model.
-    data = make_data(args, data_kind)
+    # data FIRST: make_data registers input-state carriers (WorkQueue,
+    # CriteoStats) in args._datasets, which the CheckpointManager must
+    # know about BEFORE restore() so stream positions rewind with the
+    # model. Staging starts strictly AFTER restore: the prefetch ring
+    # pulls ahead the moment it exists, and batches queued pre-restore
+    # would replay data the checkpointed run already trained on.
+    raw_data = make_data(args, data_kind)
     ck = None
     if args.checkpoint:
         ck = CheckpointManager(args.checkpoint, trainer,
@@ -238,8 +236,18 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             print(f"restored from step {int(state.step)}")
         except FileNotFoundError:
             pass
-    eval_src = getattr(args, "_eval_iter", None) or iter(data)
-    eval_batches = [put(next(eval_src)) for _ in range(args.eval_batches)]
+    # Auto-stage (SmartStage analog): the trainer derives the staged
+    # boundary from the model's input signature — IO, key filtering and
+    # the (mesh-aware) host->device transfer overlap the train step with
+    # zero manual staged() calls here or in make_data. Batches from
+    # `data` are device-ready; only out-of-band eval batches need the
+    # explicit stage_batch call.
+    data = trainer.stage(raw_data)
+    eval_src = getattr(args, "_eval_iter", None)
+    eval_batches = [
+        trainer.stage_batch(next(eval_src)) if eval_src else next(iter(data))
+        for _ in range(args.eval_batches)
+    ]
 
     tracer = None
     if args.timeline:
@@ -264,10 +272,10 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
             tracer.on_step(step)
         if args.micro_batch > 1:
             state, mets = trainer.train_step_accum(
-                state, put(batch), args.micro_batch
+                state, batch, args.micro_batch
             )
         else:
-            state, mets = trainer.train_step(state, put(batch))
+            state, mets = trainer.train_step(state, batch)
         step += 1
         if step % args.log_every == 0:
             jax.block_until_ready(mets["loss"])
