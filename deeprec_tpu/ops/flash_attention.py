@@ -5,11 +5,14 @@ behavior histories; DeepRec itself has no attention sharding — SURVEY.md §5).
 The forward pass is a classic online-softmax Pallas kernel: Q blocks stream
 from HBM to VMEM, K/V blocks iterate in-kernel, running (max, denom, acc)
 carry the softmax — O(L·block) VMEM instead of the O(L²) score matrix. The
-backward is blockwise JAX (lax.scan over K blocks with the saved LSE): same
-O(L²)→O(L·block) memory shape, compiler-scheduled, exact gradients.
+backward is Pallas too (flash-2 structure, exact gradients from the saved
+LSE): a dK/dV kernel where each K/V block accumulates over streamed Q
+blocks in VMEM scratch, and a dQ kernel with the forward's access pattern —
+no atomics, no [L, S] materialization, causal blocks skipped on both sides
+of the diagonal.
 
-On non-TPU backends the same kernel runs in interpreter mode (tests) or falls
-back to a reference jnp implementation.
+On non-TPU backends the kernels run in interpreter mode (tests) or fall
+back to a blockwise lax.scan implementation with the same memory shape.
 """
 from __future__ import annotations
 
@@ -49,6 +52,31 @@ def attention_reference(q, k, v, mask=None, causal=False, sm_scale=None):
 # ------------------------------------------------------------- pallas forward
 
 
+def _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale, causal):
+    """Scaled QK^T with padding + causal masking — the one definition all
+    three kernels (fwd, dKdV, dQ) share; a drift here would silently
+    desynchronize forward and backward. Inlines at trace time.
+    q [block_q, D] f32, k [block_k, D] f32, mk [block_k] int; qb/kb are
+    the Q/K *block* indices."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(mk[None, :] > 0, s, NEG_INF)
+    if causal:
+        qpos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    return s
+
+
+def _ds_from_p(p, do, v, delta, sm_scale):
+    """dS = P ∘ (dO·Vᵀ − Δ)·scale — shared by both backward kernels."""
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    return p * (dp - delta[:, None]) * sm_scale
+
+
 def _fa_fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     block_k: int, sm_scale: float, causal: bool, block_q: int, num_kb: int,
@@ -66,12 +94,10 @@ def _fa_fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    qpos = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+    qb = pl.program_id(1)
     # Causal: K blocks fully above the diagonal contribute nothing — skip
     # their compute (~2x FLOPs saved on long sequences).
-    diag_reached = (kb * block_k) <= (pl.program_id(1) + 1) * block_q - 1
+    diag_reached = (kb * block_k) <= (qb + 1) * block_q - 1
     run = diag_reached if causal else (kb >= 0)
 
     @pl.when(run)
@@ -80,13 +106,8 @@ def _fa_fwd_kernel(
         k = k_ref[0].astype(jnp.float32)  # [block_k, D]
         v = v_ref[0].astype(jnp.float32)
         mk = mask_ref[0]  # [block_k]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        s = jnp.where(mk[None, :] > 0, s, NEG_INF)
-        if causal:
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        s = _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale,
+                           causal)
         m = m_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
@@ -189,6 +210,178 @@ def _blockwise_forward(q, k, v, mask, causal, sm_scale, block_k):
     return o, lse
 
 
+# ---------------------------------------------------------- pallas backward
+
+
+def _fa_bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr, *,
+    block_q: int, block_k: int, sm_scale: float, causal: bool, num_qb: int,
+):
+    """dK/dV: grid = (BH, S/block_k, Lq/block_q). One K/V block owns the
+    kernel instance; Q blocks stream through the sequential minor grid
+    axis, accumulating dk/dv in VMEM scratch (flash-2 structure: no
+    atomics, no [L, S] materialization)."""
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(2)
+    kb = pl.program_id(1)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: Q blocks entirely above this K block's diagonal see none of
+    # it — skip their compute (the backward mirror of the forward skip).
+    diag_reached = (kb * block_k) <= ((qb + 1) * block_q - 1)
+    run = diag_reached if causal else (qb >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)       # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)       # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)     # [block_q, D]
+        lse = lse_ref[0].astype(jnp.float32)   # [block_q]
+        delta = delta_ref[0].astype(jnp.float32)
+        mk = mask_ref[0]                       # [block_k]
+        s = _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale,
+                           causal)
+        p = jnp.exp(s - lse[:, None])          # exact probs from saved LSE
+        dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        ds = _ds_from_p(p, do, v, delta, sm_scale)
+        dk_scr[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == num_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr, *,
+    block_q: int, block_k: int, sm_scale: float, causal: bool, num_kb: int,
+):
+    """dQ: grid = (BH, Lq/block_q, S/block_k), accumulating over K blocks
+    in scratch — the forward kernel's access pattern with ds in place of p."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(2)
+    qb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    diag_reached = (kb * block_k) <= (qb + 1) * block_q - 1
+    run = diag_reached if causal else (kb >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)
+        delta = delta_ref[0].astype(jnp.float32)
+        mk = mask_ref[0]
+        s = _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale,
+                           causal)
+        p = jnp.exp(s - lse[:, None])
+        ds = _ds_from_p(p, do, v, delta, sm_scale)
+        dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, mask, causal, sm_scale, block_q, block_k,
+                     o, lse, do, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Lq, D = q.shape
+    S = k.shape[2]
+    BH = B * H
+    qr = q.reshape(BH, Lq, D)
+    kr = k.reshape(BH, S, D)
+    vr = v.reshape(BH, S, D)
+    dor = do.reshape(BH, Lq, D)
+    lser = lse.reshape(BH, Lq)
+    maskr = jnp.repeat(mask.astype(jnp.int32), H, axis=0)  # [BH, S]
+    # delta = rowsum(do * o): cheap elementwise+reduce, XLA fuses it; the
+    # kernels read it per Q block.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(BH, Lq)
+
+    num_qb, num_kb = Lq // block_q, S // block_k
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    common = dict(interpret=interpret)
+
+    dkdv_kernel = functools.partial(
+        _fa_bwd_dkdv_kernel, block_q=block_q, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, num_qb=num_qb,
+    )
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(BH, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, kb, qb: (b, qb, 0)),  # q
+            pl.BlockSpec((1, block_k, D), lambda b, kb, qb: (b, kb, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, kb, qb: (b, kb, 0)),  # v
+            pl.BlockSpec((1, block_k), lambda b, kb, qb: (b, kb)),        # mask
+            pl.BlockSpec((1, block_q, D), lambda b, kb, qb: (b, qb, 0)),  # do
+            pl.BlockSpec((1, block_q), lambda b, kb, qb: (b, qb)),        # lse
+            pl.BlockSpec((1, block_q), lambda b, kb, qb: (b, qb)),        # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, kb, qb: (b, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, kb, qb: (b, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        **common,
+    )(qr, kr, vr, maskr, dor, lser, delta)
+
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, num_kb=num_kb,
+    )
+    (dq,) = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, num_qb, num_kb),
+        in_specs=[
+            qspec,                                                        # q
+            pl.BlockSpec((1, block_k, D), lambda b, i, kb: (b, kb, 0)),   # k
+            pl.BlockSpec((1, block_k, D), lambda b, i, kb: (b, kb, 0)),   # v
+            pl.BlockSpec((1, block_k), lambda b, i, kb: (b, kb)),         # mask
+            qspec,                                                        # do
+            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),          # lse
+            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),          # delta
+        ],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((BH, Lq, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        **common,
+    )(qr, kr, vr, maskr, dor, lser, delta)
+
+    return (
+        dq.reshape(B, H, Lq, D),
+        dk.reshape(B, H, S, D),
+        dv.reshape(B, H, S, D),
+    )
+
+
 # ------------------------------------------------------------------ backward
 
 
@@ -262,9 +455,15 @@ def _fa_fwd(q, k, v, mask, causal, sm_scale, block_q, block_k, interpret):
 def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     q, k, v, mask, o, lse = res
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    dq, dk, dv = _blockwise_backward(
-        q, k, v, mask, causal, scale, block_k, o, lse, do
-    )
+    if _use_pallas() or interpret:
+        dq, dk, dv = _pallas_backward(
+            q, k, v, mask, causal, scale, block_q, block_k, o, lse, do,
+            interpret or not _use_pallas(),
+        )
+    else:
+        dq, dk, dv = _blockwise_backward(
+            q, k, v, mask, causal, scale, block_k, o, lse, do
+        )
     return dq, dk, dv, None
 
 

@@ -61,16 +61,19 @@ def test_stage_overlaps_io_with_compute():
     gen = SyntheticCriteo(batch_size=16, num_cat=4, num_dense=2, vocab=100)
     pulls = []
 
+    # IO strictly faster than compute so the producer cycle (0.04s +
+    # stage_batch transform) provably finishes inside the consumer's
+    # 0.08s window — equal sleeps made the ordering a coin flip.
     def slow_source(n=6):
         for _ in range(n):
-            time.sleep(0.05)  # "IO"
+            time.sleep(0.04)  # "IO"
             pulls.append(time.monotonic())
             yield gen.batch()
 
     staged = tr.stage(slow_source())
     finishes = []
     for _ in staged:
-        time.sleep(0.05)  # "compute"
+        time.sleep(0.08)  # "compute"
         finishes.append(time.monotonic())
     assert len(finishes) == 6 and len(pulls) == 6
     # overlap: while we computed on batch i, the ring fetched ahead —
@@ -79,9 +82,10 @@ def test_stage_overlaps_io_with_compute():
         pulls[i + 1] < finishes[i] for i in range(5)
     )
     assert overlapped >= 4, (pulls, finishes)
-    # and wall clock beats the serial sum (6*0.05 IO + 6*0.05 compute)
-    wall = finishes[-1] - pulls[0] + 0.05
-    assert wall < 0.55, wall
+    # and wall clock beats the serial sum (6*0.04 IO + 6*0.08 compute =
+    # 0.72s): overlapped ≈ 0.04 + 6*0.08 ≈ 0.52s + transform slop
+    wall = finishes[-1] - pulls[0] + 0.04
+    assert wall < 0.68, wall
 
 
 def test_sharded_stage_places_on_mesh():
