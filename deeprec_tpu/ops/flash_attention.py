@@ -77,6 +77,15 @@ def _ds_from_p(p, do, v, delta, sm_scale):
     return p * (dp - delta[:, None]) * sm_scale
 
 
+def _probs_from_lse(s, lse):
+    """exp(s − LSE) with the dead-row guard: a row whose visible keys are
+    ALL masked stores lse ≈ NEG_INF, and exp(NEG_INF − NEG_INF) = 1 would
+    broadcast garbage into dk/dv/dq — such rows attend to nothing, so
+    their probabilities are exactly zero. Shared by every backward path."""
+    dead = lse <= NEG_INF * 0.5
+    return jnp.where(dead[..., None], 0.0, jnp.exp(s - lse[..., None]))
+
+
 def _fa_fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     block_k: int, sm_scale: float, causal: bool, block_q: int, num_kb: int,
@@ -248,7 +257,7 @@ def _fa_bwd_dkdv_kernel(
         mk = mask_ref[0]                       # [block_k]
         s = _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale,
                            causal)
-        p = jnp.exp(s - lse[:, None])          # exact probs from saved LSE
+        p = _probs_from_lse(s, lse)            # exact probs from saved LSE
         dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         ds = _ds_from_p(p, do, v, delta, sm_scale)
         dk_scr[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
@@ -289,7 +298,7 @@ def _fa_bwd_dq_kernel(
         mk = mask_ref[0]
         s = _masked_scores(q, k, mk, qb, kb, block_q, block_k, sm_scale,
                            causal)
-        p = jnp.exp(s - lse[:, None])
+        p = _probs_from_lse(s, lse)
         ds = _ds_from_p(p, do, v, delta, sm_scale)
         dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -404,7 +413,7 @@ def _blockwise_backward(q, k, v, mask, causal, sm_scale, block_k, o, lse, do):
         if causal:
             kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (Lq, block_k), 1)
             s = jnp.where((kpos <= qpos)[None, None], s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # exact probabilities
+        p = _probs_from_lse(s, lse)  # exact probabilities (dead rows -> 0)
         dp = jnp.einsum("bhld,bhsd->bhls", dof, vs)
         ds = p * (dp - delta[..., None]) * sm_scale
         dq = dq + jnp.einsum("bhls,bhsd->bhld", ds, ks)

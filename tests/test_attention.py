@@ -102,6 +102,42 @@ def test_blockwise_backward_matches_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 
+@pytest.mark.parametrize("interpret", [True, False])
+def test_fully_masked_row_zero_gradients(interpret):
+    """A batch row whose mask is all-False attends to nothing: output 0,
+    and the backward must contribute NOTHING from it (the saved LSE is
+    ~NEG_INF there; an unguarded exp(s - lse) would emit p=1 garbage
+    into dk/dv/dq). interpret=True drives the Pallas kernels, False the
+    blockwise fallback."""
+    q, k, v, _ = _inputs(B=2, L=128, D=16, seed=7)
+    mask = jnp.asarray(np.array([[True] * 128, [False] * 128]))
+
+    def loss(q, k, v):
+        # linear loss -> do is nonzero even where the output is zero
+        return jnp.sum(
+            flash_attention(q, k, v, mask, False, None, 64, 64, interpret)
+        )
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.all(np.isfinite(np.asarray(g)))
+    # the dead batch element contributes exactly nothing
+    np.testing.assert_array_equal(np.asarray(dq[1]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dk[1]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dv[1]), 0.0)
+    # the live batch element still matches the dense oracle
+    ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            attention_reference(q[:1], k[:1], v[:1], mask[:1])
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip((dq, dk, dv), ref):
+        np.testing.assert_allclose(
+            np.asarray(a[0]), np.asarray(b[0]), atol=3e-4
+        )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_reference(causal):
     mesh = make_mesh(8, axis="sp")
