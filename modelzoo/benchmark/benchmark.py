@@ -30,16 +30,20 @@ AUC_RE = re.compile(r"Eval AUC: ([0-9.]+) \((\w+)\)")
 
 # Per-model eval-AUC floors for the --full / --extended tiers (the
 # reference harness asserts converged AUC the same way,
-# /root/reference/modelzoo/benchmark/cpu/config.yaml). Floors are set
-# ~0.02 under the worst observed smoke-tier AUC (MODELZOO_SMOKE.json,
-# 300 steps) — longer runs must not do WORSE than smoke; raise them as
-# full-tier evidence accumulates. BST's floor reflects the round-5 head
-# fix (target-position encoding feeds the MLP): 0.687 at smoke size.
+# /root/reference/modelzoo/benchmark/cpu/config.yaml). Floors sit ~0.02
+# under the measured extended-tier AUCs (MODELZOO_FULL.json, round 5:
+# 0.70-0.73 criteo/behavior, 0.78 dssm, 0.666 multitask ctr; BST 0.719
+# after the target-position head fix) minus an extra 0.01 seed-noise
+# allowance — the extended tier has ONE observation per model so far, and
+# 1000-step runs carry more seed variance than the 12k-step protocol
+# (which measured ±0.002 across seeds, AUC_PROTOCOL.json). Tighten as
+# multi-seed evidence accumulates; a run below these floors means
+# training quality actually broke.
 AUC_FLOORS = {
-    "wide_and_deep": 0.66, "deepfm": 0.66, "dlrm": 0.63, "dcn": 0.66,
-    "dcnv2": 0.66, "mlperf": 0.66, "masknet": 0.65, "din": 0.62,
-    "dien": 0.62, "bst": 0.64, "dssm": 0.68, "esmm": 0.62, "mmoe": 0.62,
-    "ple": 0.62, "dbmtl": 0.62, "simple_multitask": 0.62,
+    "wide_and_deep": 0.70, "deepfm": 0.69, "dlrm": 0.68, "dcn": 0.70,
+    "dcnv2": 0.70, "mlperf": 0.70, "masknet": 0.70, "din": 0.67,
+    "dien": 0.67, "bst": 0.68, "dssm": 0.74, "esmm": 0.63, "mmoe": 0.63,
+    "ple": 0.63, "dbmtl": 0.63, "simple_multitask": 0.63,
 }
 
 
