@@ -55,6 +55,7 @@ def run_model(name: str, args) -> dict:
         "--capacity", str(args.capacity),
         "--eval_every", str(args.steps),
         "--log_every", "50",
+        "--seed", str(args.seed),
     ]
     if args.sharded:
         cmd.append("--sharded")
@@ -106,6 +107,7 @@ def main(argv=None):
     p.add_argument("--capacity", type=int, default=1 << 18)
     p.add_argument("--sharded", action="store_true")
     p.add_argument("--timeout", type=int, default=1800)
+    p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="")
     p.add_argument("--full", action="store_true",
                    help="reference protocol (12k steps, bs 2048, AUC "
@@ -128,6 +130,7 @@ def main(argv=None):
         "tier": tier,
         "batch_size": args.batch_size,
         "steps": args.steps,
+        "seed": args.seed,
         "floors_asserted": check_floors,
         "results": results,
     }
