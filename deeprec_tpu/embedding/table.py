@@ -651,6 +651,15 @@ def _lookup_readonly_jit(table, state, ids, pad_value, salt):
     return table._lookup_readonly_impl(state, ids, pad_value, salt)
 
 
+@_functools.partial(jax.jit, static_argnums=0)
+def probe_jit(table, keys, uids, want_create):
+    """Jitted lookup-or-create probe for restore/replay paths: the eager
+    while_loop dispatches op-by-op and dominated delta-replay latency
+    (poll_updates under serving load). Compile-cached per (table,
+    shapes) — pair with power-of-two row bucketing (import_rows)."""
+    return table._probe(keys, uids, want_create)
+
+
 @_functools.partial(jax.jit, static_argnums=(0, 3))
 def _evict_jit(table, state, step, slot_fills):
     drop = table.evict_mask(state, step)

@@ -132,16 +132,45 @@ def import_rows(
     state: TableState,
     rows: Dict[str, np.ndarray],
     strict: bool = True,
+    bucket: bool = False,
 ) -> TableState:
-    """Insert checkpointed rows into a (fresh or live) local table state."""
+    """Insert checkpointed rows into a (fresh or live) local table state.
+
+    bucket=True pads the row count to the next power of two before the
+    probe/scatter: every distinct count is a distinct static shape, and
+    delta replays at serving cadence (poll_updates) would otherwise bake
+    a fresh XLA program per update. Padding keys hold the empty-key
+    sentinel, which _probe treats as invalid — inert by construction.
+    One-shot full restores skip it (each shape compiles once anyway, and
+    padding would transiently copy the whole values array). Only PER-ROW
+    arrays pad; per-table entries (scalar optimizer slots, bloom) pass
+    through untouched.
+    """
     n = rows["keys"].shape[0]
     if n == 0:
         if "bloom" in rows and state.bloom is not None:
             state = state.replace(bloom=jnp.asarray(rows["bloom"]))
         return state
+    m = (1 << (n - 1).bit_length()) if bucket else n
+
+    def _padded(k, a):
+        per_row = k in ("keys", "values", "freqs", "versions") or (
+            k.startswith("slot:") and is_per_row(k)
+        )
+        if m == n or not per_row:
+            return a
+        a = np.asarray(a)
+        fill = empty_key(table.cfg) if k == "keys" else 0
+        return np.concatenate(
+            [a, np.full((m - n,) + a.shape[1:], fill, a.dtype)]
+        )
+
+    rows = {k: _padded(k, v) for k, v in rows.items()}
+    from deeprec_tpu.embedding.table import probe_jit
+
     keys = jnp.asarray(rows["keys"])
-    new_keys, slot_ix, created, failed = table._probe(
-        state.keys, keys, jnp.ones((n,), bool)
+    new_keys, slot_ix, created, failed = probe_jit(
+        table, state.keys, keys, jnp.ones((m,), bool)
     )
     if strict and bool(jnp.any(failed)):
         raise RuntimeError(
@@ -923,6 +952,10 @@ class CheckpointManager:
         return merged
 
     def _apply_ckpt(self, state: TrainState, path: str, load_dense: bool) -> TrainState:
+        # Delta replays recur at serving cadence with a different row
+        # count each time — bucket those to stabilize compiled shapes;
+        # one-shot full restores import exact-size.
+        bucket = os.path.basename(path).startswith("incr-")
         tables = dict(state.tables)
         for bname, b in self.trainer.bundles.items():
             ts = tables[bname]
@@ -935,7 +968,8 @@ class CheckpointManager:
                 if rows is not None:
                     rows.pop("partition_offset", None)
                     live = rows.pop("live_keys", None)
-                    sub = self._import_local(b.table, sub, rows)
+                    sub = self._import_local(b.table, sub, rows,
+                                             bucket=bucket)
                     if live is not None:
                         # delta semantics: anything absent from the delta's
                         # live set was evicted since the previous save
@@ -972,7 +1006,8 @@ class CheckpointManager:
             sub, keep=jnp.asarray(np.isin(keys, live)), slot_fills=fills
         )
 
-    def _import_local(self, table, sub: TableState, rows) -> TableState:
+    def _import_local(self, table, sub: TableState, rows,
+                      bucket: bool = False) -> TableState:
         """Import rows into a local (possibly shard-stacked) table state."""
         if self._is_sharded():
             N = self.trainer.num_shards
@@ -997,7 +1032,8 @@ class CheckpointManager:
                 # would inflate ~N× per save/restore cycle.
                 shard_rows.pop("bloom", None)  # legacy merged-sketch files
                 local = jax.tree.map(lambda a: a[s], sub)
-                local = import_rows(table, local, shard_rows)
+                local = import_rows(table, local, shard_rows,
+                                    bucket=bucket)
                 cbf = table.cfg.ev.cbf_filter
                 if cbf is not None and local.bloom is not None and same_topology:
                     local = local.replace(
@@ -1017,7 +1053,7 @@ class CheckpointManager:
                     local = local.replace(bloom=bloom)
                 shards.append(local)
             return jax.tree.map(lambda *xs: jnp.stack(xs), *shards)
-        return import_rows(table, sub, rows)
+        return import_rows(table, sub, rows, bucket=bucket)
 
     # ----------------------------------------------------------------- gc
 

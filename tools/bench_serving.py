@@ -50,17 +50,24 @@ def build(tmp, emb_dim=16, steps=5):
         st, _ = tr.train_step(st, {k: jnp.asarray(v)
                                    for k, v in gen.batch().items()})
     ck = CheckpointManager(tmp, tr)
-    ck.save(st)
+    # keep the returned state: save() clears the dirty bitmap, so later
+    # incremental saves contain only rows actually touched since
+    st, _ = ck.save(st)
     req = {k: v for k, v in gen.batch().items() if not k.startswith("label")}
 
-    def save_next():
+    def save_next(mode: str = "full"):
         """Train a few more steps and land a NEW checkpoint (the rolling-
-        update stimulus)."""
+        update stimulus). mode="delta" writes an incremental checkpoint —
+        the DeltaModelUpdate path: poll_updates replays touched rows onto
+        the live state instead of a full reload."""
         nonlocal st
         for _ in range(3):
             st, _ = tr.train_step(st, {k: jnp.asarray(v)
                                        for k, v in gen.batch().items()})
-        ck.save(st)
+        if mode == "delta":
+            st, _ = ck.save_incremental(st)
+        else:
+            st, _ = ck.save(st)
         return int(st.step)
 
     return model, req, save_next
@@ -199,8 +206,19 @@ def main():
                 print(json.dumps(out), flush=True)
 
                 if groups and name == f"group-{max(groups)}":
+                    # full reload first, then the delta (DeltaModelUpdate)
+                    # path — the blip the incremental format exists to shrink
                     results.append(rolling_update_phase(
                         server, http, payloads, args, name, save_next))
+                    results.append(rolling_update_phase(
+                        server, http, payloads, args, name,
+                        lambda: save_next("delta"), label="+delta-update"))
+                    # second delta hits the compile cache (import_rows
+                    # buckets row counts) — the serving-cadence steady state
+                    results.append(rolling_update_phase(
+                        server, http, payloads, args, name,
+                        lambda: save_next("delta"),
+                        label="+delta-update-warm"))
             finally:
                 http.stop()
                 server.close()
@@ -211,7 +229,8 @@ def main():
         return results
 
 
-def rolling_update_phase(server, http, payloads, args, name, save_next):
+def rolling_update_phase(server, http, payloads, args, name, save_next,
+                         label="+rolling-update"):
     """Measure the rolling-update blip: a new checkpoint lands mid-load
     and poll_updates rolls it across replicas while clients keep
     hammering. Reports steady vs during-update latency and asserts the
@@ -251,7 +270,7 @@ def rolling_update_phase(server, http, payloads, args, name, save_next):
     steady = [dt for ts, dt in recs if ts + dt < t0 or ts > t1]
     v1 = server.predictor.model_info().get("step")
     out = summarize(
-        name + "+rolling-update", recs, elapsed, args.clients,
+        name + label, recs, elapsed, args.clients,
         args.rows,
         extra={
             "steady_p99_ms": (
