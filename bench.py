@@ -7,6 +7,16 @@ reference's best published DLRM number: 188.11 global steps/sec × bs 2048 =
 385,249 examples/sec on 1×A100-80G + 64-core Xeon
 (docs/docs_en/Smart-Stage.md:182-190, see BASELINE.md).
 
+Multi-step device loop: `--steps-per-dispatch K` (default 16) measures the
+`Trainer.train_steps` path — K training steps per host dispatch via
+`lax.scan` — and sweeps the K-curve over {1, 4, 16} ∩ [1, K] so the
+dispatch-overhead amortization lands in the JSON (`k_curve`, with >= 3
+timed repetitions and mean/min/max per K so single-core noise is
+distinguishable from regression; see docs/perf.md). The headline `value`
+is the requested K's best repetition; `steps_per_dispatch` records it.
+`--smoke` (or BENCH_SMOKE=1, used by cibuild) shrinks the sweep and the
+timed windows so CI completes quickly.
+
 The TPU behind the axon tunnel is intermittent, so the harness probes with
 retries across a window (BENCH_PROBE_ATTEMPTS × BENCH_PROBE_TIMEOUT, default
 5 × 120s with 30s between failures, ~13 min worst case) and records probe
@@ -14,6 +24,7 @@ diagnostics in the JSON ("tpu": "ok" | "unreachable: <last error>") so a CPU
 fallback is self-describing. The measured workload runs in a subprocess so a
 tunnel that wedges mid-run degrades to the CPU number instead of hanging.
 """
+import argparse
 import json
 import os
 import subprocess
@@ -103,8 +114,66 @@ def _run_worker(extra_env, timeout):
     return None, "workload produced no JSON"
 
 
+def _measure_k(trainer, batches, B, k, timed_steps, reps):
+    """Throughput at k steps/dispatch: identical pre-fill + warmup schedule
+    for every k (same batch sequence), then `reps` timed windows. Returns
+    per-k stats; "examples_per_sec" is the best repetition (the tunnel TPU
+    shows ±15% run-to-run noise on identical programs — the fastest window
+    is the least-noisy estimate), mean/min/max expose the spread."""
+    import jax
+
+    from deeprec_tpu.training import stack_batches
+
+    n = len(batches)
+    state = trainer.init(0)
+    # Pre-fill: populate the table through the single-step path so every k
+    # starts timing from the same table occupancy.
+    for i in range(16):
+        state, mets = trainer.train_step(state, batches[i % n])
+    jax.block_until_ready(mets["loss"])
+
+    steps_k = max(k, timed_steps - timed_steps % k)
+    ndisp = steps_k // k
+    if k == 1:
+        def window(state):
+            for i in range(steps_k):
+                state, mets = trainer.train_step(state, batches[i % n])
+            return state, mets
+    else:
+        stacked = [
+            stack_batches([batches[(d * k + i) % n] for i in range(k)])
+            for d in range(ndisp)
+        ]
+
+        def window(state):
+            for d in range(ndisp):
+                state, mets = trainer.train_steps(state, stacked[d])
+            return state, mets
+
+    # Warmup window: compiles the k-path, advances the same steps_k steps.
+    state, mets = window(state)
+    jax.block_until_ready(mets["loss"])
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, mets = window(state)
+        jax.block_until_ready(mets["loss"])
+        times.append(time.perf_counter() - t0)
+    ex = [steps_k * B / t for t in times]
+    return {
+        "examples_per_sec": round(max(ex), 1),
+        "mean": round(sum(ex) / len(ex), 1),
+        "min": round(min(ex), 1),
+        "max": round(max(ex), 1),
+        "ms_per_step": round(min(times) / steps_k * 1e3, 3),
+        "timed_steps": steps_k,
+        "reps": reps,
+    }
+
+
 def workload():
-    """The measured DLRM step loop. Runs on whatever platform jax resolves."""
+    """The measured DLRM loop. Runs on whatever platform jax resolves."""
     import jax
     import jax.numpy as jnp
 
@@ -113,35 +182,33 @@ def workload():
     from deeprec_tpu.optim import Adagrad
     from deeprec_tpu.training import Trainer
 
+    K = max(1, int(os.environ.get("BENCH_K", "16")))
+    reps = max(3, int(os.environ.get("BENCH_REPS", "3")))
+    timed_steps = int(os.environ.get("BENCH_TIMED_STEPS", "32"))
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    ks = [k for k in (1, 4, 16) if k <= K]
+    if K not in ks:
+        ks.append(K)
+    if smoke:
+        timed_steps = min(timed_steps, 8)
+        ks = sorted({ks[0], ks[-1]})  # endpoints only: fast CI green
+
     B = 2048
     model = DLRM(emb_dim=16, capacity=1 << 20)
     trainer = Trainer(model, Adagrad(lr=0.05))
-    state = trainer.init(0)
-    gen = SyntheticCriteo(batch_size=B, vocab=1_000_000, seed=0)
 
+    gen = SyntheticCriteo(batch_size=B, vocab=1_000_000, seed=0)
     # Pre-generate host batches so input generation isn't measured.
     batches = [
         {k: jnp.asarray(v) for k, v in gen.batch().items()} for _ in range(8)
     ]
 
-    # Warmup (compile + table fill).
-    for i in range(3):
-        state, mets = trainer.train_step(state, batches[i % len(batches)])
-    jax.block_until_ready(mets["loss"])
+    k_curve = {}
+    for k in ks:
+        k_curve[str(k)] = _measure_k(trainer, batches, B, k, timed_steps, reps)
 
-    # Best of 3 windows: the tunnel-attached TPU shows ±15% run-to-run
-    # noise on identical programs; the fastest window is the least-noisy
-    # estimate of the program's actual step time.
-    steps = 30
-    best_dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, mets = trainer.train_step(state, batches[i % len(batches)])
-        jax.block_until_ready(mets["loss"])
-        best_dt = min(best_dt, time.perf_counter() - t0)
-
-    ex_per_sec = steps * B / best_dt
+    head = k_curve[str(K)]
+    ex_per_sec = head["examples_per_sec"]
 
     # Record the program actually measured — backend, storage layout, and
     # kernel-trust flags — so round-over-round numbers are comparable (the
@@ -164,6 +231,12 @@ def workload():
                 "value": round(ex_per_sec, 1),
                 "unit": "examples/sec",
                 "vs_baseline": round(ex_per_sec / BASELINE_EXAMPLES_PER_SEC, 4),
+                "steps_per_dispatch": K,
+                "repetitions": {
+                    "mean": head["mean"], "min": head["min"],
+                    "max": head["max"], "n": head["reps"],
+                },
+                "k_curve": k_curve,
                 "device": jax.devices()[0].platform,
                 "backend": jax.default_backend(),
                 "layout": "packed_x%d" % pack if pack > 1 else "unpacked",
@@ -177,6 +250,29 @@ def workload():
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps-per-dispatch", type=int,
+                   default=int(os.environ.get("BENCH_K", "16")),
+                   help="K training steps per device dispatch (lax.scan); "
+                        "the K-curve over {1,4,16} up to K is also measured")
+    p.add_argument("--reps", type=int,
+                   default=int(os.environ.get("BENCH_REPS", "3")),
+                   help="timed repetitions per K (min 3; JSON records "
+                        "mean/min/max so noise is visible)")
+    p.add_argument("--timed-steps", type=int,
+                   default=int(os.environ.get("BENCH_TIMED_STEPS", "32")),
+                   help="training steps per timed repetition")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast CI path: endpoints-only K sweep, short windows")
+    args = p.parse_args()
+    if args.steps_per_dispatch < 1:
+        p.error("--steps-per-dispatch must be >= 1")
+    # The measured workload runs in a subprocess; parameters ride the env.
+    os.environ["BENCH_K"] = str(args.steps_per_dispatch)
+    os.environ["BENCH_REPS"] = str(args.reps)
+    os.environ["BENCH_TIMED_STEPS"] = str(args.timed_steps)
+    if args.smoke:
+        os.environ["BENCH_SMOKE"] = "1"
     if os.environ.get("BENCH_FORCED") == "1":
         # CI / smoke path: skip the (many-minute) probe window and measure
         # on whatever platform jax resolves in this environment.

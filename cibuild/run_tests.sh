@@ -31,4 +31,4 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "== bench (CPU smoke; real numbers come from TPU) =="
-env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 python bench.py
+env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py

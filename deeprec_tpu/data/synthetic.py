@@ -216,8 +216,14 @@ class CriteoStats:
 
     # ------------------------------------------------------------ internals
 
-    def _stream_rng(self, index: int) -> np.random.Generator:
-        salt = {"train": 1, "eval": 2, "calib": 3}.get(self.split, 99)
+    def _stream_rng(self, index: int,
+                    split: Optional[str] = None) -> np.random.Generator:
+        """Stream generator for batch `index` of `split` (default: this
+        instance's split). The split rides as a PARAMETER — never mutated
+        on the instance — so `_calibrate_intercept`/`bayes_auc` can draw
+        from the calib/eval streams while a concurrent prefetch thread
+        keeps generating train batches from the train salt."""
+        salt = {"train": 1, "eval": 2, "calib": 3}.get(split or self.split, 99)
         return np.random.default_rng((self.seed, salt, index))
 
     def _raw_logit(self, rng: np.random.Generator, n: int):
@@ -241,13 +247,8 @@ class CriteoStats:
     def _calibrate_intercept(self) -> float:
         """Solve sigmoid-intercept so mean click prob == the Kaggle CTR
         (deterministic: fixed calib stream, bisection on the sample)."""
-        save = self.split
-        self.split = "calib"
-        try:
-            rng = self._stream_rng(0)
-            _, _, logit = self._raw_logit(rng, 100_000)
-        finally:
-            self.split = save
+        rng = self._stream_rng(0, split="calib")
+        _, _, logit = self._raw_logit(rng, 100_000)
         lo, hi = -12.0, 12.0
         for _ in range(50):
             mid = (lo + hi) / 2
@@ -259,11 +260,13 @@ class CriteoStats:
 
     # -------------------------------------------------------------- public
 
-    def probs_at(self, index: int, n: Optional[int] = None):
+    def probs_at(self, index: int, n: Optional[int] = None,
+                 split: Optional[str] = None):
         """(batch dict, true click probs) — the generator's oracle view,
-        used by bayes_auc and the generator's own tests."""
+        used by bayes_auc and the generator's own tests. `split` overrides
+        this instance's stream (thread-safe: no instance mutation)."""
         n = n or self.B
-        rng = self._stream_rng(index)
+        rng = self._stream_rng(index, split=split)
         cats, dense, logit = self._raw_logit(rng, n)
         prob = 1.0 / (1.0 + np.exp(-(logit + self.intercept)))
         label = (rng.random(n) < prob).astype(np.float32)
@@ -292,12 +295,7 @@ class CriteoStats:
     def bayes_auc(self, n: int = 500_000) -> float:
         """AUC of the TRUE click probability on a held-out sample — the
         ceiling no trained model can exceed (up to sampling noise)."""
-        save = self.split
-        self.split = "eval"
-        try:
-            out, prob = self.probs_at(10_000_000, n)
-        finally:
-            self.split = save
+        out, prob = self.probs_at(10_000_000, n, split="eval")
         return float(_auc(out["label"], prob))
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

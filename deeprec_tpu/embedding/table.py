@@ -58,7 +58,16 @@ def empty_key(cfg: TableConfig) -> int:
 
 @struct.dataclass
 class TableState:
-    """Device-resident state of one table (a pytree; donate it through jit)."""
+    """Device-resident state of one table (a pytree; donate it through jit).
+
+    Scan-carry contract (Trainer.train_steps runs K steps in one
+    `lax.scan`, threading every TableState through the carry): all leaves
+    keep a FIXED shape and dtype across a step — lookups/applies/admission
+    return arrays of the same aval, and the transient counters
+    (insert_fails, a2a_overflow) accumulate as int32 scalars, never
+    promote. Anything host-shaped (growth, eviction rebuilds to a new
+    capacity, multi-tier sync) stays OUTSIDE the scan, at K-step
+    boundaries — it changes leaf shapes, which a scan carry cannot."""
 
     keys: jnp.ndarray  # [C] key_dtype, empty slots hold the sentinel
     values: jnp.ndarray  # [C, D] value_dtype

@@ -110,6 +110,19 @@ def _pad_updates(slot_ix, new_rows, block):
     return ixp, new_rows
 
 
+def _compiler_params(pltpu, **kw):
+    """Mosaic compiler params across jax versions: TPUCompilerParams was
+    renamed CompilerParams and grew fields over time (has_side_effects is
+    absent in older jax — safe to drop there: these kernels' outputs are
+    always consumed, the flag only guards against DCE). Unknown fields are
+    filtered rather than crashing the whole kernel path."""
+    import dataclasses
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kw.items() if k in names})
+
+
 def _sr_bits(seed, shape):
     """The one seed-derivation for stochastic-rounding bits: every SR
     path (XLA fallback, row kernel, pair kernel) must use this so their
@@ -282,7 +295,7 @@ def apply_rows_sr_pair(values: jnp.ndarray, slot_ix: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(values.shape, values.dtype),
         input_output_aliases={3: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(pltpu, has_side_effects=True),
         interpret=interpret,
     )(ixp, new_rows, bits, values)
 
@@ -576,6 +589,6 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(values.shape, values.dtype),
         input_output_aliases={3: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(pltpu, has_side_effects=True),
         interpret=interpret,
     )(ixp, new_rows, bits, values)

@@ -37,11 +37,7 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
+from deeprec_tpu.parallel.compat import shard_map
 from deeprec_tpu.parallel.trainer import ShardedTrainer
 from deeprec_tpu.training import metrics as M
 from deeprec_tpu.training.trainer import TrainState
@@ -74,6 +70,7 @@ class AsyncShardedTrainer(ShardedTrainer):
         super().__init__(*args, **kw)
         self._bootstrap_jit = jax.jit(self._bootstrap_impl)
         self._async_step = jax.jit(self._async_impl, donate_argnums=0)
+        self._async_steps = jax.jit(self._async_steps_impl, donate_argnums=0)
 
     # ------------------------------------------------------------- specs
 
@@ -138,14 +135,98 @@ class AsyncShardedTrainer(ShardedTrainer):
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
         return self._async_step(astate, batch, lr)
 
-    def _async_impl(self, astate: AsyncState, batch_t, lr):
+    def train_steps_async(self, astate: AsyncState, batches, lr=None):
+        """K inner async steps per staged dispatch — the multi-step device
+        loop composed with the stale-by-one embedding stage. `batches` is a
+        list/tuple of K batch dicts (stacked + mesh-placed here) or a
+        pre-placed [K, ...] pytree. Returns (astate, metrics[K]); metrics
+        at inner step t refer to batch t-1, as in `train_step_async`."""
+        from deeprec_tpu.parallel.mesh import shard_batch
+        from deeprec_tpu.training.trainer import stack_batches
+
+        if isinstance(batches, (list, tuple)):
+            batches = shard_batch(
+                self.mesh, stack_batches(batches), axis=self.axis,
+                stacked=True,
+            )
+        lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
+        return self._async_steps(astate, batches, lr)
+
+    def _async_body(self, astate: AsyncState, batch_t, lr):
+        """One async step on per-shard values (runs INSIDE shard_map).
+        Shared by the single-step path and the K-step scan."""
         state = astate.inner
-        state_spec, batch_spec = self._specs_for(state, batch_t)
+        step = state.step
+        views = astate.views
+        prev_batch = astate.batch
+
+        # (1) dense fwd/bwd on the STALE embeddings (batch t-1)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+        def loss_fn(dense, embs):
+            inputs = self._build_inputs(embs, views, prev_batch)
+            out = self.model.apply(dense, inputs, train=True)
+            loss, out = self._loss_from_logits(out, prev_batch)
+            return loss, out
+
+        (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.dense, embs)
+        g_dense = jax.lax.pmean(g_dense, self.axis)
+
+        # (2) exchange/lookup for batch t — reads the step-start tables,
+        # no data dependency on (1): XLA overlaps it with the matmuls.
+        tables = {
+            bname: self._squeeze(bname, ts)
+            for bname, ts in state.tables.items()
+        }
+        tables, views_t, res_t = self._lookup_all(
+            tables, batch_t, step, True
+        )
+
+        # (3) stale-apply batch t-1's sparse grads
+        tables = self._apply_all(tables, astate.bundle_res, g_embs, step, lr)
+
+        # (4) dense update
+        updates, opt_state = self.dense_opt.update(
+            g_dense, state.opt_state, state.dense
+        )
+        dense = optax.apply_updates(state.dense, updates)
+
+        mets = {"loss": jax.lax.pmean(loss, self.axis)}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = jax.lax.pmean(
+                M.accuracy(probs, prev_batch["label"]), self.axis
+            )
+        else:
+            mets["accuracy"] = jnp.zeros(())
+
+        new_inner = TrainState(
+            step=step + 1,
+            tables={
+                bname: self._unsqueeze(bname, ts)
+                for bname, ts in tables.items()
+            },
+            dense=dense,
+            opt_state=opt_state,
+        )
+        return (
+            AsyncState(inner=new_inner, batch=batch_t, views=views_t,
+                       bundle_res=res_t),
+            mets,
+        )
+
+    def _astate_spec(self, state_spec):
         views_spec, res_spec, prev_batch_spec = self._pending_specs()
-        astate_spec = AsyncState(
+        return AsyncState(
             inner=state_spec, batch=prev_batch_spec, views=views_spec,
             bundle_res=res_spec,
         )
+
+    def _async_impl(self, astate: AsyncState, batch_t, lr):
+        state_spec, batch_spec = self._specs_for(astate.inner, batch_t)
+        astate_spec = self._astate_spec(state_spec)
         out_metric_spec = {"loss": P(), "accuracy": P()}
 
         @partial(
@@ -156,66 +237,33 @@ class AsyncShardedTrainer(ShardedTrainer):
             check_vma=False,
         )
         def run(astate, batch_t, lr):
-            state = astate.inner
-            step = state.step
-            views = astate.views
-            prev_batch = astate.batch
-
-            # (1) dense fwd/bwd on the STALE embeddings (batch t-1)
-            embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
-
-            def loss_fn(dense, embs):
-                inputs = self._build_inputs(embs, views, prev_batch)
-                out = self.model.apply(dense, inputs, train=True)
-                loss, out = self._loss_from_logits(out, prev_batch)
-                return loss, out
-
-            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True
-            )(state.dense, embs)
-            g_dense = jax.lax.pmean(g_dense, self.axis)
-
-            # (2) exchange/lookup for batch t — reads the step-start tables,
-            # no data dependency on (1): XLA overlaps it with the matmuls.
-            tables = {
-                bname: self._squeeze(bname, ts)
-                for bname, ts in state.tables.items()
-            }
-            tables, views_t, res_t = self._lookup_all(
-                tables, batch_t, step, True
-            )
-
-            # (3) stale-apply batch t-1's sparse grads
-            tables = self._apply_all(tables, astate.bundle_res, g_embs, step, lr)
-
-            # (4) dense update
-            updates, opt_state = self.dense_opt.update(
-                g_dense, state.opt_state, state.dense
-            )
-            dense = optax.apply_updates(state.dense, updates)
-
-            mets = {"loss": jax.lax.pmean(loss, self.axis)}
-            if not isinstance(out, dict):
-                probs = jax.nn.sigmoid(out)
-                mets["accuracy"] = jax.lax.pmean(
-                    M.accuracy(probs, prev_batch["label"]), self.axis
-                )
-            else:
-                mets["accuracy"] = jnp.zeros(())
-
-            new_inner = TrainState(
-                step=step + 1,
-                tables={
-                    bname: self._unsqueeze(bname, ts)
-                    for bname, ts in tables.items()
-                },
-                dense=dense,
-                opt_state=opt_state,
-            )
-            return (
-                AsyncState(inner=new_inner, batch=batch_t, views=views_t,
-                           bundle_res=res_t),
-                mets,
-            )
+            return self._async_body(astate, batch_t, lr)
 
         return run(astate, batch_t, lr)
+
+    def _async_steps_impl(self, astate: AsyncState, batches, lr):
+        """K async steps per dispatch: lax.scan of `_async_body` inside one
+        shard_map, threading the pipelined AsyncState (carried batch, views
+        and lookup results of step t-1) through the scan carry — the
+        stale-by-one semantics of every inner step are exactly those of K
+        sequential `train_step_async` calls. Batches carry a leading
+        unsharded [K] axis (`shard_batch(..., stacked=True)`)."""
+        state_spec, _ = self._specs_for(astate.inner, {})
+        astate_spec = self._astate_spec(state_spec)
+        batch_spec = jax.tree.map(lambda _: P(None, self.axis), batches)
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(astate_spec, batch_spec, P()),
+            out_specs=(astate_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(astate, batches, lr):
+            def body(astate, batch_t):
+                return self._async_body(astate, batch_t, lr)
+
+            return jax.lax.scan(body, astate, batches)
+
+        return run(astate, batches, lr)

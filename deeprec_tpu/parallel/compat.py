@@ -1,0 +1,37 @@
+"""shard_map compatibility across jax versions.
+
+jax >= 0.4.35 exposes ``jax.shard_map``; newer versions renamed the
+replication-check flag ``check_rep`` -> ``check_vma``. Callers here write
+the modern spelling (``check_vma=``); this wrapper translates to whatever
+the resident jax accepts, so the sharded trainers run on both old and new
+runtimes without every call site carrying a try/except.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# Which spelling of the replication-check flag does this jax accept?
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+if "check_vma" in _PARAMS:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _PARAMS:
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover - flag dropped entirely
+    _CHECK_KW = None
+
+
+def shard_map(f, **kw):
+    """``jax.shard_map`` accepting either check_vma= or check_rep=."""
+    for alias in ("check_vma", "check_rep"):
+        if alias in kw and alias != _CHECK_KW:
+            val = kw.pop(alias)
+            if _CHECK_KW is not None:
+                kw.setdefault(_CHECK_KW, val)
+    return _shard_map(f, **kw)

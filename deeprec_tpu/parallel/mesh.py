@@ -58,14 +58,19 @@ def put_tiled_global(local: "np.ndarray", lead: tuple, sharding: NamedSharding):
     return jax.device_put(stacked, sharding)
 
 
-def shard_batch(mesh: Mesh, batch: dict, axis: str = "data") -> dict:
+def shard_batch(mesh: Mesh, batch: dict, axis: str = "data",
+                stacked: bool = False) -> dict:
     """Place a host batch with batch-dim sharding over the mesh.
+
+    stacked=True places a K-stacked batch pytree (leading [K, ...] axis,
+    `training.stack_batches`) for `train_steps`: the K axis stays
+    unsharded, the batch axis (dim 1) splits over the mesh.
 
     Multi-host aware: when the mesh spans processes (jax.distributed
     initialized), each process passes its LOCAL slice of the batch — sized
     B_global * local_devices / global_devices — and the global array is
     assembled across hosts (data stays put; no DCN transfer)."""
-    sharding = NamedSharding(mesh, P(axis))
+    sharding = NamedSharding(mesh, P(None, axis) if stacked else P(axis))
     if jax.process_count() > 1:
         return {
             k: jax.make_array_from_process_local_data(sharding, np.asarray(v))

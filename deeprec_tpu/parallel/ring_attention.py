@@ -86,10 +86,7 @@ def ring_attention_sharded(
     sharded, output sequence-sharded."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from deeprec_tpu.parallel.compat import shard_map
 
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
     seq = P(None, None, axis, None)

@@ -27,10 +27,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deeprec_tpu.parallel.compat import shard_map
 
 from deeprec_tpu import features as fcol
 from deeprec_tpu.embedding.table import EmbeddingTable
@@ -44,6 +41,7 @@ from deeprec_tpu.training.trainer import (
     TrainState,
     _prep_ids,
     build_bundles,
+    stack_batches,
 )
 
 
@@ -85,6 +83,7 @@ class ShardedTrainer(Trainer):
         }
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
         self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)
+        self._train_steps = jax.jit(self._sharded_steps, donate_argnums=0)
         self._eval_step = jax.jit(self._sharded_eval)
 
     def _stage_put(self, batch):
@@ -248,6 +247,33 @@ class ShardedTrainer(Trainer):
             mets["accuracy"] = jnp.zeros(())
         return tables, g_dense, mets
 
+    def _sharded_body(self, state: TrainState, batch, lr):
+        """One full train step on per-shard values (runs INSIDE shard_map):
+        squeeze the shard axis off the tables, micro-step, dense update,
+        re-wrap. Shared by the single-step path and the K-step scan."""
+        step = state.step
+        tables = {
+            bname: self._squeeze(bname, ts)
+            for bname, ts in state.tables.items()
+        }
+        tables, g_dense, mets = self._sharded_micro(
+            tables, state.dense, batch, step, lr
+        )
+        updates, opt_state = self.dense_opt.update(
+            g_dense, state.opt_state, state.dense
+        )
+        dense = optax.apply_updates(state.dense, updates)
+        new_state = TrainState(
+            step=step + 1,
+            tables={
+                bname: self._unsqueeze(bname, ts)
+                for bname, ts in tables.items()
+            },
+            dense=dense,
+            opt_state=opt_state,
+        )
+        return new_state, mets
+
     def _sharded_step(self, state: TrainState, batch, lr):
         state_spec, batch_spec = self._specs_for(state, batch)
         out_metric_spec = {"loss": P(), "accuracy": P()}
@@ -260,30 +286,35 @@ class ShardedTrainer(Trainer):
             check_vma=False,
         )
         def run(state, batch, lr):
-            step = state.step
-            tables = {
-                bname: self._squeeze(bname, ts)
-                for bname, ts in state.tables.items()
-            }
-            tables, g_dense, mets = self._sharded_micro(
-                tables, state.dense, batch, step, lr
-            )
-            updates, opt_state = self.dense_opt.update(
-                g_dense, state.opt_state, state.dense
-            )
-            dense = optax.apply_updates(state.dense, updates)
-            new_state = TrainState(
-                step=step + 1,
-                tables={
-                    bname: self._unsqueeze(bname, ts)
-                    for bname, ts in tables.items()
-                },
-                dense=dense,
-                opt_state=opt_state,
-            )
-            return new_state, mets
+            return self._sharded_body(state, batch, lr)
 
         return run(state, batch, lr)
+
+    def _sharded_steps(self, state: TrainState, batches, lr):
+        """K-step device loop (Trainer._steps_impl mirror): one shard_map
+        whose body scans `_sharded_body` over the K-stacked batch — the
+        a2a/allgather exchange of every inner step stays inside the single
+        compiled program, so K steps cost one host dispatch. Batch leaves
+        are [K, B, ...] with the K axis unsharded and the batch axis split
+        over the mesh (`shard_batch(..., stacked=True)`)."""
+        state_spec, _ = self._specs_for(state, {})
+        batch_spec = jax.tree.map(lambda _: P(None, self.axis), batches)
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec, P()),
+            out_specs=(state_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(state, batches, lr):
+            def body(state, batch):
+                return self._sharded_body(state, batch, lr)
+
+            return jax.lax.scan(body, state, batches)
+
+        return run(state, batches, lr)
 
     def _sharded_accum(self, state: TrainState, batch, lr):
         """Micro-batched sharded step: batch leaves [A, B_local*N, ...] — the
@@ -334,6 +365,20 @@ class ShardedTrainer(Trainer):
             return new_state, jax.tree.map(jnp.mean, mets)
 
         return run(state, batch, lr)
+
+    def train_steps(self, state: TrainState, batches, lr=None):
+        """K steps per dispatch on the mesh. A list/tuple of batch dicts is
+        stacked and placed with the K axis unsharded and the batch axis
+        split (P(None, axis)); pass a pre-placed stacked pytree
+        (`shard_batch(..., stacked=True)`) to skip the host round-trip."""
+        if isinstance(batches, (list, tuple)):
+            from deeprec_tpu.parallel.mesh import shard_batch
+
+            batches = shard_batch(
+                self.mesh, stack_batches(batches), axis=self.axis,
+                stacked=True,
+            )
+        return super().train_steps(state, batches, lr)
 
     def _sharded_eval(self, state: TrainState, batch):
         state_spec, batch_spec = self._specs_for(state, batch)

@@ -1,4 +1,9 @@
-from deeprec_tpu.training.trainer import ModelInputs, Trainer, TrainState
+from deeprec_tpu.training.trainer import (
+    ModelInputs,
+    Trainer,
+    TrainState,
+    stack_batches,
+)
 from deeprec_tpu.training.metrics import (
     AucState,
     accuracy,

@@ -118,6 +118,17 @@ def _prep_ids(ids):
     return ids[:, None] if ids.ndim == 1 else ids
 
 
+def stack_batches(batches):
+    """Stack K same-shape batch dicts into one pytree with a leading
+    [K, ...] axis — the input layout of `Trainer.train_steps`. Host-side;
+    for ShardedTrainer place the result with `shard_batch(..., stacked=True)`
+    so the K axis stays unsharded and the batch axis splits over the mesh."""
+    batches = list(batches)
+    if len(batches) == 1:
+        return jax.tree.map(lambda x: jnp.asarray(x)[None], batches[0])
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+
 # Module-level so repeated evaluate() calls hit one compile cache.
 _jit_auc_update = jax.jit(M.auc_update)
 
@@ -148,6 +159,10 @@ class Trainer:
         self.bundles = build_bundles(model.features)
         self._train_step = jax.jit(self._step_impl, donate_argnums=0)
         self._train_step_accum = jax.jit(self._accum_impl, donate_argnums=0)
+        # K-step device loop: jit caches one executable per K (the stacked
+        # batch's leading dim is part of the trace signature), so sweeping
+        # or changing K recompiles once per value and then amortizes.
+        self._train_steps = jax.jit(self._steps_impl, donate_argnums=0)
         self._eval_step = jax.jit(self._eval_impl)
 
     # Back-compat/introspection: table object + state accessor per table name.
@@ -354,6 +369,24 @@ class Trainer:
             step=step + 1, tables=tables, dense=dense, opt_state=opt_state
         ), jax.tree.map(jnp.mean, mets)
 
+    def _steps_impl(self, state: TrainState, batches, lr):
+        """Multi-step device loop — K full train steps per dispatch.
+
+        DeepRec amortizes per-step host overhead with graph-level pipeline
+        stages (Stage/SmartStage); in the functional world the same cure is
+        a `lax.scan` over K steps inside ONE compiled program: the host
+        dispatches once per K steps instead of once per step, which is the
+        lever when the step is dispatch-overhead-bound (docs/perf.md). The
+        scan threads the FULL TrainState — dense params, optimizer state
+        and every hash-table TableState — so insertion, eviction counters,
+        frequency/admission and version stamping behave exactly as K
+        sequential `train_step` calls (tests/test_train_steps.py pins the
+        equivalence, exact on table ints)."""
+        def body(state, batch):
+            return self._step_impl(state, batch, lr)
+
+        return jax.lax.scan(body, state, batches)
+
     def forward_views(self, state: TrainState, batch):
         """Readonly lookup pass (no inserts/counters): per-feature views
         plus per-bundle results. Shared by eval and the serving predictor."""
@@ -429,6 +462,28 @@ class Trainer:
         # lr always rides as a traced scalar so schedules never recompile.
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
         return self._train_step(state, batch, lr)
+
+    def train_steps(self, state: TrainState, batches,
+                    lr: Optional[float] = None):
+        """Run K train steps in ONE device dispatch (`lax.scan`).
+
+        `batches` is either a list/tuple of K same-shape batch dicts
+        (stacked on the spot via `stack_batches`) or an already-stacked
+        pytree with a leading [K, ...] axis — pre-stack and `device_put`
+        it when the transfer should overlap compute. Returns
+        (final_state, metrics) with metric leaves stacked [K] (per-step
+        loss/accuracy, so streamed metric accumulation sees every step,
+        same as K `train_step` calls). The input state is donated.
+
+        Semantics are exactly K sequential `train_step` calls — table
+        insertion/admission/eviction counters and the global step advance
+        per inner step. Run checkpoint/eval/maintain() at K-step
+        boundaries (they are host-side and see only the returned state).
+        Compiles once per K; see docs/perf.md for the K-curve."""
+        if isinstance(batches, (list, tuple)):
+            batches = stack_batches(batches)
+        lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
+        return self._train_steps(state, batches, lr)
 
     def train_step_accum(self, state: TrainState, batch, accum_steps: int,
                          lr: Optional[float] = None):

@@ -65,12 +65,14 @@ def run_model(name: str, args) -> dict:
             cwd=os.path.join(ZOO, name),
         )
     except subprocess.TimeoutExpired as e:
-        # one hung model must not abort an hours-long grid
+        # one hung model must not abort an hours-long grid. log_tail is a
+        # LIST of lines on every failure path (auc_protocol.py convention)
+        # so consumers iterate lines, never characters.
         return {
             "model": name, "ok": False, "global_step_per_sec": 0.0,
             "examples_per_sec": 0.0, "auc": None, "auc_tasks": None,
-            "log_tail": "timeout after %ss: %s" % (
-                args.timeout, str(e.stdout or "")[-400:]),
+            "log_tail": ["timeout after %ss" % args.timeout]
+            + str(e.stdout or "")[-400:].splitlines(),
         }
     log = proc.stdout + proc.stderr
     sps = [float(m) for m in STEP_RE.findall(log)]
@@ -95,7 +97,7 @@ def run_model(name: str, args) -> dict:
         "auc_tasks": aucs or None,
     }
     if not out["ok"]:
-        out["log_tail"] = log[-800:]
+        out["log_tail"] = log[-800:].splitlines()
     return out
 
 

@@ -82,10 +82,10 @@ def test_stage_overlaps_io_with_compute():
         pulls[i + 1] < finishes[i] for i in range(5)
     )
     assert overlapped >= 4, (pulls, finishes)
-    # and wall clock beats the serial sum (6*0.04 IO + 6*0.08 compute =
-    # 0.72s): overlapped ≈ 0.04 + 6*0.08 ≈ 0.52s + transform slop
-    wall = finishes[-1] - pulls[0] + 0.04
-    assert wall < 0.68, wall
+    # No wall-clock bound: the ordering assertion above IS the overlap
+    # proof, and a scheduler hiccup on a loaded single-core box pushed a
+    # wall < 0.68s check into flake territory (sleep() only guarantees a
+    # MINIMUM delay).
 
 
 def test_sharded_stage_places_on_mesh():

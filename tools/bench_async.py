@@ -8,6 +8,12 @@ with the matmuls (reference: async_embedding_stage.py). This tool measures
 whether it does on the target hardware.
 
     python tools/bench_async.py [--devices 8] [--batch 4096] [--steps 30]
+                                [--steps-per-dispatch K]
+
+--steps-per-dispatch K > 1 measures the multi-step device loop
+(`train_steps` / `train_steps_async`): K inner steps ride one compiled
+dispatch, so the sync-vs-async comparison is repeated with host dispatch
+overhead amortized K× (docs/perf.md).
 
 On a CPU host-platform mesh the absolute numbers mean little; the TPU run
 is the answer recorded in docs/perf notes.
@@ -29,7 +35,16 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--emb_dim", type=int, default=32)
     p.add_argument("--comm", default="a2a", choices=["a2a", "allgather"])
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="K inner steps per dispatch (lax.scan path)")
     args = p.parse_args(argv)
+    K = args.steps_per_dispatch
+    if K < 1:
+        p.error("--steps-per-dispatch must be >= 1")
+    if args.steps < 1:
+        p.error("--steps must be >= 1")
+    if K > 1:
+        args.steps = max(K, args.steps - args.steps % K)
 
     import jax
     import jax.numpy as jnp
@@ -55,31 +70,59 @@ def main(argv=None):
         for _ in range(8)
     ]
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeprec_tpu.training import stack_batches
+
+    def windows():
+        """[(stacked-or-single batch, steps it advances), ...] per timed
+        pass — K-stacked dispatches when --steps-per-dispatch > 1."""
+        if K <= 1:
+            return [(batches[i % len(batches)], 1) for i in range(args.steps)]
+        sh = NamedSharding(mesh, P(None, "data"))
+        return [
+            (
+                jax.device_put(
+                    stack_batches(
+                        [batches[(d * K + i) % len(batches)] for i in range(K)]
+                    ),
+                    sh,
+                ),
+                K,
+            )
+            for d in range(args.steps // K)
+        ]
+
     def timed(step, state, tag):
-        for i in range(3):
-            state, mets = step(state, batches[i % len(batches)])
+        work = windows()
+        for b, _ in work[: max(1, 3 // K)]:  # warmup: compile + fill
+            state, mets = step(state, b)
         jax.block_until_ready(mets["loss"])
         t0 = time.perf_counter()
-        for i in range(args.steps):
-            state, mets = step(state, batches[i % len(batches)])
+        for b, _ in work:
+            state, mets = step(state, b)
         jax.block_until_ready(mets["loss"])
         dt = (time.perf_counter() - t0) / args.steps
         print(f"{tag:12s} {dt * 1e3:8.2f} ms/step "
-              f"({args.batch / dt:,.0f} ex/s)")
+              f"({args.batch / dt:,.0f} ex/s, K={K})")
         return dt
 
     sync = ShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
                           mesh=mesh, comm=args.comm)
-    dt_sync = timed(sync.train_step, sync.init(0), "sync")
+    dt_sync = timed(
+        sync.train_step if K <= 1 else sync.train_steps, sync.init(0), "sync"
+    )
 
     asy = AsyncShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
                               mesh=mesh, comm=args.comm)
     ast = asy.bootstrap(asy.init(0), batches[0])
-    dt_async = timed(asy.train_step_async, ast, "async")
+    dt_async = timed(
+        asy.train_step_async if K <= 1 else asy.train_steps_async, ast, "async"
+    )
 
     print(f"speedup: {dt_sync / dt_async:.3f}x "
           f"({'async wins' if dt_async < dt_sync else 'sync wins'}, "
-          f"{n} devices, comm={args.comm})")
+          f"{n} devices, comm={args.comm}, steps_per_dispatch={K})")
 
 
 if __name__ == "__main__":
