@@ -17,6 +17,14 @@ is the requested K's best repetition; `steps_per_dispatch` records it.
 `--smoke` (or BENCH_SMOKE=1, used by cibuild) shrinks the sweep and the
 timed windows so CI completes quickly.
 
+Unique budgets: `--unique-budget auto` (default) engages the hash dedup
+engine (ops/dedup.py) — each table's unique fraction is measured during
+pre-fill, folded into an EMA budget, and every downstream op of the lookup/
+apply hot path is sized at the budget instead of the full flattened batch;
+the JSON records the per-table `unique_fraction`/`dedup_overflow` under
+"dedup" plus the run's "unique_budget" mode. `--unique-budget off` keeps the
+legacy full-batch sort-unique for A/B comparison.
+
 The TPU behind the axon tunnel is intermittent, so the harness probes with
 retries across a window (BENCH_PROBE_ATTEMPTS × BENCH_PROBE_TIMEOUT, default
 5 × 120s with 30s between failures, ~13 min worst case) and records probe
@@ -117,20 +125,33 @@ def _run_worker(extra_env, timeout):
 def _measure_k(trainer, batches, B, k, timed_steps, reps):
     """Throughput at k steps/dispatch: identical pre-fill + warmup schedule
     for every k (same batch sequence), then `reps` timed windows. Returns
-    per-k stats; "examples_per_sec" is the best repetition (the tunnel TPU
-    shows ±15% run-to-run noise on identical programs — the fastest window
-    is the least-noisy estimate), mean/min/max expose the spread."""
+    (per-k stats, per-table dedup stats); "examples_per_sec" is the best
+    repetition (the tunnel TPU shows ±15% run-to-run noise on identical
+    programs — the fastest window is the least-noisy estimate),
+    mean/min/max expose the spread."""
     import jax
 
     from deeprec_tpu.training import stack_batches
 
     n = len(batches)
+    # Identical budget state for every k: the trainer's EMA persists across
+    # the K sweep, so without a reset later ks would pre-fill under the
+    # previous k's engaged budget and could land in a different budget
+    # bucket — conflating dispatch amortization with budget differences.
+    trainer._unique_ema.clear()
+    trainer._auto_frac.clear()
+    trainer._make_jits()
     state = trainer.init(0)
     # Pre-fill: populate the table through the single-step path so every k
     # starts timing from the same table occupancy.
     for i in range(16):
         state, mets = trainer.train_step(state, batches[i % n])
     jax.block_until_ready(mets["loss"])
+    if trainer.unique_budget is not None:
+        # Fold the pre-fill's measured unique fractions into the budgets so
+        # the warmed/timed windows run the hash dedup engine at-budget
+        # (docs/perf.md); the one recompile lands in the warmup window.
+        state, _ = trainer.update_budgets(state)
 
     steps_k = max(k, timed_steps - timed_steps % k)
     ndisp = steps_k // k
@@ -169,7 +190,7 @@ def _measure_k(trainer, batches, B, k, timed_steps, reps):
         "ms_per_step": round(min(times) / steps_k * 1e3, 3),
         "timed_steps": steps_k,
         "reps": reps,
-    }
+    }, trainer.dedup_stats(state)
 
 
 def workload():
@@ -194,8 +215,17 @@ def workload():
         ks = sorted({ks[0], ks[-1]})  # endpoints only: fast CI green
 
     B = 2048
+    # Hash dedup engine (ops/dedup.py): "auto" (default) measures each
+    # table's unique fraction during pre-fill and sizes every downstream op
+    # at the derived budget; an int fixes the budget; "off" keeps the
+    # legacy full-batch sort-unique.
+    budget_mode = os.environ.get("BENCH_UNIQUE_BUDGET", "auto")
+    unique_budget = (
+        None if budget_mode == "off"
+        else ("auto" if budget_mode == "auto" else int(budget_mode))
+    )
     model = DLRM(emb_dim=16, capacity=1 << 20)
-    trainer = Trainer(model, Adagrad(lr=0.05))
+    trainer = Trainer(model, Adagrad(lr=0.05), unique_budget=unique_budget)
 
     gen = SyntheticCriteo(batch_size=B, vocab=1_000_000, seed=0)
     # Pre-generate host batches so input generation isn't measured.
@@ -204,8 +234,11 @@ def workload():
     ]
 
     k_curve = {}
+    dedup_stats = {}
     for k in ks:
-        k_curve[str(k)] = _measure_k(trainer, batches, B, k, timed_steps, reps)
+        k_curve[str(k)], dedup_stats = _measure_k(
+            trainer, batches, B, k, timed_steps, reps
+        )
 
     head = k_curve[str(K)]
     ex_per_sec = head["examples_per_sec"]
@@ -240,6 +273,11 @@ def workload():
                 "device": jax.devices()[0].platform,
                 "backend": jax.default_backend(),
                 "layout": "packed_x%d" % pack if pack > 1 else "unpacked",
+                # Dedup engine telemetry: per-table measured unique fraction
+                # + budget-overflowed ids from the timed windows, and the
+                # budget mode the run used (comparability across rounds).
+                "unique_budget": budget_mode,
+                "dedup": dedup_stats,
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
                     "bf16_pair": _fl.AUTO_TRUSTS_BF16_PAIR,
@@ -264,13 +302,25 @@ def main():
                    help="training steps per timed repetition")
     p.add_argument("--smoke", action="store_true",
                    help="fast CI path: endpoints-only K sweep, short windows")
+    p.add_argument("--unique-budget",
+                   default=os.environ.get("BENCH_UNIQUE_BUDGET", "auto"),
+                   help="hash dedup unique budget: 'auto' (measured EMA, "
+                        "default), an int (fixed ids per lookup), or 'off' "
+                        "(legacy full-batch sort-unique)")
     args = p.parse_args()
     if args.steps_per_dispatch < 1:
         p.error("--steps-per-dispatch must be >= 1")
+    if args.unique_budget not in ("auto", "off"):
+        try:
+            if int(args.unique_budget) <= 0:
+                raise ValueError
+        except ValueError:
+            p.error("--unique-budget must be 'auto', 'off' or a positive int")
     # The measured workload runs in a subprocess; parameters ride the env.
     os.environ["BENCH_K"] = str(args.steps_per_dispatch)
     os.environ["BENCH_REPS"] = str(args.reps)
     os.environ["BENCH_TIMED_STEPS"] = str(args.timed_steps)
+    os.environ["BENCH_UNIQUE_BUDGET"] = str(args.unique_budget)
     if args.smoke:
         os.environ["BENCH_SMOKE"] = "1"
     if os.environ.get("BENCH_FORCED") == "1":

@@ -30,5 +30,12 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "== dedup engine microbench (CPU smoke: both paths compile) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_dedup.py --smoke
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py
+
+echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
+    BENCH_TIMED_STEPS=4 BENCH_K=4 python bench.py --unique-budget off

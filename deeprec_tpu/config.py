@@ -234,6 +234,21 @@ class EmbeddingVariableOption:
             raise ValueError("at most one admission filter per table")
 
 
+def validate_unique_budget(ub, where: str) -> None:
+    """Shared grammar check for the unique-budget knob — one definition
+    for TableConfig and SparseFeature so the accepted forms can never
+    diverge: None | "auto" | "off" | positive int."""
+    if not (
+        ub is None
+        or ub in ("auto", "off")
+        or (isinstance(ub, int) and not isinstance(ub, bool) and ub > 0)
+    ):
+        raise ValueError(
+            f"{where}: unique_budget must be None, 'auto', 'off' or a "
+            f"positive int, got {ub!r}"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class TableConfig:
     """Static configuration of one hash-embedding table.
@@ -268,6 +283,21 @@ class TableConfig:
     # vs r03), so "auto" resolves to unpacked there. "on"/"off" force it
     # either way (tests exercise the packed path on CPU via "on").
     packed: str = "auto"  # auto | on | off
+    # Unique-budget for the hash dedup engine (ops/dedup.py): per lookup,
+    # ids dedup to at most `unique_budget` uniques and EVERY downstream op
+    # (probe, gather, freq/version scatters, init, backward segment-sum,
+    # the sharded a2a/allgather payload) is sized at the budget instead of
+    # the full flattened batch. Ids past the budget serve the
+    # admission-blocked default for that step and count in the table's
+    # `dedup_overflow` (the a2a_overflow contract).
+    #   int    — fixed budget (real unique ids per lookup)
+    #   "auto" — trainer-derived: capacity-clamped slack over an EMA of
+    #            measured unique fractions (Trainer.update_budgets /
+    #            maintain()); until the first measurement the lookup runs
+    #            at U = N and seeds the EMA counters
+    #   None   — legacy U = N sort-unique (logged once per table so the
+    #            waste is visible); "off" the same, silently.
+    unique_budget: Optional[object] = None  # None | "off" | "auto" | int
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
     def __post_init__(self):
@@ -279,6 +309,7 @@ class TableConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.packed not in ("auto", "on", "off"):
             raise ValueError(f"unknown packed mode {self.packed!r}")
+        validate_unique_budget(self.unique_budget, f"table {self.name}")
 
 
 @dataclasses.dataclass(frozen=True)

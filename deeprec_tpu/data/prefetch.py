@@ -40,6 +40,18 @@ class Prefetcher:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Enqueue with a timed put that re-checks the stop flag, so a full
+        queue can never strand the worker after close() (a plain q.put
+        blocks forever once the consumer is gone). True = delivered."""
+        while not self._stop.is_set():
+            try:
+                self.q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _run(self):
         try:
             for batch in self.source:
@@ -47,11 +59,16 @@ class Prefetcher:
                     return
                 # device_put returns immediately; the transfer overlaps the
                 # consumer's compute.
-                self.q.put(self.transform(batch))
-            self.q.put(None)
+                if not self._put(self.transform(batch)):
+                    return
+            self._put(None)
         except Exception as e:  # surface reader errors to the consumer
-            self.q.put(e)
-            self.q.put(None)  # terminate iteration if the consumer continues
+            # Only chase the exception with the end-of-stream marker if the
+            # exception itself was delivered — unconditionally enqueueing
+            # both could block on a full queue (and double-signal a
+            # consumer that already stopped reading).
+            if self._put(e):
+                self._put(None)
 
     def __iter__(self):
         return self
@@ -65,7 +82,18 @@ class Prefetcher:
         return item
 
     def close(self):
+        """Stop the worker and release anything blocked: sets the stop flag
+        (the worker's timed put observes it within its timeout), drains the
+        queue so an in-flight put can land, and joins the thread."""
         self._stop.set()
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=2.0)
+        # A put that raced the drain may have landed afterwards; clear it
+        # so close() leaves nothing referencing device buffers.
         try:
             while True:
                 self.q.get_nowait()

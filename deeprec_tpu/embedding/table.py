@@ -31,6 +31,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from flax import struct
 
 from deeprec_tpu.config import TableConfig
@@ -81,6 +82,23 @@ class TableState:
     # a2a_slack, NOT capacity — kept separate from insert_fails). Transient;
     # not checkpointed, resets on rebuild.
     a2a_overflow: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
+    # Dedup-engine telemetry (ops/dedup.py), train lookups only. Same
+    # transient contract as the counters above: int32 scalars accumulating
+    # inside the K-step scan, not checkpointed, reset on rebuild and by
+    # Trainer.update_budgets (which folds them into the auto-budget EMA).
+    #   dedup_overflow — distinct ids compacted out past the unique budget
+    #                    (served the blocked default that step)
+    #   dedup_unique   — accumulated budgeted unique ids seen
+    #   dedup_ids      — accumulated non-pad id positions those covered
+    dedup_overflow: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
+    dedup_unique: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
+    dedup_ids: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((), jnp.int32)
     )
 
@@ -333,6 +351,24 @@ class EmbeddingTable:
 
     # ----------------------------------------------------------------- lookup
 
+    def default_unique_size(self, n: int) -> Optional[int]:
+        """Resolve cfg.unique_budget for an n-position flattened TRAIN
+        lookup: the uids-array size for the hash dedup engine, or None for
+        the legacy U = N sort-unique (logged once per table so the waste
+        is visible — None/"auto" configs; "off" stays silent). Trainers
+        override this resolution with their own (EMA-driven) budgets.
+        Eval/serving lookups never budget by default: resident keys must
+        read exactly, and read-only state makes overflow invisible to the
+        counters (callers may still force a size explicitly)."""
+        from deeprec_tpu.ops import dedup
+
+        ub = self.cfg.unique_budget
+        if isinstance(ub, int) and not isinstance(ub, bool):
+            return dedup.resolve_size(ub, n)
+        if ub != "off":  # None or "auto": visible fallback
+            dedup.log_full_fallback(self.cfg.name, n)
+        return None
+
     def lookup_unique(
         self,
         state: TableState,
@@ -343,6 +379,10 @@ class EmbeddingTable:
         pad_value: int = -1,
         unique_size: Optional[int] = None,
     ) -> Tuple[TableState, UniqueLookup]:
+        if unique_size is None and train:
+            unique_size = self.default_unique_size(
+                int(_np.prod(ids.shape)) if ids.ndim else 1
+            )
         return _lookup_unique_jit(
             self, state, ids, jnp.asarray(step, jnp.int32), train, pad_value,
             unique_size,
@@ -365,25 +405,49 @@ class EmbeddingTable:
         are inserted, frequencies incremented and versions stamped — the
         combined semantics of KvResourceGather + the freq/version bookkeeping
         DeepRec does inside EmbeddingVar::GetEmbeddings/LookupOrCreateKey.
+
+        Dedup routing: `unique_size=None` keeps the legacy sort-based
+        `jnp.unique` at U = N; a concrete `unique_size` engages the O(N)
+        hash dedup engine (ops/dedup.py) at that static budget — every
+        downstream op then runs at U instead of N, ids past the budget
+        serve the blocked default and count into `dedup_overflow`.
         """
+        from deeprec_tpu.ops import dedup
+
         cfg = self.cfg
         flat = ids.reshape(-1)
         N = flat.shape[0]
-        U = unique_size or N
         sentinel = jnp.asarray(empty_key(cfg), flat.dtype)
         # Collapse padding onto the sentinel so it dedups to one fill entry.
         flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
-        uids, inverse, counts = jnp.unique(
-            flat, size=U, fill_value=sentinel, return_inverse=True, return_counts=True
-        )
+        if unique_size is None:
+            uids, inverse, counts = dedup.sort_unique(
+                flat, N, sentinel=empty_key(cfg)
+            )
+            overflow = None
+        else:
+            uids, inverse, counts, overflow = dedup.hash_dedup(
+                flat, unique_size, sentinel=empty_key(cfg)
+            )
         inverse = inverse.reshape(ids.shape)  # position -> unique, in id layout
         valid = uids != sentinel
-        # Padding contributes no counts.
-        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
 
         state, res = self._lookup_resolved(
             state, uids, counts, valid, step=step, train=train, salt=salt
         )
+        if train:
+            # Seed the auto-budget EMA (Trainer.update_budgets) on every
+            # path; the overflow counter only moves under a budget.
+            state = state.replace(
+                dedup_unique=state.dedup_unique
+                + jnp.sum(valid).astype(jnp.int32),
+                dedup_ids=state.dedup_ids + jnp.sum(counts),
+                dedup_overflow=(
+                    state.dedup_overflow + overflow
+                    if overflow is not None
+                    else state.dedup_overflow
+                ),
+            )
         return state, dataclasses.replace(res, inverse=inverse)
 
     def _lookup_resolved(

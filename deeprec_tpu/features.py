@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from deeprec_tpu.config import TableConfig
+from deeprec_tpu.config import TableConfig, validate_unique_budget
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +31,11 @@ class SparseFeature:
              fused GroupEmbedding lookups only when their id shapes match;
              set distinct max_len values to keep differently-shaped features
              in separate groups.
+    unique_budget: per-feature override of TableConfig.unique_budget (the
+             hash-dedup unique budget, ops/dedup.py): int fixed budget,
+             "auto" trainer-derived, "off" to force the legacy U=N path,
+             None (default) to inherit the table's setting. Features
+             sharing a bundle resolve to the largest member budget.
     """
 
     name: str
@@ -39,12 +44,14 @@ class SparseFeature:
     pad_value: int = -1
     shared_table: Optional[str] = None
     max_len: Optional[int] = None
+    unique_budget: Optional[object] = None  # None | "off" | "auto" | int
 
     def __post_init__(self):
         if (self.table is None) == (self.shared_table is None):
             raise ValueError(
                 f"{self.name}: exactly one of table/shared_table must be set"
             )
+        validate_unique_budget(self.unique_budget, f"feature {self.name}")
 
 
 @dataclasses.dataclass(frozen=True)

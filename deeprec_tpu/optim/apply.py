@@ -4,6 +4,13 @@ Pipeline (mirrors DeepRec's backward path, SURVEY.md §3.1): autodiff produces
 gradients w.r.t. the *unique* gathered embeddings; this module gathers the
 matching value/slot rows, runs the optimizer row-function, masks out invalid /
 filter-blocked keys, and scatters everything back. One fused pass over [U, D].
+
+U is whatever the dedup produced: the full flattened batch on the legacy
+path, or the static unique BUDGET under the hash dedup engine
+(ops/dedup.py) — the whole gather->update->scatter pass shrinks with it.
+Budget-overflowed ids never reach here as rows: their positions point at
+the reserved sentinel entry (uids[0], valid=False), which the `ok` mask
+below drops exactly like a filter-blocked key.
 """
 from __future__ import annotations
 

@@ -66,8 +66,8 @@ class AsyncShardedTrainer(ShardedTrainer):
     those rebuild tables and invalidate the carried slot indices.
     """
 
-    def __init__(self, *args, **kw):
-        super().__init__(*args, **kw)
+    def _make_jits(self):
+        super()._make_jits()
         self._bootstrap_jit = jax.jit(self._bootstrap_impl)
         self._async_step = jax.jit(self._async_impl, donate_argnums=0)
         self._async_steps = jax.jit(self._async_steps_impl, donate_argnums=0)

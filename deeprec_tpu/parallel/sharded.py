@@ -97,36 +97,64 @@ class ShardedTable:
         train: bool = True,
         pad_value: int = -1,
         salt=None,
+        unique_size: Optional[int] = None,
     ) -> Tuple[TableState, ShardedLookup]:
+        """`unique_size` (static) engages the hash dedup engine at that
+        budget BEFORE the exchange: the all_gather/all2all id payload, the
+        owner-side work and the embedding return all shrink by the same
+        U/N factor. None keeps the legacy sort-unique at U = N."""
         if self.comm == "a2a":
             return self._lookup_a2a(
                 state, ids, step=step, train=train, pad_value=pad_value,
-                salt=salt,
+                salt=salt, unique_size=unique_size,
             )
         return self._lookup_allgather(
-            state, ids, step=step, train=train, pad_value=pad_value, salt=salt
+            state, ids, step=step, train=train, pad_value=pad_value,
+            salt=salt, unique_size=unique_size,
         )
 
     # ------------------------------------------------------- shared helpers
 
-    def _local_unique(self, ids, pad_value):
-        """Flatten + pad-collapse + dedup the local batch (both paths)."""
-        sentinel = jnp.asarray(empty_key(self.table.cfg), ids.dtype)
-        flat = ids.reshape(-1)
-        U = flat.shape[0]
-        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
-        uids, inverse, counts = jnp.unique(
-            flat, size=U, fill_value=sentinel, return_inverse=True,
-            return_counts=True,
-        )
-        valid = uids != sentinel
-        counts = jnp.where(valid, counts, 0).astype(jnp.int32)
-        return sentinel, uids, inverse.reshape(ids.shape), counts, valid
+    def _local_unique(self, ids, pad_value, unique_size=None):
+        """Flatten + pad-collapse + dedup the local batch (both paths).
+        Returns (sentinel, uids, inverse, counts, valid, overflow) —
+        overflow is None on the legacy path, a scalar int32 under a
+        budget (ids past it serve the default this step)."""
+        from deeprec_tpu.ops import dedup
 
-    def _owner_dedup(self, g_ids, g_counts, include, sentinel):
+        sent_py = empty_key(self.table.cfg)
+        sentinel = jnp.asarray(sent_py, ids.dtype)
+        flat = ids.reshape(-1)
+        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
+        if unique_size is None:
+            uids, inverse, counts = dedup.sort_unique(
+                flat, flat.shape[0], sentinel=sent_py
+            )
+            overflow = None
+        else:
+            uids, inverse, counts, overflow = dedup.hash_dedup(
+                flat, unique_size, sentinel=sent_py
+            )
+        valid = uids != sentinel
+        return sentinel, uids, inverse.reshape(ids.shape), counts, valid, overflow
+
+    def _owner_dedup(self, g_ids, g_counts, include, sentinel,
+                     budgeted: bool = False):
         """Dedup exchanged ids on the owner side (the same id may arrive from
-        many peers) and segment-sum their counts."""
+        many peers) and segment-sum their counts. Under a budget the dedup
+        is the sort-free hash engine sized to hold every exchanged id (a
+        few pad slots over G), so the owner side never overflows."""
         G = g_ids.shape[0]
+        if budgeted:
+            from deeprec_tpu.ops import dedup
+
+            o_uids, o_inverse, o_counts, _ = dedup.hash_dedup(
+                jnp.where(include, g_ids, sentinel),
+                dedup.resolve_size(G, G),
+                sentinel=empty_key(self.table.cfg),
+                weights=jnp.where(include, g_counts, 0),
+            )
+            return o_uids, o_inverse, o_counts, o_uids != sentinel
         o_uids, o_inverse, _ = jnp.unique(
             jnp.where(include, g_ids, sentinel), size=G, fill_value=sentinel,
             return_inverse=True, return_counts=True,
@@ -139,25 +167,44 @@ class ShardedTable:
         )
         return o_uids, o_inverse, jnp.where(o_valid, o_counts, 0), o_valid
 
+    def _count_dedup(self, state, counts, valid, overflow, train):
+        """Accumulate the dedup telemetry counters on the local shard's
+        state (mirrors EmbeddingTable._lookup_unique_impl)."""
+        if not train:
+            return state
+        return state.replace(
+            dedup_unique=state.dedup_unique + jnp.sum(valid).astype(jnp.int32),
+            dedup_ids=state.dedup_ids + jnp.sum(counts),
+            dedup_overflow=(
+                state.dedup_overflow + overflow
+                if overflow is not None
+                else state.dedup_overflow
+            ),
+        )
+
     def _lookup_allgather(
-        self, state, ids, *, step, train, pad_value, salt
+        self, state, ids, *, step, train, pad_value, salt, unique_size=None
     ) -> Tuple[TableState, ShardedLookup]:
         N = self.num_shards
         axis = self.axis
-        sentinel, uids, inverse, counts, valid = self._local_unique(ids, pad_value)
+        sentinel, uids, inverse, counts, valid, loc_ovf = self._local_unique(
+            ids, pad_value, unique_size
+        )
 
-        # Exchange unique ids (cheap: ints) so every shard sees all candidates.
+        # Exchange unique ids (cheap: ints) so every shard sees all
+        # candidates — under a budget the gathered G = N·U shrinks with U.
         g_uids = jax.lax.all_gather(uids, axis, tiled=True)  # [G]
         g_counts = jax.lax.all_gather(counts, axis, tiled=True)  # [G]
         me = jax.lax.axis_index(axis)
         owned = (hashing.hash_shard(g_uids, N) == me) & (g_uids != sentinel)
         o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
-            g_uids, g_counts, owned, sentinel
+            g_uids, g_counts, owned, sentinel, budgeted=unique_size is not None
         )
 
         state, res = self.table._lookup_resolved(
             state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
         )
+        state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
         # Back to gathered layout; non-owned rows contribute zero, then one
         # reduce-scatter hands each replica its own unique rows.
@@ -185,12 +232,16 @@ class ShardedTable:
         return max(8, ((per_dest + 7) // 8) * 8)  # pad to VPU-friendly size
 
     def _lookup_a2a(
-        self, state, ids, *, step, train, pad_value, salt
+        self, state, ids, *, step, train, pad_value, salt, unique_size=None
     ) -> Tuple[TableState, ShardedLookup]:
         cfg = self.table.cfg
         N = self.num_shards
         axis = self.axis
-        sentinel, uids, inverse, counts, valid = self._local_unique(ids, pad_value)
+        sentinel, uids, inverse, counts, valid, loc_ovf = self._local_unique(
+            ids, pad_value, unique_size
+        )
+        # Under a budget U shrinks, so the per-destination bucket Bd and
+        # both all2all payloads shrink by the same factor.
         U = uids.shape[0]
 
         # Bucket by owner with a per-destination budget.
@@ -229,12 +280,14 @@ class ShardedTable:
         recv_valid = recv_ids != sentinel
         G2 = N * Bd
         o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
-            recv_ids, recv_counts, recv_valid, sentinel
+            recv_ids, recv_counts, recv_valid, sentinel,
+            budgeted=unique_size is not None,
         )
 
         state, res = self.table._lookup_resolved(
             state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
         )
+        state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
         e_out = res.embeddings[o_inverse].astype(jnp.float32)
         e_out = e_out * recv_valid[:, None].astype(jnp.float32)
@@ -284,8 +337,11 @@ class ShardedTable:
             g_buf.reshape(N, Bd, D), self.axis, split_axis=0, concat_axis=0,
             tiled=True,
         ).reshape(G2, D)
+        # Segment-sum into owner-unique rows AT THE OWNER SIZE (== G2 on
+        # the legacy path; a few pad slots over it under a budget).
+        O = sl.owner_res.uids.shape[0]
         o_grad = (
-            jnp.zeros((G2, D), jnp.float32)
+            jnp.zeros((O, D), jnp.float32)
             .at[sl.o_inverse]
             .add(g_recv * sl.owned[:, None].astype(jnp.float32))
         )
@@ -316,10 +372,12 @@ class ShardedTable:
             )
         g_g = jax.lax.all_gather(
             grad_u.astype(jnp.float32), self.axis, tiled=True
-        )  # [G, D]
+        )  # [G, D] — G = N·U shrinks with the unique budget
         G, D = g_g.shape
+        # Owner-unique rows: size == G legacy, G + pad under a budget.
+        O = sl.owner_res.uids.shape[0]
         o_grad = (
-            jnp.zeros((G, D), jnp.float32)
+            jnp.zeros((O, D), jnp.float32)
             .at[sl.o_inverse]
             .add(g_g * sl.owned[:, None].astype(jnp.float32))
         )

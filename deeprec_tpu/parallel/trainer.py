@@ -66,13 +66,15 @@ class ShardedTrainer(Trainer):
         comm: str = "allgather",  # or "a2a": budgeted all2all (SOK path)
         remat: bool = False,
         a2a_slack: float = 2.0,
+        unique_budget=None,
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
         self.mesh = mesh or make_mesh(axis=axis)
         self.axis = axis
         self.num_shards = self.mesh.devices.size
-        super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat)
+        super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat,
+                         unique_budget=unique_budget)
         # Re-point bundles at per-shard capacities + collective wrappers.
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
@@ -81,6 +83,10 @@ class ShardedTrainer(Trainer):
                                 a2a_slack=a2a_slack)
             for bname, b in self.bundles.items()
         }
+
+    def _make_jits(self):
+        # Called by Trainer.__init__ (before self.sharded exists — jit
+        # wrapping is lazy) and by update_budgets on a budget change.
         self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
         self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)
         self._train_steps = jax.jit(self._sharded_steps, donate_argnums=0)
@@ -166,9 +172,19 @@ class ShardedTrainer(Trainer):
 
     # Per-bundle primitives: the only thing that differs from the base
     # Trainer is that lookup/apply go through the collective ShardedTable.
+    # The unique budget resolves on the LOCAL batch — dedup-at-budget runs
+    # before the exchange, so the a2a payload / allgather return shrink by
+    # the same U/N factor as the compute.
+    def _budget_capacity(self, b):
+        # The bundle's cfg is the PER-SHARD capacity; local-batch uniques
+        # are bounded by the global table (they hash across all shards).
+        return b.table.cfg.capacity * self.num_shards
+
     def _lookup_one(self, b, state, ids, pad, salt, step, train):
+        U = self._budget_for_lookup(b, ids, train)
         return self.sharded[b.name].lookup_unique(
-            state, ids, step=step, train=train, pad_value=pad, salt=salt
+            state, ids, step=step, train=train, pad_value=pad, salt=salt,
+            unique_size=U,
         )
 
     def _apply_one(self, b, state, res, grad, step, lr):

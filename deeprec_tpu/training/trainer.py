@@ -142,6 +142,7 @@ class Trainer:
         grad_averaging: bool = False,
         remat: bool = False,
         stage: str = "auto",
+        unique_budget=None,
     ):
         self.model = model
         self.sparse_opt = sparse_opt
@@ -154,9 +155,30 @@ class Trainer:
         if stage not in ("auto", "off"):
             raise ValueError(f"unknown stage mode {stage!r}")
         self.stage_mode = stage
+        # Trainer-wide unique-budget override (None = per-feature/table
+        # configs decide): "auto" | "off" | int — see ops/dedup.py and
+        # TableConfig.unique_budget. Same grammar check as the configs: an
+        # unvalidated typo would fall through _resolve_budget's else-branch
+        # and silently mean "auto".
+        fcol.validate_unique_budget(unique_budget, "Trainer(unique_budget=)")
+        self.unique_budget = unique_budget
         self.sparse_specs = fcol.sparse_features(model.features)
         self.dense_specs = fcol.dense_features(model.features)
         self.bundles = build_bundles(model.features)
+        self._budget_modes = {
+            bname: self._bundle_budget_mode(b)
+            for bname, b in self.bundles.items()
+        }
+        self._auto_frac: Dict[str, float] = {}  # bundle -> budget fraction
+        self._unique_ema: Dict[str, float] = {}  # bundle -> raw EMA
+        self._make_jits()
+
+    def _make_jits(self):
+        """(Re)wrap the step functions in fresh jit caches. Budget
+        resolution happens at TRACE time, so anything that changes a
+        resolved budget (update_budgets moving an "auto" bucket) must
+        rebuild these — an already-cached executable for the same input
+        avals would silently keep its old unique sizes otherwise."""
         self._train_step = jax.jit(self._step_impl, donate_argnums=0)
         self._train_step_accum = jax.jit(self._accum_impl, donate_argnums=0)
         # K-step device loop: jit caches one executable per K (the stacked
@@ -208,9 +230,77 @@ class Trainer:
     # overrides just these two to swap in the collective path, so the
     # bundling/stacking control flow below exists exactly once.
 
+    # ----------------------------------------------------- unique budgets
+
+    def _bundle_budget_mode(self, b: Bundle):
+        """Effective budget mode for one bundle: the trainer-wide override
+        wins, then feature-level settings (largest int / any "auto"),
+        then the table config. Returns None (legacy), "auto", or int."""
+        mode = self.unique_budget
+        if mode is None:
+            feat = [
+                f.unique_budget for f in b.features
+                if f.unique_budget is not None
+            ]
+            if feat:
+                ints = [m for m in feat if isinstance(m, int)]
+                mode = (
+                    max(ints) if ints
+                    else ("auto" if any(m == "auto" for m in feat) else "off")
+                )
+            else:
+                mode = b.table.cfg.unique_budget
+        return mode  # None (legacy, logged) | "off" (legacy, silent) | "auto" | int
+
+    def _resolve_budget(self, b: Bundle, n: int) -> Optional[int]:
+        """Static uids-array size for an n-position lookup of bundle `b`,
+        or None for the legacy U=N path. "auto" uses the quantized EMA
+        fraction once `update_budgets` has measured one (clamped by the
+        table capacity — more uniques than slots cannot land anyway);
+        before the first measurement it runs at U=N through the hash
+        engine so the counters seed the EMA without a sort."""
+        from deeprec_tpu.ops import dedup
+
+        mode = self._budget_modes.get(b.name)
+        if mode is None or mode == "off":
+            if mode is None:  # "off" is a deliberate choice: stay silent
+                dedup.log_full_fallback(b.name, n)
+            return None
+        if isinstance(mode, int):
+            return dedup.resolve_size(mode, n)
+        frac = self._auto_frac.get(b.name)
+        if frac is None:
+            budget = n
+        else:
+            import math
+
+            budget = min(int(math.ceil(frac * n)), self._budget_capacity(b))
+        return dedup.resolve_size(budget, n)
+
+    def _budget_capacity(self, b: Bundle) -> int:
+        """Upper clamp for the auto budget: a batch cannot hold more
+        RESIDENT uniques than the table has slots. ShardedTrainer overrides
+        with the GLOBAL capacity — its bundle cfg is per-shard, but a local
+        batch's ids hash across every shard."""
+        return b.table.cfg.capacity
+
+    def _budget_for_lookup(self, b: Bundle, ids, train: bool) -> Optional[int]:
+        """Static unique size for one lookup — shared by the local and the
+        sharded `_lookup_one`. Budgets apply to TRAIN lookups only: an
+        eval/serving batch with more uniques than the (train-skew-derived)
+        budget would silently serve defaults for resident keys — and the
+        overflow counter only accumulates on train state, so it would be
+        invisible. Eval runs exact at U = N."""
+        import numpy as np
+
+        if not train:
+            return None
+        return self._resolve_budget(b, int(np.prod(ids.shape)))
+
     def _lookup_one(self, b: Bundle, state, ids, pad, salt, step, train):
+        U = self._budget_for_lookup(b, ids, train)
         return b.table._lookup_unique_impl(
-            state, ids, step, train, pad, None, salt=salt
+            state, ids, step, train, pad, U, salt=salt
         )
 
     def _apply_one(self, b: Bundle, state, res, grad, step, lr):
@@ -567,6 +657,97 @@ class Trainer:
             a.size * a.dtype.itemsize for a in jax.tree.leaves(ts)
         )
 
+    # ------------------------------------------- unique-budget telemetry
+
+    def _bundle_dedup_counters(self, ts):
+        """Host-read (unique, ids, overflow) totals of one bundle's state,
+        summed over every leading axis (grouped tables × shards)."""
+        import numpy as np
+
+        return (
+            int(np.sum(np.asarray(jax.device_get(ts.dedup_unique)))),
+            int(np.sum(np.asarray(jax.device_get(ts.dedup_ids)))),
+            int(np.sum(np.asarray(jax.device_get(ts.dedup_overflow)))),
+        )
+
+    def dedup_stats(self, state: TrainState) -> Dict[str, Dict[str, float]]:
+        """Per-TABLE dedup telemetry since the last counter reset:
+        `unique_fraction` (budgeted uniques + overflow over id positions —
+        the quantity the auto budget tracks) and `dedup_overflow`. Stacked
+        bundles report each member table under its own feature name."""
+        import numpy as np
+
+        out: Dict[str, Dict[str, float]] = {}
+        for bname, b in self.bundles.items():
+            ts = state.tables[bname]
+            for k, f in enumerate(b.features):
+                member = (
+                    jax.tree.map(lambda a: a[k], ts) if b.stacked else ts
+                )
+                uniq, ids, ovf = self._bundle_dedup_counters(member)
+                out[fcol.resolve_table_name(f)] = {
+                    "unique_fraction": (
+                        round((uniq + ovf) / ids, 4) if ids else None
+                    ),
+                    "dedup_overflow": ovf,
+                }
+                if not b.stacked:
+                    break  # shared-table bundles hold one merged counter
+        return out
+
+    def update_budgets(
+        self, state: TrainState, *, slack: float = 1.5, ema: float = 0.5
+    ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
+        """Fold the per-table dedup counters into the auto-budget EMA,
+        derive each "auto" bundle's budget fraction (slack x EMA, rounded
+        UP onto a 1/16 grid so drift inside a bucket never recompiles),
+        and reset the counters. Host-side, call at maintain/log cadence —
+        a changed bucket rebuilds the jitted steps (budgets resolve at
+        trace time; a cached executable would silently keep its old unique
+        sizes) so the next dispatch recompiles once. Returns (new_state,
+        report) with per-bundle unique_fraction / dedup_overflow /
+        unique_budget_fraction."""
+        from deeprec_tpu.ops import dedup
+
+        tables = dict(state.tables)
+        report: Dict[str, Dict[str, float]] = {}
+        changed = False
+        for bname, b in self.bundles.items():
+            ts = tables[bname]
+            uniq, ids, ovf = self._bundle_dedup_counters(ts)
+            rep: Dict[str, float] = {"dedup_overflow": ovf}
+            if ids > 0:
+                # Overflowed ids are uniques the budget refused — count
+                # them so a too-tight budget widens instead of latching.
+                frac = min(1.0, (uniq + ovf) / ids)
+                rep["unique_fraction"] = round(frac, 4)
+                old = self._unique_ema.get(bname)
+                self._unique_ema[bname] = (
+                    frac if old is None else (1.0 - ema) * old + ema * frac
+                )
+                if self._budget_modes.get(bname) == "auto":
+                    new_frac = dedup.auto_budget_fraction(
+                        self._unique_ema[bname], slack=slack
+                    )
+                    changed |= self._auto_frac.get(bname) != new_frac
+                    self._auto_frac[bname] = new_frac
+            if bname in self._auto_frac:
+                rep["unique_budget_fraction"] = self._auto_frac[bname]
+            # Reset via *0 so sharded leaves keep their placement.
+            tables[bname] = ts.replace(
+                dedup_unique=ts.dedup_unique * 0,
+                dedup_ids=ts.dedup_ids * 0,
+                dedup_overflow=ts.dedup_overflow * 0,
+            )
+            report[bname] = rep
+        if changed:
+            self._make_jits()
+        return (
+            TrainState(step=state.step, tables=tables, dense=state.dense,
+                       opt_state=state.opt_state),
+            report,
+        )
+
     def maintain(
         self,
         state: TrainState,
@@ -598,6 +779,9 @@ class Trainer:
         import numpy as np
 
         step = int(state.step) if step is None else int(step)
+        # Dedup telemetry first: fold counters into the auto-budget EMA,
+        # reset them, and carry the per-bundle stats into the report.
+        state, dedup_report = self.update_budgets(state)
         total_bytes = (
             sum(self._state_bytes(ts) for ts in state.tables.values())
             if hbm_budget_bytes
@@ -622,6 +806,7 @@ class Trainer:
             fails_each = [int(m.insert_fails) for m in members]
             fails = sum(fails_each)
             rep = {"occupancy": occ, "insert_fails": fails, "capacity": C}
+            rep.update(dedup_report.get(bname, {}))
             multi_tier = b.table.cfg.ev.storage.storage_type.value in (
                 "hbm_dram", "hbm_dram_ssd"
             )

@@ -8,11 +8,19 @@ without TPU hardware.
 Speed: the default run excludes tests marked ``slow`` (multi-process
 launches, the largest compile grids) so `pytest -q` gives a quick green;
 ``DEEPREC_FULL_TESTS=1`` runs everything (any explicit ``-m`` expression
-also takes over, e.g. ``-m 'slow or not slow'``). XLA results are
-also cached persistently across runs (JAX_COMPILATION_CACHE_DIR, default
-under the system tmpdir) — compile-heavy tests warm up run-over-run.
+also takes over, e.g. ``-m 'slow or not slow'``). The XLA compilation
+cache uses a FRESH per-run directory: reusing one across processes
+(the previous default) made every warm run segfault/abort
+deterministically in ``test_sharded_models::test_din_sharded_matches_local``
+— jax 0.4.37's CPU PJRT client crashes DESERIALIZING the cached
+8-device shard_map executable (compile path fine, reload path fatal;
+reproduced on pre-change code, so it is an upstream serialization bug,
+not a program bug). Within one pytest process the in-memory jit cache
+still dedups compiles, which is where almost all of the win was anyway.
 """
+import atexit
 import os
+import shutil
 import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -21,10 +29,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(tempfile.gettempdir(), "deeprec_jax_cache"),
-)
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    _cache_dir = tempfile.mkdtemp(prefix="deeprec_jax_cache_")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+    # Serialized executables reach tens of MB per run — don't leak them
+    # into the tempdir across CI loops.
+    atexit.register(shutil.rmtree, _cache_dir, True)
 
 import pytest  # noqa: E402
 

@@ -3,6 +3,7 @@
 tests — SURVEY §4)."""
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -271,6 +272,38 @@ def test_prefetcher_propagates_errors():
     next(it)
     with pytest.raises(RuntimeError, match="reader exploded"):
         next(it)
+
+
+def test_prefetcher_close_does_not_strand_worker():
+    """close() on an unconsumed infinite source: the worker's put is timed
+    and re-checks the stop flag, so the thread exits instead of blocking
+    forever on a full queue."""
+
+    def forever():
+        while True:
+            yield {"x": np.zeros(4)}
+
+    pf = Prefetcher(forever(), depth=1, transform=lambda b: b)
+    # let the worker fill the queue and block in its (timed) put
+    time.sleep(0.3)
+    pf.close()
+    pf._thread.join(timeout=2.0)
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_close_after_error_path():
+    """A reader error with no consumer must not strand the worker either
+    (the old code unconditionally enqueued exception + None)."""
+
+    def bad():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom")
+
+    pf = Prefetcher(bad(), depth=1, transform=lambda b: b)
+    time.sleep(0.3)  # batch fills the depth-1 queue; error waits behind it
+    pf.close()
+    pf._thread.join(timeout=2.0)
+    assert not pf._thread.is_alive()
 
 
 def test_file_tail_reader_streams_and_resumes(tmp_path):
