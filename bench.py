@@ -193,6 +193,159 @@ def _measure_k(trainer, batches, B, k, timed_steps, reps):
     }, trainer.dedup_stats(state)
 
 
+def _traffic_report(trainer, budget_mode, dedup_stats):
+    """The traffic-diet artifact: modeled per-step embedding-engine bytes
+    (before vs after the diet, at the measured single-device shape AND the
+    reference sharded DLRM shape) plus MEASURED stablehlo gather/scatter
+    counts of the single-table lookup+apply program, next to the model's
+    expected counts. `tools/roofline.py --assert-traffic <json>` fails when
+    model and measurement drift."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.ops import dedup
+    from deeprec_tpu.ops import traffic as T
+    from deeprec_tpu.optim.apply import apply_gradients, ensure_slots
+
+    # Measured unique fraction (auto budgets) scales the touched rows.
+    fracs = [
+        s["unique_fraction"] for s in dedup_stats.values()
+        if s.get("unique_fraction")
+    ]
+    uf = round(sum(fracs) / len(fracs), 4) if fracs else 1.0
+
+    slot_widths = tuple(
+        w for (shape, _) in trainer.sparse_opt.slot_specs(16).values()
+        for w in shape
+    ) or (0,)
+    shapes = {
+        "measured_1dev": dict(num_shards=1, comm=None),
+        "reference_8dev_allgather": dict(num_shards=8, comm="allgather"),
+    }
+    modeled = {}
+    for name, kw in shapes.items():
+        before = T.dlrm_reference_traffic(
+            diet=False, exchange_dtype="float32", unique_fraction=uf,
+            slot_widths=slot_widths, **kw,
+        )
+        after = T.dlrm_reference_traffic(
+            diet=True, exchange_dtype="bfloat16", unique_fraction=uf,
+            slot_widths=slot_widths, **kw,
+        )
+        modeled[name] = {
+            "before_bytes": round(before["total_bytes"]),
+            "after_bytes": round(after["total_bytes"]),
+            "wire_after_bytes": round(after["wire_bytes"]),
+            "reduction": round(
+                1.0 - after["total_bytes"] / before["total_bytes"], 4
+            ),
+        }
+
+    # Measured op counts: lower the single-table train lookup+apply at a
+    # small static shape (op COUNTS are shape-independent) on both the
+    # diet and the legacy-apply arm.
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.embedding.table import EmbeddingTable
+
+    t = EmbeddingTable(TableConfig(name="_traffic_probe", dim=16,
+                                   capacity=1 << 12))
+    s = ensure_slots(t, t.create(), trainer.sparse_opt)
+    ids = jnp.arange(256, dtype=jnp.int32)
+    budgeted = budget_mode != "off"
+    U = dedup.resolve_size(128, 256) if budgeted else None
+
+    def prog(s, ids, diet):
+        s, res = t._lookup_unique_impl(s, ids, jnp.int32(0), True, -1, U)
+        g = jnp.ones_like(res.embeddings, jnp.float32)
+        return apply_gradients(t, s, trainer.sparse_opt, res, g, step=0,
+                               reuse_rows=diet, stamp_meta=not diet)
+
+    n_slots = sum(1 for n in s.slots if not n.startswith("scalar/"))
+    ops = {}
+    for arm, diet in (("diet", True), ("legacy_apply", False)):
+        txt = jax.jit(
+            lambda s, ids, d=diet: prog(s, ids, d)
+        ).lower(s, ids).as_text()
+        ops[arm] = T.count_stablehlo_ops(txt)
+    return {
+        "unique_fraction": uf,
+        "engine_bytes_per_step": modeled["measured_1dev"]["after_bytes"],
+        "modeled": modeled,
+        "ops_measured": ops,
+        "ops_model": {
+            "diet": T.expected_lookup_apply_ops(
+                diet=True, budgeted=budgeted, n_row_slots=n_slots),
+            "legacy_apply": T.expected_lookup_apply_ops(
+                diet=False, budgeted=budgeted, n_row_slots=n_slots),
+        },
+        "budgeted": budgeted,
+    }
+
+
+def _profile_phases(trainer, batches):
+    """Host-timed per-phase breakdown (training/profiler.py): jitted
+    sub-programs isolate the sparse phases, deltas attribute the rest."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.training.profiler import PhaseProfiler
+
+    state = trainer.init(0)
+    for i in range(4):
+        state, mets = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(mets["loss"])
+
+    # The phase sub-programs DONATE the table pytree (like the step path
+    # does) — without donation the output materializes a full copy of
+    # every table per call and the copy, not the phase, dominates.
+    lookup_jit = jax.jit(
+        lambda tables, b, step: trainer._lookup_all(tables, b, step, True)[0],
+        donate_argnums=0,
+    )
+
+    def sparse(tables, b, step):
+        tables, views, bundle_res = trainer._lookup_all(
+            tables, b, step, True
+        )
+        g = {n: jnp.ones_like(v[0], jnp.float32) for n, v in views.items()}
+        return trainer._apply_all(tables, bundle_res, g, step,
+                                  jnp.float32(trainer.sparse_opt.lr))
+
+    sparse_jit = jax.jit(sparse, donate_argnums=0)
+    prof = PhaseProfiler()
+    b0 = batches[0]
+    # Full-step phase FIRST: the sub-programs below then take over (and
+    # donate) the final state's table buffers.
+    for i in range(8):
+        b = batches[i % len(batches)]
+        with prof.phase("step", block=None):
+            state, mets = trainer.train_step(state, b)
+            jax.block_until_ready(mets["loss"])
+    # Fresh host-round-tripped scalar: train_step donated the state (and
+    # its step buffer) every iteration above.
+    step0 = jnp.asarray(int(state.step), jnp.int32)
+    # compile outside the timed loop; thread the donated tables through
+    tables = lookup_jit(dict(state.tables), b0, step0)
+    tables = sparse_jit(tables, b0, step0)
+    jax.block_until_ready(jax.tree.leaves(tables)[0])
+    for i in range(8):
+        b = batches[i % len(batches)]
+        with prof.phase("lookup"):
+            tables = lookup_jit(tables, b, step0)
+            jax.block_until_ready(jax.tree.leaves(tables)[0])
+        with prof.phase("lookup_plus_apply"):
+            tables = sparse_jit(tables, b, step0)
+            jax.block_until_ready(jax.tree.leaves(tables)[0])
+    rep = prof.phase_report()
+    rep["derived_sparse_apply_ms"] = round(
+        rep["lookup_plus_apply"]["min_ms"] - rep["lookup"]["min_ms"], 3
+    )
+    rep["derived_dense_plus_overhead_ms"] = round(
+        rep["step"]["min_ms"] - rep["lookup_plus_apply"]["min_ms"], 3
+    )
+    return rep
+
+
 def workload():
     """The measured DLRM loop. Runs on whatever platform jax resolves."""
     import jax
@@ -243,6 +396,13 @@ def workload():
     head = k_curve[str(K)]
     ex_per_sec = head["examples_per_sec"]
 
+    traffic = _traffic_report(trainer, budget_mode, dedup_stats)
+    phases = (
+        _profile_phases(trainer, batches)
+        if os.environ.get("BENCH_PROFILE") == "1"
+        else None
+    )
+
     # Record the program actually measured — backend, storage layout, and
     # kernel-trust flags — so round-over-round numbers are comparable (the
     # r03->r04 regression was an unrecorded layout change). The layout is
@@ -278,6 +438,13 @@ def workload():
                 # budget mode the run used (comparability across rounds).
                 "unique_budget": budget_mode,
                 "dedup": dedup_stats,
+                # Traffic-diet artifact: modeled engine bytes/step (before
+                # vs after, measured + reference sharded shapes) and the
+                # MEASURED gather/scatter op counts of the hot path, which
+                # tools/roofline.py --assert-traffic checks against the
+                # model (ops/traffic.py).
+                "traffic": traffic,
+                **({"phases": phases} if phases else {}),
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
                     "bf16_pair": _fl.AUTO_TRUSTS_BF16_PAIR,
@@ -307,6 +474,10 @@ def main():
                    help="hash dedup unique budget: 'auto' (measured EMA, "
                         "default), an int (fixed ids per lookup), or 'off' "
                         "(legacy full-batch sort-unique)")
+    p.add_argument("--profile", action="store_true",
+                   help="add a per-phase step breakdown (lookup / sparse "
+                        "apply / dense+overhead, training/profiler.py) to "
+                        "the JSON")
     args = p.parse_args()
     if args.steps_per_dispatch < 1:
         p.error("--steps-per-dispatch must be >= 1")
@@ -321,6 +492,8 @@ def main():
     os.environ["BENCH_REPS"] = str(args.reps)
     os.environ["BENCH_TIMED_STEPS"] = str(args.timed_steps)
     os.environ["BENCH_UNIQUE_BUDGET"] = str(args.unique_budget)
+    if args.profile:
+        os.environ["BENCH_PROFILE"] = "1"
     if args.smoke:
         os.environ["BENCH_SMOKE"] = "1"
     if os.environ.get("BENCH_FORCED") == "1":

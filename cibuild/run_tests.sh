@@ -33,8 +33,17 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== dedup engine microbench (CPU smoke: both paths compile) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_dedup.py --smoke
 
+echo "== traffic-diet microbench (CPU smoke: diet + legacy-apply arms) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --traffic --smoke
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
-env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py
+env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py \
+    | tee /tmp/deeprec_bench_smoke.out
+tail -n 1 /tmp/deeprec_bench_smoke.out > /tmp/deeprec_bench_smoke.json
+
+echo "== traffic model vs measured op counts (drift fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-traffic /tmp/deeprec_bench_smoke.json
 
 echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \

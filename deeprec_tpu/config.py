@@ -298,6 +298,15 @@ class TableConfig:
     #   None   — legacy U = N sort-unique (logged once per table so the
     #            waste is visible); "off" the same, silently.
     unique_budget: Optional[object] = None  # None | "off" | "auto" | int
+    # Wire format of the sharded TRAIN exchanges (ShardedTable): the value
+    # payload of the allgather/psum_scatter and a2a embedding returns, and
+    # the gradient payload of the backward exchange, are cast to this dtype
+    # on the wire. "bfloat16" (default) halves ICI/collective bytes; the
+    # owner side always accumulates segment-sums in fp32, and EVAL/serving
+    # exchanges always ride exact fp32 regardless of this knob (a read-only
+    # pass must reproduce resident rows exactly). Id payloads are ints and
+    # unaffected.
+    exchange_dtype: str = "bfloat16"  # bfloat16 | float32
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
     def __post_init__(self):
@@ -309,6 +318,11 @@ class TableConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.packed not in ("auto", "on", "off"):
             raise ValueError(f"unknown packed mode {self.packed!r}")
+        if self.exchange_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"table {self.name}: exchange_dtype must be 'bfloat16' or "
+                f"'float32', got {self.exchange_dtype!r}"
+            )
         validate_unique_budget(self.unique_budget, f"table {self.name}")
 
 

@@ -31,10 +31,18 @@ class Prefetcher:
         source: Iterator[Dict[str, np.ndarray]],
         depth: int = 2,
         transform: Optional[Callable] = None,
+        on_consume: Optional[Callable] = None,
     ):
+        """on_consume: invoked (in the CONSUMER thread) each time a batch is
+        delivered from __next__. The ring runs `depth` batches ahead of the
+        train loop, so producer-side positions (a reader's internal index)
+        overstate progress by the in-flight count; stream-position
+        checkpoints must track deliveries, not productions — wire the
+        reader's `mark_consumed` here (CriteoStats, Trainer.stage)."""
         self.source = iter(source)
         self.depth = max(1, depth)
         self.transform = transform or (lambda b: jax.device_put(b))
+        self.on_consume = on_consume
         self.q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -79,6 +87,8 @@ class Prefetcher:
             raise StopIteration
         if isinstance(item, Exception):
             raise item
+        if self.on_consume is not None:
+            self.on_consume()
         return item
 
     def close(self):
@@ -101,6 +111,8 @@ class Prefetcher:
             pass
 
 
-def staged(source, depth: int = 2, transform=None) -> Prefetcher:
+def staged(source, depth: int = 2, transform=None,
+           on_consume=None) -> Prefetcher:
     """tf.staged analog: `for batch in staged(reader): ...`"""
-    return Prefetcher(source, depth=depth, transform=transform)
+    return Prefetcher(source, depth=depth, transform=transform,
+                      on_consume=on_consume)

@@ -211,7 +211,14 @@ class CriteoStats:
             / 2.0 ** 24
         )
         self.dense_weight = 0.25 * _hash_normal(dseed, salt=0xDA7A)
-        self._index = 0
+        self._index = 0  # producer position: next batch batch() will emit
+        # Consumer position: next batch the TRAIN LOOP has yet to receive.
+        # Under a prefetch ring the producer runs `depth` batches ahead, so
+        # checkpointing `_index` would silently skip the in-flight batches
+        # on restore; once a staging layer wires `mark_consumed`, save()
+        # reports this counter instead (exactly-once replay).
+        self._consumed = 0
+        self._consumer_attached = False
         self.intercept = self._calibrate_intercept()
 
     # ------------------------------------------------------------ internals
@@ -286,11 +293,32 @@ class CriteoStats:
         self._index += 1
         return out
 
+    def attach_consumer(self) -> None:
+        """Declare that a staging ring decouples production from
+        consumption (call at WIRING time, before the ring's producer runs
+        ahead): from here on save() reports the consumed position. Without
+        this, a save taken after staging but before the first delivery —
+        e.g. immediately after a restore — would still report the
+        ran-ahead producer index and skip the in-flight batches."""
+        self._consumer_attached = True
+
+    def mark_consumed(self) -> None:
+        """One batch DELIVERED to the train loop (call from the staging
+        layer's consumer side — Prefetcher(on_consume=...))."""
+        self._consumer_attached = True
+        self._consumed += 1
+
     def save(self) -> Dict:
-        return {"index": self._index}
+        # Unstaged iteration (produce == consume) keeps the legacy producer
+        # index so direct batch() users checkpoint exactly as before.
+        return {
+            "index": self._consumed if self._consumer_attached else self._index
+        }
 
     def restore(self, state: Dict) -> None:
         self._index = int(state["index"])
+        self._consumed = int(state["index"])
+        self._consumer_attached = False
 
     def bayes_auc(self, n: int = 500_000) -> float:
         """AUC of the TRUE click probability on a held-out sample — the

@@ -430,8 +430,10 @@ class MultiTierTable:
                         state, dev_ix[refreshed], hv[refreshed]
                     )
                     ix = jnp.asarray(dev_ix[refreshed], jnp.int32)
+                    from deeprec_tpu.embedding.table import META_FREQ
+
                     state = state.replace(
-                        freq=state.freq.at[ix].add(
+                        meta=state.meta.at[META_FREQ, ix].add(
                             jnp.asarray(hf[refreshed], jnp.int32)
                         ),
                     )

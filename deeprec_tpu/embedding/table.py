@@ -57,6 +57,27 @@ def empty_key(cfg: TableConfig) -> int:
     return int(jnp.iinfo(_key_dtype(cfg)).min)
 
 
+# Row indices of the packed per-slot metadata leaf (TableState.meta, [3, C]):
+# freq / version / dirty live in ONE int32 array so the train hot path
+# updates all three with a single fused scatter instead of three. The layout
+# is [3, C] (columns minor) — a [C, 3] layout would lane-pad 3 -> 128 on TPU
+# and waste ~42x HBM; with C minor the array tiles like any other big row.
+META_FREQ = 0
+META_VERSION = 1
+META_DIRTY = 2
+
+# Per-column fill values for a fresh/vacated slot: freq 0, version -1
+# (never touched), dirty 0.
+_META_FILL = (0, -1, 0)
+
+
+def empty_meta(capacity: int) -> jnp.ndarray:
+    """[3, C] metadata array of an empty table."""
+    return jnp.tile(
+        jnp.asarray(_META_FILL, jnp.int32)[:, None], (1, capacity)
+    )
+
+
 @struct.dataclass
 class TableState:
     """Device-resident state of one table (a pytree; donate it through jit).
@@ -72,11 +93,17 @@ class TableState:
 
     keys: jnp.ndarray  # [C] key_dtype, empty slots hold the sentinel
     values: jnp.ndarray  # [C, D] value_dtype
-    freq: jnp.ndarray  # [C] int32 — lookup counter (admission + LFU tiering)
-    version: jnp.ndarray  # [C] int32 — global step of last touch (TTL evict)
+    # [3, C] int32 — fused per-slot metadata, rows META_FREQ / META_VERSION /
+    # META_DIRTY (lookup counter for admission + LFU tiering; global step of
+    # last touch for TTL evict; touched-since-last-incremental-save flag).
+    # One leaf so the train hot path reads and writes all three with a
+    # single gather + a single scatter; the named `freq`/`version`/`dirty`
+    # properties below keep every metadata READER (eviction, filters,
+    # multi-tier, checkpoint, maintain) on the columnar view, and
+    # `replace_meta` is the columnar WRITE entry point for cold paths.
+    meta: jnp.ndarray
     slots: Dict[str, jnp.ndarray]  # optimizer slot arrays, [C, D] or [C, 1]
     bloom: Optional[jnp.ndarray]  # [M] int32 counting-Bloom sketch (CBF filter)
-    dirty: jnp.ndarray  # [C] bool — touched since last incremental save
     insert_fails: jnp.ndarray  # [] int32 — ids that found no slot (grow signal)
     # [] int32 — ids past the all2all per-destination budget (the knob is
     # a2a_slack, NOT capacity — kept separate from insert_fails). Transient;
@@ -112,6 +139,38 @@ class TableState:
         (values [C // P, P * D] — ops/packed.py): D * rows stays C * dim."""
         return self.values.shape[-1] * self.values.shape[-2] // self.keys.shape[-1]
 
+    # Columnar views of the fused metadata leaf. Leading (table-group /
+    # shard) axes pass through untouched — meta is [..., 3, C], the views
+    # are [..., C], the same shapes the three separate leaves had.
+
+    @property
+    def freq(self) -> jnp.ndarray:
+        return self.meta[..., META_FREQ, :]
+
+    @property
+    def version(self) -> jnp.ndarray:
+        return self.meta[..., META_VERSION, :]
+
+    @property
+    def dirty(self) -> jnp.ndarray:
+        return self.meta[..., META_DIRTY, :] != 0
+
+    def replace_meta(self, freq=None, version=None, dirty=None) -> "TableState":
+        """Columnar metadata write for cold paths (restore, tier sync,
+        tests): rebuild the packed leaf from whole replacement columns.
+        The hot path never comes here — it scatters fused [3]-rows."""
+        meta = self.meta
+        if freq is not None:
+            meta = meta.at[..., META_FREQ, :].set(
+                jnp.asarray(freq, jnp.int32))
+        if version is not None:
+            meta = meta.at[..., META_VERSION, :].set(
+                jnp.asarray(version, jnp.int32))
+        if dirty is not None:
+            meta = meta.at[..., META_DIRTY, :].set(
+                jnp.asarray(dirty, jnp.int32))
+        return self.replace(meta=meta)
+
 
 @struct.dataclass
 class UniqueLookup:
@@ -124,6 +183,15 @@ class UniqueLookup:
     valid: jnp.ndarray  # [U] bool — real id (not padding)
     admitted: jnp.ndarray  # [U] bool — passes the admission filter
     embeddings: jnp.ndarray  # [U, D] gathered values (default where blocked)
+    # [U, D] forward RESIDUAL: the raw (unmasked, pre-admission) value rows
+    # gathered at safe_ix during the lookup. `embeddings` is a masked view
+    # of these rows; `apply_gradients` reuses them in place of its own
+    # value re-gather (the rows cannot go stale between a train lookup and
+    # its same-step apply — inserts only claim empty slots). Empty ([0])
+    # signals "no residual carried" and the apply falls back to a gather.
+    rows: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.float32)
+    )
 
 
 class EmbeddingTable:
@@ -233,11 +301,9 @@ class EmbeddingTable:
         return TableState(
             keys=jnp.full((C,), empty_key(cfg), kdt),
             values=jnp.zeros((C // P, P * D), vdt),
-            freq=jnp.zeros((C,), jnp.int32),
-            version=jnp.full((C,), -1, jnp.int32),
+            meta=empty_meta(C),
             slots={},
             bloom=bloom,
-            dirty=jnp.zeros((C,), bool),
             insert_fails=jnp.zeros((), jnp.int32),
         )
 
@@ -483,10 +549,13 @@ class EmbeddingTable:
         present = slot_ix >= 0
         safe_ix = jnp.where(present, slot_ix, 0)
 
+        need_filter = (
+            cfg.ev.counter_filter is not None
+            and cfg.ev.counter_filter.filter_freq > 0
+        )
         values = state.values
-        freq = state.freq
-        version = state.version
-        dirty = state.dirty
+        meta = state.meta
+        f_cur = None  # post-update per-uid frequency (admission input)
         if train:
             # Initialize newly created rows (bf16 tables stochastic-round
             # the initializer, same as every later write).
@@ -495,30 +564,39 @@ class EmbeddingTable:
                 values, jnp.where(created, slot_ix, -1), init_rows,
                 state.capacity, seed=step,
             )
+            # Fused metadata update: ONE [3, U] gather + ONE [3, U]
+            # scatter replace the former freq add / version set / dirty
+            # set trio. The gather also feeds the admission filter, whose
+            # legacy post-update freq read it subsumes (uids are unique,
+            # so each present id owns its slot and set == read-add-write).
             upd_ix = jnp.where(present, slot_ix, state.capacity)
-            freq = freq.at[upd_ix].add(counts, mode="drop")
-            version = version.at[upd_ix].set(step, mode="drop")
-            dirty = dirty.at[upd_ix].set(True, mode="drop")
+            m_rows = meta.at[:, safe_ix].get(mode="clip")  # [3, U]
+            f_cur = m_rows[META_FREQ] + counts
+            new_rows = jnp.stack([
+                f_cur,
+                jnp.broadcast_to(step, f_cur.shape).astype(jnp.int32),
+                jnp.ones_like(f_cur),
+            ])
+            meta = meta.at[:, upd_ix].set(new_rows, mode="drop")
+        elif need_filter:
+            f_cur = meta[META_FREQ].at[safe_ix].get(mode="clip")
 
         emb = self._gather(values, safe_ix, state.capacity)
 
         # Admission: counter filter gates on the (just updated) frequency.
         admitted = present
-        if cfg.ev.counter_filter is not None and cfg.ev.counter_filter.filter_freq > 0:
-            f = freq.at[safe_ix].get(mode="clip")
-            admitted = present & (f >= cfg.ev.counter_filter.filter_freq)
+        if need_filter:
+            admitted = present & (f_cur >= cfg.ev.counter_filter.filter_freq)
         blocked_default = jnp.asarray(
             cfg.ev.init.default_value_no_permission, emb.dtype
         )
-        emb = jnp.where(admitted[:, None], emb, blocked_default)
+        masked = jnp.where(admitted[:, None], emb, blocked_default)
 
         new_state = state.replace(
             keys=keys,
             values=values,
-            freq=freq,
-            version=version,
+            meta=meta,
             bloom=bloom,
-            dirty=dirty,
             insert_fails=state.insert_fails + jnp.sum(failed).astype(jnp.int32),
         )
         res = UniqueLookup(
@@ -528,7 +606,9 @@ class EmbeddingTable:
             counts=counts,
             valid=valid,
             admitted=admitted,
-            embeddings=emb,
+            embeddings=masked,
+            # Raw gathered rows ride along as the apply-side residual.
+            rows=emb,
         )
         return new_state, res
 
@@ -587,8 +667,10 @@ class EmbeddingTable:
             state.capacity, seed=seed,
         )
         ix = jnp.where(ok, slot_ix, state.capacity)
-        dirty = state.dirty.at[ix].set(True, mode="drop")
-        return state.replace(values=values, dirty=dirty)
+        # Standalone writes (no same-step train lookup stamped these rows)
+        # keep their own dirty marking so incremental saves see them.
+        meta = state.meta.at[META_DIRTY, ix].set(1, mode="drop")
+        return state.replace(values=values, meta=meta)
 
     # ------------------------------------------------------- evict & rebuild
 
@@ -667,11 +749,14 @@ class EmbeddingTable:
 
         from deeprec_tpu.optim.sparse import SCALAR_PREFIX
 
+        # Relocate the fused metadata in one scatter; vacated slots take the
+        # per-column fills (freq 0 / version -1 / dirty 0).
+        meta = empty_meta(C_new).at[:, ix].set(state.meta, mode="drop")
+
         return TableState(
             keys=fresh_keys,
             values=move_rows(state.values, 0),
-            freq=move(state.freq, 0),
-            version=move(state.version, -1),
+            meta=meta,
             slots={
                 # Per-table scalar slots (e.g. AdamAsync beta powers, shape
                 # [1, 1]) are not per-key rows — pass them through. Freed
@@ -686,7 +771,6 @@ class EmbeddingTable:
                 for k, v in state.slots.items()
             },
             bloom=state.bloom,
-            dirty=move(state.dirty, False),
             insert_fails=jnp.sum(failed).astype(jnp.int32),
         )
 

@@ -1,0 +1,185 @@
+"""Embedding-engine traffic accounting: one model, asserted against reality.
+
+The train-step hot path of a hash-embedding table is a fixed set of
+gathers/scatters per unique id (docs/perf.md "Roofline methodology").  This
+module is the single source of truth for that set, in two forms:
+
+  * **Bytes** (`table_step_traffic`): per-table per-step HBM bytes of the
+    engine plus, for sharded tables, the wire bytes of the collective
+    exchange at a given wire dtype.  `tools/roofline.py` divides these by
+    measured step time; `bench.py` records them as
+    `engine_bytes_per_step` so a before/after is an artifact, not a claim.
+  * **Op counts** (`expected_lookup_apply_ops`): how many stablehlo
+    gather/scatter ops the single-table lookup+apply program should lower
+    to.  `bench.py` measures the real counts off the lowered program
+    (`count_stablehlo_ops`); `tools/roofline.py --assert-traffic` fails CI
+    when model and measurement drift — so the model can never silently
+    describe a hot path the code no longer runs.
+
+Both forms carry a `diet` switch describing the pre/post state of the
+traffic-diet PR (forward-residual reuse + fused metadata + dropped
+apply-side re-stamps), which is how the "before" column of the accounting
+stays reproducible after the "before" code is gone.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+META_COLS = 3  # freq / version / dirty, int32 each (embedding/table.py)
+
+
+# --------------------------------------------------------------- bytes model
+
+
+def table_step_traffic(
+    *,
+    unique: int,
+    dim: int,
+    value_bytes: int = 4,
+    key_bytes: int = 4,
+    slot_widths: Sequence[int] = (0,),
+    diet: bool = True,
+    counter_filter: bool = False,
+    num_shards: int = 1,
+    comm: Optional[str] = None,
+    wire_bytes: int = 4,
+    a2a_slack: float = 2.0,
+) -> Dict[str, float]:
+    """Per-table per-step traffic of the embedding engine.
+
+    `unique` is the number of unique rows the step touches (post-dedup, the
+    budgeted U); `slot_widths` the optimizer's per-row slot widths (f32).
+    Steady state: the initializer scatter for newly created rows is
+    excluded (it is proportional to table GROWTH, not step traffic).
+
+    Returns {"hbm_bytes", "wire_bytes", "total_bytes"} — wire_bytes is 0
+    for unsharded tables; for num_shards > 1 it models the per-device
+    payload of the `comm` exchange ("allgather" | "a2a") at `wire_bytes`
+    per value/grad element (4 = fp32, 2 = bf16; ids/counts always ride
+    int32).
+    """
+    U, D, vb, kb = unique, dim, value_bytes, key_bytes
+    slot_b = sum(w * 4 for w in slot_widths)
+
+    # --- HBM: per-unique-id engine traffic (gathers read, scatters write;
+    # .add reads and writes).
+    probe = 2 * kb * U  # key gather + claim scatter
+    value = (1 * D * vb) * U  # lookup row gather — the apply reuses it
+    value += (1 * D * vb) * U  # apply row scatter
+    slots = 2 * slot_b * U  # apply slot gather + scatter
+    if diet:
+        # one fused [3] gather + one fused [3] scatter
+        meta = 2 * META_COLS * 4 * U
+    else:
+        # forward: freq RMW (r+w) + version set + dirty set; admission
+        # freq gather when a counter filter gates; apply re-gather of the
+        # value rows and the duplicate version/dirty re-stamps.
+        meta = (2 * 4 + 4 + 1) * U
+        meta += (4 * U) if counter_filter else 0
+        meta += (4 + 1) * U  # apply-side version/dirty re-stamp
+        value += (1 * D * vb) * U  # apply-side value re-gather
+    hbm = probe + value + slots + meta
+
+    # --- wire: per-device exchange payload for sharded tables.
+    wire = 0.0
+    if num_shards > 1 and comm:
+        N = num_shards
+        if comm == "allgather":
+            # ids + counts allgather (int32), value psum_scatter, grad
+            # allgather — each moves ~(N-1)·U rows per device.
+            wire += (N - 1) * U * (kb + 4)
+            wire += (N - 1) * U * D * wire_bytes  # embeddings down
+            wire += (N - 1) * U * D * wire_bytes  # grads up
+        elif comm == "a2a":
+            import math
+
+            Bd = max(8, math.ceil(U * a2a_slack / N / 8) * 8)
+            per_dir_rows = (N - 1) * Bd
+            wire += per_dir_rows * (kb + 4)  # id + count buckets
+            wire += per_dir_rows * D * wire_bytes  # embeddings back
+            wire += per_dir_rows * D * wire_bytes  # grads out
+        else:
+            raise ValueError(f"unknown comm {comm!r}")
+    return {
+        "hbm_bytes": float(hbm),
+        "wire_bytes": float(wire),
+        "total_bytes": float(hbm + wire),
+    }
+
+
+def dlrm_reference_traffic(
+    *,
+    batch: int = 2048,
+    num_tables: int = 26,
+    dim: int = 16,
+    unique_fraction: float = 1.0,
+    slot_widths: Sequence[int] = (16,),
+    diet: bool = True,
+    num_shards: int = 1,
+    comm: Optional[str] = None,
+    exchange_dtype: str = "float32",
+) -> Dict[str, float]:
+    """Whole-model per-step traffic at the reference DLRM shape (26 single-
+    hot features, dim 16, Adagrad).  `unique_fraction` scales the per-table
+    touched rows (the dedup budget); sharded shapes split the batch across
+    devices and add the exchange term."""
+    wire_bytes = 2 if exchange_dtype == "bfloat16" else 4
+    local_batch = batch // max(num_shards, 1)
+    U = max(1, int(round(local_batch * unique_fraction)))
+    per_table = table_step_traffic(
+        unique=U, dim=dim, slot_widths=slot_widths, diet=diet,
+        num_shards=num_shards, comm=comm, wire_bytes=wire_bytes,
+    )
+    return {k: v * num_tables for k, v in per_table.items()}
+
+
+# ------------------------------------------------------------ op-count model
+
+
+def count_stablehlo_ops(text: str) -> Dict[str, int]:
+    """Count gather/scatter ops in a StableHLO module (the output of
+    `jax.jit(fn).lower(*args).as_text()`).  Collectives (all_gather etc.)
+    spell differently and are not counted."""
+    return {
+        "gather": len(re.findall(r'"stablehlo\.gather"|stablehlo\.gather\b', text)),
+        "scatter": len(re.findall(r'"stablehlo\.scatter"|stablehlo\.scatter\b', text)),
+    }
+
+
+def expected_lookup_apply_ops(
+    *,
+    diet: bool = True,
+    budgeted: bool = True,
+    n_row_slots: int = 1,
+) -> Dict[str, int]:
+    """Expected stablehlo gather/scatter counts for the single-table TRAIN
+    `lookup_unique` + `apply_gradients` program (no sharding, no admission
+    filter, one per-row optimizer slot unless overridden).
+
+    Base constants are CALIBRATED against the lowered program (jax 0.4.37;
+    the extra ops over a hand inventory come from jnp.unique / hash-dedup
+    internals and clip/where index lowering).  The diet deltas are the
+    structural facts this PR is about and what the CI assertion guards:
+
+      * non-diet adds 4 scatters — the forward's separate freq/version/
+        dirty trio plus the apply-side version/dirty re-stamp collapse
+        into ONE fused meta scatter under the diet (5 -> 1);
+      * the gather count is net-unchanged — the apply-side value re-gather
+        the diet removes is replaced by the fused [3, U] meta gather the
+        forward adds (which also absorbed the admission freq read).
+
+    `tools/roofline.py --assert-traffic` compares this against the counts
+    `bench.py` measures off the actually-lowered program, so any change to
+    the engine's op mix must be reflected here (that is the point).
+    """
+    if budgeted:  # hash dedup engine front-end (ops/dedup.py)
+        counts = {"gather": 20, "scatter": 14}
+    else:  # legacy sort-based jnp.unique front-end
+        counts = {"gather": 14, "scatter": 18}
+    if not diet:
+        counts["scatter"] += 4
+    extra_slots = n_row_slots - 1
+    counts["gather"] += extra_slots
+    counts["scatter"] += extra_slots
+    return counts

@@ -58,8 +58,27 @@ def apply_gradients(
     step: jnp.ndarray | int = 0,
     lr: Optional[jnp.ndarray | float] = None,
     grad_averaging: bool = False,
+    reuse_rows: bool = False,
+    stamp_meta: bool = True,
 ) -> TableState:
-    """Update the touched rows of `state` in one gather→compute→scatter pass."""
+    """Update the touched rows of `state` in one compute→scatter pass.
+
+    Traffic diet (docs/perf.md "traffic diet"), opted into by the trainer
+    hot paths via `reuse_rows=True, stamp_meta=False`: the value rows this
+    apply needs were already gathered by the same-step train lookup and
+    ride in `res.rows` — reusing them deletes a whole [U, D] gather, and
+    the lookup's fused metadata scatter already stamped version/dirty for
+    every touched row, so the apply-side pair is redundant too.
+
+    The diet is only valid when nothing wrote the touched value rows
+    between the lookup that produced `res` and this apply, and when a
+    same-step TRAIN lookup stamped the rows' metadata. The trainers
+    enforce that precondition (and the shared-table / async paths where it
+    fails keep these safe defaults — see Trainer._bundle_reuse_rows and
+    AsyncShardedTrainer._apply_one); standalone callers get the legacy
+    re-gather + re-stamp behavior, correct for every call pattern
+    (repeated applies of one `res`, interleaved scatter_update, ...).
+    """
     step = jnp.asarray(step, jnp.int32)
     lr = jnp.asarray(opt.lr if lr is None else lr, jnp.float32)
 
@@ -71,9 +90,12 @@ def apply_gradients(
     if grad_averaging:
         grad = grad / jnp.maximum(res.counts.astype(jnp.float32), 1.0)[:, None]
 
-    value = table._gather(state.values, safe_ix, state.capacity).astype(
-        jnp.float32
-    )
+    if reuse_rows and res.rows.size:
+        value = res.rows.astype(jnp.float32)
+    else:
+        value = table._gather(state.values, safe_ix, state.capacity).astype(
+            jnp.float32
+        )
     from deeprec_tpu.ops.packed import gather_rows_any, scatter_rows_any
 
     row_slots: Dict[str, jnp.ndarray] = {}
@@ -108,6 +130,10 @@ def apply_gradients(
                 use_pallas=table.use_pallas,
                 pair_kernels=table.pair_kernels,
             )
-    dirty = state.dirty.at[drop_ix].set(True, mode="drop")
-    version = state.version.at[drop_ix].set(step, mode="drop")
-    return state.replace(values=values, slots=slots, dirty=dirty, version=version)
+    if stamp_meta:
+        from deeprec_tpu.embedding.table import META_DIRTY, META_VERSION
+
+        meta = state.meta.at[META_VERSION, drop_ix].set(step, mode="drop")
+        meta = meta.at[META_DIRTY, drop_ix].set(1, mode="drop")
+        return state.replace(values=values, slots=slots, meta=meta)
+    return state.replace(values=values, slots=slots)

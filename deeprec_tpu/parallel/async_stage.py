@@ -72,6 +72,44 @@ class AsyncShardedTrainer(ShardedTrainer):
         self._async_step = jax.jit(self._async_impl, donate_argnums=0)
         self._async_steps = jax.jit(self._async_steps_impl, donate_argnums=0)
 
+    def _apply_one(self, b, state, res, grad, step, lr):
+        # The stale-by-one apply consumes batch t-1's lookup result AFTER
+        # batch t's lookup (and, across the scan, after t-1's own apply on
+        # overlapping rows): the carried forward residual predates writes to
+        # the same rows, so the apply must RE-GATHER (reuse_rows=False) —
+        # and re-stamp version/dirty (stamp_meta=True), since the rows'
+        # lookup-time stamps are a step old and a checkpoint's dirty-clear
+        # may have landed in between.
+        return self.sharded[b.name].apply_gradients(
+            state, self.sparse_opt, res, grad, step=step, lr=lr,
+            grad_averaging=self.grad_averaging,
+            reuse_rows=False, stamp_meta=True,
+        )
+
+    def _strip_residuals(self, bundle_res):
+        """Drop the forward residual (owner_res.rows, [.., O, D]) from the
+        pipelined lookup results before they enter AsyncState: the stale
+        apply never reuses it (reuse_rows=False above), and carrying it
+        would roughly double the per-table owner-side payload held across
+        dispatches and threaded through the K-step scan carry. The
+        0-sized replacement keeps each leaf's rank (shard_map out-specs
+        broadcast over the subtree) and `rows.size == 0` is the documented
+        "no residual, fall back to gather" sentinel."""
+
+        def strip(r):
+            rows = r.owner_res.rows
+            empty = jnp.zeros(rows.shape[:-2] + (0, 0), jnp.float32)
+            return r.replace(owner_res=r.owner_res.replace(rows=empty))
+
+        return {
+            bname: (
+                {k: strip(v) for k, v in r.items()}
+                if isinstance(r, dict)
+                else strip(r)
+            )
+            for bname, r in bundle_res.items()
+        }
+
     # ------------------------------------------------------------- specs
 
     def _pending_specs(self):
@@ -113,6 +151,7 @@ class AsyncShardedTrainer(ShardedTrainer):
             tables, views, bundle_res = self._lookup_all(
                 tables, batch, state.step, True
             )
+            bundle_res = self._strip_residuals(bundle_res)
             new_state = TrainState(
                 step=state.step,
                 tables={
@@ -183,6 +222,7 @@ class AsyncShardedTrainer(ShardedTrainer):
         tables, views_t, res_t = self._lookup_all(
             tables, batch_t, step, True
         )
+        res_t = self._strip_residuals(res_t)
 
         # (3) stale-apply batch t-1's sparse grads
         tables = self._apply_all(tables, astate.bundle_res, g_embs, step, lr)

@@ -115,6 +115,17 @@ class ShardedTable:
 
     # ------------------------------------------------------- shared helpers
 
+    def _wire_dtype(self, train: bool):
+        """Dtype of the value/grad payloads on the wire. TRAIN exchanges
+        ride cfg.exchange_dtype (default bf16 — halves ICI bytes both ways;
+        the owner side always segment-sums in fp32, and in the forward each
+        gathered position has exactly ONE nonzero contributor, so even the
+        psum_scatter reduction is exact at the wire precision). Eval and
+        serving exchanges stay exact fp32 regardless."""
+        if train and self.table.cfg.exchange_dtype == "bfloat16":
+            return jnp.bfloat16
+        return jnp.float32
+
     def _local_unique(self, ids, pad_value, unique_size=None):
         """Flatten + pad-collapse + dedup the local batch (both paths).
         Returns (sentinel, uids, inverse, counts, valid, overflow) —
@@ -207,11 +218,14 @@ class ShardedTable:
         state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
         # Back to gathered layout; non-owned rows contribute zero, then one
-        # reduce-scatter hands each replica its own unique rows.
+        # reduce-scatter hands each replica its own unique rows. The value
+        # payload rides the wire dtype (train: bf16 by default) — exact as a
+        # reduction because each row has one nonzero contributor.
+        wire = self._wire_dtype(train)
         e_g = res.embeddings[o_inverse] * owned[:, None].astype(res.embeddings.dtype)
         emb_local = jax.lax.psum_scatter(
-            e_g.astype(jnp.float32), axis, scatter_dimension=0, tiled=True
-        )  # [U, D]
+            e_g.astype(wire), axis, scatter_dimension=0, tiled=True
+        ).astype(jnp.float32)  # [U, D]
 
         return state, ShardedLookup(
             inverse=inverse,
@@ -289,12 +303,14 @@ class ShardedTable:
         )
         state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
-        e_out = res.embeddings[o_inverse].astype(jnp.float32)
-        e_out = e_out * recv_valid[:, None].astype(jnp.float32)
+        # Embedding return payload in the wire dtype (train: bf16 default).
+        wire = self._wire_dtype(train)
+        e_out = res.embeddings[o_inverse].astype(wire)
+        e_out = e_out * recv_valid[:, None].astype(wire)
         e_back = jax.lax.all_to_all(
             e_out.reshape(N, Bd, -1), axis, split_axis=0, concat_axis=0,
             tiled=True,
-        ).reshape(G2, -1)
+        ).reshape(G2, -1).astype(jnp.float32)
         # e_back[send_slot[u]] is u's embedding; overflow/invalid -> default.
         emb_local = e_back.at[jnp.where(send_slot >= 0, send_slot, 0)].get(
             mode="clip"
@@ -321,35 +337,41 @@ class ShardedTable:
         )
 
     def _apply_a2a(
-        self, state, opt, sl, grad_u, *, step, lr, grad_averaging
+        self, state, opt, sl, grad_u, *, step, lr, grad_averaging,
+        reuse_rows, stamp_meta,
     ) -> TableState:
         N = self.num_shards
         G2 = sl.o_inverse.shape[0]
         Bd = G2 // N
         D = grad_u.shape[1]
+        wire = self._wire_dtype(True)  # the backward only exists in train
         sslot_safe = jnp.where(sl.send_slot >= 0, sl.send_slot, G2)
         g_buf = (
-            jnp.zeros((G2, D), jnp.float32)
+            jnp.zeros((G2, D), wire)
             .at[sslot_safe]
-            .set(grad_u.astype(jnp.float32), mode="drop")
+            .set(grad_u.astype(wire), mode="drop")
         )
         g_recv = jax.lax.all_to_all(
             g_buf.reshape(N, Bd, D), self.axis, split_axis=0, concat_axis=0,
             tiled=True,
         ).reshape(G2, D)
         # Segment-sum into owner-unique rows AT THE OWNER SIZE (== G2 on
-        # the legacy path; a few pad slots over it under a budget).
+        # the legacy path; a few pad slots over it under a budget). The
+        # accumulation runs in fp32 on the owner side regardless of the
+        # wire dtype.
         O = sl.owner_res.uids.shape[0]
         o_grad = (
             jnp.zeros((O, D), jnp.float32)
             .at[sl.o_inverse]
-            .add(g_recv * sl.owned[:, None].astype(jnp.float32))
+            .add(g_recv.astype(jnp.float32)
+                 * sl.owned[:, None].astype(jnp.float32))
         )
         # Same local-mean-loss rescale as the allgather path.
         o_grad = o_grad / jnp.float32(N)
         return optim_apply.apply_gradients(
             self.table, state, opt, sl.owner_res, o_grad, step=step, lr=lr,
-            grad_averaging=grad_averaging,
+            grad_averaging=grad_averaging, reuse_rows=reuse_rows,
+            stamp_meta=stamp_meta,
         )
 
     # ------------------------------------------------------------- backward
@@ -364,22 +386,33 @@ class ShardedTable:
         step: jnp.ndarray | int = 0,
         lr=None,
         grad_averaging: bool = False,
+        reuse_rows: bool = False,
+        stamp_meta: bool = True,
     ) -> TableState:
+        """reuse_rows/stamp_meta thread to optim_apply.apply_gradients
+        (safe legacy defaults; see its docstring). The sharded trainer's
+        sync hot path opts into the diet — the owner-side residual
+        (sl.owner_res.rows) replaces the apply's value gather — while the
+        async stale-by-one apply keeps the defaults."""
         if self.comm == "a2a":
             return self._apply_a2a(
                 state, opt, sl, grad_u, step=step, lr=lr,
-                grad_averaging=grad_averaging,
+                grad_averaging=grad_averaging, reuse_rows=reuse_rows,
+                stamp_meta=stamp_meta,
             )
+        wire = self._wire_dtype(True)  # the backward only exists in train
         g_g = jax.lax.all_gather(
-            grad_u.astype(jnp.float32), self.axis, tiled=True
+            grad_u.astype(wire), self.axis, tiled=True
         )  # [G, D] — G = N·U shrinks with the unique budget
         G, D = g_g.shape
         # Owner-unique rows: size == G legacy, G + pad under a budget.
+        # Accumulate in fp32 whatever the wire dtype was.
         O = sl.owner_res.uids.shape[0]
         o_grad = (
             jnp.zeros((O, D), jnp.float32)
             .at[sl.o_inverse]
-            .add(g_g * sl.owned[:, None].astype(jnp.float32))
+            .add(g_g.astype(jnp.float32)
+                 * sl.owned[:, None].astype(jnp.float32))
         )
         # Per-replica losses are means over the LOCAL batch (B/N); summing N
         # replicas' grads here would make the sparse step N x the
@@ -395,4 +428,6 @@ class ShardedTable:
             step=step,
             lr=lr,
             grad_averaging=grad_averaging,
+            reuse_rows=reuse_rows,
+            stamp_meta=stamp_meta,
         )

@@ -188,9 +188,11 @@ class ShardedTrainer(Trainer):
         )
 
     def _apply_one(self, b, state, res, grad, step, lr):
+        # Sync sharded hot path: traffic-diet opt-in (see Trainer._apply_one).
         return self.sharded[b.name].apply_gradients(
             state, self.sparse_opt, res, grad, step=step, lr=lr,
             grad_averaging=self.grad_averaging,
+            reuse_rows=self._bundle_reuse_rows(b), stamp_meta=False,
         )
 
     # --------------------------------------------- capacity management
@@ -237,7 +239,10 @@ class ShardedTrainer(Trainer):
     def _sharded_micro(self, tables, dense, batch, step, lr):
         """One (micro-)batch inside shard_map: lookups, fwd/bwd, sparse
         applies; returns tables, pmean'd dense grads (unapplied), metrics."""
-        tables, views, bundle_res = self._lookup_all(tables, batch, step, True)
+        with jax.named_scope("phase_lookup_exchange"):
+            tables, views, bundle_res = self._lookup_all(
+                tables, batch, step, True
+            )
         embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
 
         def loss_fn(dense, embs):
@@ -246,12 +251,14 @@ class ShardedTrainer(Trainer):
             loss, out = self._loss_from_logits(out, batch)
             return loss, out
 
-        (loss, out), (g_dense, g_embs) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True
-        )(dense, embs)
+        with jax.named_scope("phase_dense_fwd_bwd"):
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(dense, embs)
         # Data-parallel dense grads: mean over replicas via ICI allreduce.
         g_dense = jax.lax.pmean(g_dense, self.axis)
-        tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
+        with jax.named_scope("phase_sparse_apply"):
+            tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
 
         mets = {"loss": jax.lax.pmean(loss, self.axis)}
         if not isinstance(out, dict):

@@ -112,13 +112,25 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _meta_columns(meta_np: np.ndarray) -> Dict[str, np.ndarray]:
+    """Unpack the fused [..., 3, C] metadata into the checkpoint's COLUMNAR
+    freq/version/dirty arrays — the on-disk format is unchanged by the
+    packed device layout, so old checkpoints restore as-is and new ones
+    restore into old code."""
+    from deeprec_tpu.embedding.table import META_DIRTY, META_FREQ, META_VERSION
+
+    return {
+        "freq": meta_np[..., META_FREQ, :],
+        "version": meta_np[..., META_VERSION, :],
+        "dirty": meta_np[..., META_DIRTY, :] != 0,
+    }
+
+
 def _state_to_np(ts: TableState) -> Dict[str, np.ndarray]:
     d = {
         "keys": _to_host(ts.keys),
         "values": _to_host(ts.values),
-        "freq": _to_host(ts.freq),
-        "version": _to_host(ts.version),
-        "dirty": _to_host(ts.dirty),
+        **_meta_columns(_to_host(ts.meta)),
     }
     for sname, arr in ts.slots.items():
         d["slot:" + sname] = _to_host(arr)
@@ -188,8 +200,14 @@ def import_rows(
         state.values, put_ix, jnp.asarray(rows["values"], np.float32),
         state.capacity,
     )
-    freq = state.freq.at[ix].set(jnp.asarray(rows["freqs"]), mode="drop")
-    version = state.version.at[ix].set(jnp.asarray(rows["versions"]), mode="drop")
+    from deeprec_tpu.embedding.table import META_FREQ, META_VERSION
+
+    meta = state.meta.at[META_FREQ, ix].set(
+        jnp.asarray(rows["freqs"], jnp.int32), mode="drop"
+    )
+    meta = meta.at[META_VERSION, ix].set(
+        jnp.asarray(rows["versions"], jnp.int32), mode="drop"
+    )
     slots = dict(state.slots)
     for sname, arr in state.slots.items():
         key = "slot:" + sname
@@ -206,8 +224,7 @@ def import_rows(
     if "bloom" in rows and bloom is not None:
         bloom = jnp.asarray(rows["bloom"])
     return state.replace(
-        keys=new_keys, values=values, freq=freq, version=version, slots=slots,
-        bloom=bloom,
+        keys=new_keys, values=values, meta=meta, slots=slots, bloom=bloom,
     )
 
 
@@ -394,9 +411,9 @@ class CheckpointManager:
                 d = {
                     "keys": get(ts.keys),
                     "values": get(ts.values),
-                    "freq": get(ts.freq),
-                    "version": get(ts.version),
-                    "dirty": get(ts.dirty),
+                    # unpack the fused metadata HOST-side (the device leaf
+                    # is [3, C_local]; the file format stays columnar)
+                    **_meta_columns(get(ts.meta)),
                 }
                 for sname, arr in ts.slots.items():
                     d["slot:" + sname] = get(arr)
@@ -438,8 +455,12 @@ class CheckpointManager:
         return exports
 
     def _clear_dirty(self, state: TrainState) -> TrainState:
+        # Zero the META_DIRTY row of the fused metadata leaf; the columnar
+        # multiply broadcasts over any leading (group/shard) axes and keeps
+        # the arrays' device placement.
+        _keep = jnp.asarray([1, 1, 0], jnp.int32)[:, None]
         tables = {
-            bname: ts.replace(dirty=jax.tree.map(jnp.zeros_like, ts.dirty))
+            bname: ts.replace(meta=ts.meta * _keep)
             if not isinstance(ts, dict)
             else ts
             for bname, ts in state.tables.items()

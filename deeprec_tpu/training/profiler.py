@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Iterator, Optional
+import time
+from typing import Dict, Iterator, Optional
 
 import jax
 
@@ -24,6 +25,73 @@ def trace(logdir: str = "/tmp/deeprec_tpu_trace") -> Iterator[str]:
         yield logdir
     finally:
         jax.profiler.stop_trace()
+
+
+class PhaseProfiler:
+    """Named-phase step breakdown (lookup / exchange / dense fwd-bwd /
+    sparse apply / metadata ...).
+
+    Two halves, matching how phase attribution works on an async device:
+
+      * Inside the compiled step the trainers wrap each phase in
+        `jax.named_scope("phase_<name>")` (training/trainer.py), so device
+        traces (StepWindowTracer / `trace()`) group the emitted ops per
+        phase — that is where TPU per-phase DEVICE time comes from.
+      * Host-side, `phase(name)` wraps a blocking call (e.g. a jitted
+        sub-program of just the lookups, or lookup+apply) in a
+        `jax.profiler.TraceAnnotation` plus a wall-clock accumulator;
+        `phase_report()` returns {phase: {calls, total_ms, mean_ms}}.
+        `bench.py --profile` uses this to time phase sub-programs and
+        report where the step went — the measurement that verifies a hot-
+        path diet actually moved engine time, without trace parsing.
+
+    The two compose: annotations from (2) bracket the dispatches of (1) on
+    the host timeline when a trace is being captured.
+    """
+
+    def __init__(self):
+        self._times: Dict[str, list] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block=None) -> Iterator[None]:
+        """Time the enclosed block under `name`. Pass `block` (an array or
+        pytree) to `jax.block_until_ready` before the clock stops so async
+        dispatch doesn't attribute device time to the NEXT phase."""
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(f"phase_{name}"):
+            try:
+                yield
+            finally:
+                if block is not None:
+                    jax.block_until_ready(block)
+                self._times.setdefault(name, []).append(
+                    time.perf_counter() - t0
+                )
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        """Run fn(*args, **kwargs), block on its result, record under
+        `name`, return the result."""
+        out = None
+        with self.phase(name):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        return out
+
+    def reset(self) -> None:
+        self._times.clear()
+
+    def phase_report(self) -> Dict[str, Dict[str, float]]:
+        """{phase: {calls, total_ms, mean_ms, min_ms}} over everything
+        recorded since the last reset()."""
+        out = {}
+        for name, ts in self._times.items():
+            out[name] = {
+                "calls": len(ts),
+                "total_ms": round(sum(ts) * 1e3, 3),
+                "mean_ms": round(sum(ts) / len(ts) * 1e3, 3),
+                "min_ms": round(min(ts) * 1e3, 3),
+            }
+        return out
 
 
 class StepWindowTracer:

@@ -303,10 +303,24 @@ class Trainer:
             state, ids, step, train, pad, U, salt=salt
         )
 
+    def _bundle_reuse_rows(self, b: Bundle) -> bool:
+        """Whether the apply may reuse the forward residual (res.rows)
+        instead of re-gathering value rows. Shared-table bundles (several
+        features on ONE unstacked table) apply sequentially — feature k's
+        residual predates feature k-1's apply and overlapping rows would
+        lose updates — so only they re-gather. Stacked (vmapped) members
+        and single-feature tables see exactly one apply per step."""
+        return b.stacked or len(b.features) == 1
+
     def _apply_one(self, b: Bundle, state, res, grad, step, lr):
+        # Train hot path: opt into the traffic diet — reuse the forward
+        # residual where the bundle allows it, and never re-stamp
+        # version/dirty (the same-step train lookup's fused metadata
+        # scatter already did, for a superset of the applied rows).
         return apply_gradients(
             b.table, state, self.sparse_opt, res, grad, step=step, lr=lr,
             grad_averaging=self.grad_averaging,
+            reuse_rows=self._bundle_reuse_rows(b), stamp_meta=False,
         )
 
     def _lookup_all(self, tables, batch, step, train):
@@ -391,8 +405,15 @@ class Trainer:
 
     def _micro_step(self, tables, dense, batch, step, lr):
         """Forward + backward + SPARSE applies for one (micro-)batch; returns
-        updated tables, the dense-grad pytree (NOT applied) and metrics."""
-        tables, views, bundle_res = self._lookup_all(tables, batch, step, True)
+        updated tables, the dense-grad pytree (NOT applied) and metrics.
+
+        Phases carry `jax.named_scope` annotations (training/profiler.py:
+        the per-phase step breakdown) so device traces group the emitted
+        ops under lookup / dense fwd-bwd / sparse apply."""
+        with jax.named_scope("phase_lookup"):
+            tables, views, bundle_res = self._lookup_all(
+                tables, batch, step, True
+            )
         embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
 
         def loss_fn(dense, embs):
@@ -406,10 +427,12 @@ class Trainer:
             loss, out = self._loss_from_logits(out, batch)
             return loss, out
 
-        (loss, out), (g_dense, g_embs) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True
-        )(dense, embs)
-        tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
+        with jax.named_scope("phase_dense_fwd_bwd"):
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(dense, embs)
+        with jax.named_scope("phase_sparse_apply"):
+            tables = self._apply_all(tables, bundle_res, g_embs, step, lr)
         mets = {"loss": loss}
         if not isinstance(out, dict):
             probs = jax.nn.sigmoid(out)
@@ -533,18 +556,24 @@ class Trainer:
         # ShardedTrainer overrides with mesh placement.
         return jax.device_put(batch)
 
-    def stage(self, source, depth: int = 2):
+    def stage(self, source, depth: int = 2, on_consume=None):
         """Auto-staged input pipeline: wrap any host batch iterator so IO,
         the host->device transfer, and the train step overlap — zero
         manual `staged()` calls, boundary derived from the model (the
         SmartStage user contract). Returns `source` unchanged when the
-        trainer was built with stage="off"."""
+        trainer was built with stage="off".
+
+        `on_consume`: called once per batch DELIVERED to the train loop
+        (not per batch produced) — stream-position carriers
+        (CriteoStats.mark_consumed) checkpoint the consumed index through
+        this so a restore never skips the ring's in-flight batches."""
         if self.stage_mode != "auto":
             return source
         from deeprec_tpu.data.prefetch import Prefetcher
 
         return Prefetcher(iter(source), depth=depth,
-                          transform=self.stage_batch)
+                          transform=self.stage_batch,
+                          on_consume=on_consume)
 
     # --------------------------------------------------------------- public
 

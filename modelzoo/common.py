@@ -127,8 +127,12 @@ def make_data(args, kind: str):
         args._eval_iter = iter(
             D.CriteoStats(args.batch_size, seed=args.seed, split="eval")
         )
-        # stream position checkpoints with the model (CriteoStats is a
-        # pure function of index, so a restore must NOT replay batch 0)
+        # Stream position checkpoints with the model (CriteoStats is a pure
+        # function of index: a restore must NOT replay consumed batches and
+        # must NOT skip un-consumed ones). The auto-stage ring runs ahead of
+        # the train step, so run() wires gen.mark_consumed into the staged
+        # iterator and save() records the CONSUMED index — the producer
+        # index would silently skip the in-flight batches.
         args._datasets = {"criteo_stats": gen}
         return iter(gen)
     if args.data != "synthetic":
@@ -242,7 +246,20 @@ def run(model, args, data_kind: str) -> Dict[str, float]:
     # zero manual staged() calls here or in make_data. Batches from
     # `data` are device-ready; only out-of-band eval batches need the
     # explicit stage_batch call.
-    data = trainer.stage(raw_data)
+    # Stream-position carriers track the CONSUMED index through the staging
+    # ring (depth-2 prefetch runs the producer ahead; checkpoints must
+    # record what the train loop actually received).
+    marks = []
+    for d in getattr(args, "_datasets", {}).values():
+        if hasattr(d, "mark_consumed"):
+            marks.append(d.mark_consumed)
+            if hasattr(d, "attach_consumer"):
+                # flip to consumed-position checkpointing BEFORE the ring's
+                # producer runs ahead (a save prior to the first delivery
+                # must not report the producer index)
+                d.attach_consumer()
+    on_consume = (lambda: [m() for m in marks]) if marks else None
+    data = trainer.stage(raw_data, on_consume=on_consume)
     eval_src = getattr(args, "_eval_iter", None)
     eval_batches = [
         trainer.stage_batch(next(eval_src)) if eval_src else next(iter(data))

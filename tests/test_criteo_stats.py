@@ -132,3 +132,57 @@ def test_auc_helper_exact():
         np.asarray([1, 0, 1, 0], np.float32),
         np.asarray([0.7, 0.7, 0.2, 0.2], np.float32),
     ) == 0.5
+
+
+def test_consumed_index_checkpoints_behind_prefetch_ring():
+    """ADVICE round-5 #2: under a depth-2 prefetch ring the producer index
+    runs ahead of what the train loop consumed; save() must checkpoint the
+    CONSUMED position so kill-and-resume replays every unconsumed batch
+    exactly once."""
+    import time
+
+    from deeprec_tpu.data.prefetch import Prefetcher
+
+    g = CriteoStats(batch_size=64, seed=0)
+    g.attach_consumer()  # wiring-time: BEFORE the ring's producer runs ahead
+    pf = Prefetcher(iter(g), depth=2, transform=lambda b: b,
+                    on_consume=g.mark_consumed)
+    try:
+        # a save BEFORE the first delivery must report position 0 even
+        # though the ring's producer is already ahead
+        deadline0 = time.time() + 5.0
+        while g._index == 0 and time.time() < deadline0:
+            time.sleep(0.01)
+        assert g._index > 0 and g.save()["index"] == 0
+        consumed = [next(pf) for _ in range(3)]
+        # let the producer run the ring ahead of the consumer
+        deadline = time.time() + 5.0
+        while g._index <= 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert g._index > 3, "producer never ran ahead (ring broken?)"
+        st = g.save()
+        assert st["index"] == 3  # consumed, NOT the producer position
+    finally:
+        pf.close()
+
+    # the consumer saw exactly batches 0..2, in order
+    for i, b in enumerate(consumed):
+        np.testing.assert_array_equal(b["C1"], g.batch_at(i)["C1"])
+
+    # kill-and-resume: the restored stream hands out batch 3 next — the
+    # first batch the dead run never trained on — exactly once
+    g2 = CriteoStats(batch_size=64, seed=0)
+    g2.restore(st)
+    pf2 = Prefetcher(iter(g2), depth=2, transform=lambda b: b,
+                     on_consume=g2.mark_consumed)
+    try:
+        nxt = next(pf2)
+        np.testing.assert_array_equal(nxt["C1"], g.batch_at(3)["C1"])
+        assert g2.save()["index"] == 4
+    finally:
+        pf2.close()
+
+    # unstaged use keeps the legacy producer-position semantics
+    g3 = CriteoStats(batch_size=64, seed=0)
+    g3.batch(), g3.batch()
+    assert g3.save()["index"] == 2

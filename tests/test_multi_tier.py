@@ -260,6 +260,7 @@ def test_demote_promote_preserves_optimizer_slots():
                 s.slots["accum"], put, jnp.full((1, D), 7.75), s.capacity
             ),
         },
+    ).replace_meta(
         # make key 7 STRICTLY the coldest so LFU must demote it
         freq=jnp.where(jnp.asarray(occ0), 5, s.freq).at[slot7].set(1),
     )

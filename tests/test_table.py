@@ -207,7 +207,7 @@ def test_scatter_update_and_dirty_tracking():
     t = make_table()
     s = t.create()
     s, res = t.lookup_unique(s, jnp.array([5, 6], jnp.int32))
-    s = s.replace(dirty=jnp.zeros_like(s.dirty))  # simulate post-save reset
+    s = s.replace_meta(dirty=jnp.zeros_like(s.dirty))  # simulate post-save reset
     new_vals = jnp.ones_like(res.embeddings)
     s = t.scatter_update(s, res.slot_ix, new_vals, mask=res.valid)
     assert int(jnp.sum(s.dirty)) == 2

@@ -31,6 +31,54 @@ def mlp_flops(dims, batch):
     return total
 
 
+def assert_traffic(json_path: str) -> int:
+    """CI gate: the traffic model (deeprec_tpu/ops/traffic.py) must match
+    the gather/scatter op counts bench.py measured off the actually-lowered
+    lookup+apply program.  Drift — an op added to or removed from the hot
+    path without the model learning about it — fails the smoke run."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    tr = rec.get("traffic")
+    if not tr:
+        print(f"roofline: {json_path} has no 'traffic' record", file=sys.stderr)
+        return 1
+    rc = 0
+    for arm in ("diet", "legacy_apply"):
+        # bench.py records both the measurement (op counts off the lowered
+        # program) and the model's prediction from the same checkout; the
+        # re-import here also catches a bench JSON produced by stale code.
+        measured = tr["ops_measured"][arm]
+        recorded = tr["ops_model"][arm]
+        for kind in ("gather", "scatter"):
+            if measured[kind] != recorded[kind]:
+                print(
+                    f"roofline: traffic-model drift [{arm}/{kind}]: "
+                    f"measured {measured[kind]} vs model {recorded[kind]} "
+                    f"— update deeprec_tpu/ops/traffic.py's op inventory "
+                    f"to match the hot path",
+                    file=sys.stderr,
+                )
+                rc = 1
+    diet_s = tr["ops_measured"]["diet"]["scatter"]
+    legacy_s = tr["ops_measured"]["legacy_apply"]["scatter"]
+    if diet_s >= legacy_s:
+        print(
+            f"roofline: the diet no longer removes scatters "
+            f"(diet {diet_s} vs legacy {legacy_s})", file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: traffic model matches measurement "
+            f"(diet {tr['ops_measured']['diet']}, legacy "
+            f"{tr['ops_measured']['legacy_apply']}; diet removes "
+            f"{legacy_s - diet_s} scatters)"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -41,7 +89,13 @@ def main(argv=None):
                    help="HBM bandwidth ceiling (GB/s); v4 default")
     p.add_argument("--peak_tflops", type=float, default=275.0,
                    help="bf16 MXU ceiling (TFLOP/s); v4 default")
+    p.add_argument("--assert-traffic", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the traffic model "
+                        "against the op counts recorded in a bench.py JSON "
+                        "(CI smoke gate; exits nonzero on drift)")
     args = p.parse_args(argv)
+    if args.assert_traffic:
+        sys.exit(assert_traffic(args.assert_traffic))
 
     import jax
     import jax.numpy as jnp
@@ -71,17 +125,25 @@ def main(argv=None):
     eps = B / dt
 
     # ---- algorithmic cost accounting (per step) ----
+    # Embedding-engine traffic comes from the SHARED model in
+    # deeprec_tpu/ops/traffic.py (the one bench.py records and
+    # --assert-traffic validates): per unique id, probe key gather + claim
+    # scatter, ONE value row gather (the apply reuses the forward
+    # residual), one value row scatter, slot row R/W, and one fused [3]
+    # int32 metadata gather + scatter.
+    from deeprec_tpu.ops.traffic import table_step_traffic
+
     F = model.num_cat
     vbytes = jnp.dtype(model.features[0].table.value_dtype).itemsize
     U = B  # worst case: all ids unique (synthetic zipf dedups below this)
-    # embedding engine HBM traffic: probe key gathers + value row
-    # gather + value scatter + Adagrad slot row gather + scatter
-    emb_bytes = F * U * (
-        2 * 4            # probe: key gather + claim scatter (4B keys)
-        + 2 * D * vbytes   # value row read + write
-        + 2 * D * 4        # accumulator row read + write (f32)
-        + 4 * 4            # freq/version/dirty touches
+    per_table = table_step_traffic(
+        unique=U, dim=D, value_bytes=vbytes, slot_widths=(D,), diet=True,
     )
+    per_table_before = table_step_traffic(
+        unique=U, dim=D, value_bytes=vbytes, slot_widths=(D,), diet=False,
+    )
+    emb_bytes = F * per_table["hbm_bytes"]
+    emb_bytes_before = F * per_table_before["hbm_bytes"]
     dense_in = model.num_dense
     fwd = mlp_flops([dense_in] + list(model.bottom), B)
     inter_f = (F + 1) * (F + 1) * D  # dot-interaction matmul per example
@@ -99,6 +161,9 @@ def main(argv=None):
     print(f"examples/sec      : {eps:,.0f}   ({dt * 1e3:.2f} ms/step, batch {B})")
     print(f"embedding traffic : {emb_bytes / 1e6:.1f} MB/step -> {bw_used:,.1f} GB/s "
           f"({frac_bw:.1%} of {args.peak_bw_gbs:.0f} GB/s roof)")
+    print(f"   pre-diet model : {emb_bytes_before / 1e6:.1f} MB/step "
+          f"({1 - emb_bytes / emb_bytes_before:.1%} removed by "
+          f"residual-reuse + fused metadata)")
     print(f"dense compute     : {flops / 1e9:.2f} GFLOP/step -> {tf_used:.2f} TFLOP/s "
           f"({frac_tf:.1%} of {args.peak_tflops:.0f} TFLOP/s roof)")
     print(f"binding roof      : {roof}")
