@@ -282,6 +282,103 @@ def _traffic_report(trainer, budget_mode, dedup_stats):
     }
 
 
+def _ckpt_report():
+    """Host-choreography stall accounting (round 9): what a checkpoint /
+    multi-tier sync costs the TRAINING THREAD, sync vs async, plus the
+    incremental-save transfer diet (device-compacted dirty rows vs the
+    legacy full-table device->host pull). Small dedicated model — the
+    numbers are stall ratios, not throughput, so smoke-scale is fine."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=16, capacity=1 << 14, hidden=(32,), num_cat=4,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1))
+    gen = SyntheticCriteo(batch_size=512, num_cat=4, num_dense=2,
+                          vocab=6000, seed=0)
+    batches = [
+        {k: jnp.asarray(v) for k, v in gen.batch().items()} for _ in range(4)
+    ]
+    st = tr.init(0)
+    for b in batches:
+        st, mets = tr.train_step(st, b)
+    jax.block_until_ready(mets["loss"])
+
+    tmp = tempfile.mkdtemp(prefix="deeprec_bench_ckpt_")
+    try:
+        ck = CheckpointManager(os.path.join(tmp, "sync"), tr)
+        cka = CheckpointManager(os.path.join(tmp, "async"), tr)
+        report = {"ckpt_stall_ms": {}, "incr_transfer_bytes": {}}
+
+        st, _ = ck.save(st)
+        report["ckpt_stall_ms"]["sync_full"] = ck.last_save["stall_ms"]
+        full_bytes = ck.last_save["transfer_bytes"]
+        _, _ = cka.save_async(st)
+        report["ckpt_stall_ms"]["async_full"] = cka.last_save["stall_ms"]
+        cka.wait()
+
+        # dirty a fraction of the table, then delta-save both ways
+        st, mets = tr.train_step(st, batches[0])
+        jax.block_until_ready(mets["loss"])
+        st2, _ = ck.save_incremental(st)
+        report["ckpt_stall_ms"]["sync_incr"] = ck.last_save["stall_ms"]
+        incr_bytes = ck.last_save["transfer_bytes"]
+        _, _ = cka.save_incremental_async(st)
+        report["ckpt_stall_ms"]["async_incr"] = cka.last_save["stall_ms"]
+        cka.wait()
+        report["incr_transfer_bytes"] = {
+            "full_tables": int(full_bytes),
+            "dirty_compacted": int(incr_bytes),
+            "reduction": round(1.0 - incr_bytes / max(full_bytes, 1), 4),
+        }
+
+        # multi-tier migration: sync stall vs overlapped extraction
+        from deeprec_tpu.config import (
+            EmbeddingVariableOption, StorageOption, TableConfig,
+        )
+        from deeprec_tpu.embedding.multi_tier import MultiTierTable
+        from deeprec_tpu.embedding.table import EmbeddingTable
+
+        def tier_run(use_async):
+            cfg = TableConfig(
+                name="bench_tier", dim=16, capacity=1 << 12,
+                ev=EmbeddingVariableOption(storage=StorageOption(
+                    storage_type="hbm_dram")),
+            )
+            t = EmbeddingTable(cfg)
+            mt = MultiTierTable(t, high_watermark=0.7, low_watermark=0.5)
+            s = t.create()
+            s, res = t.lookup_unique(
+                s, jnp.arange(3500, dtype=jnp.int32), step=0
+            )
+            jax.block_until_ready(res.embeddings)
+            t0 = time.perf_counter()
+            if use_async:
+                s, _ = mt.sync_async(s, step=1)
+            else:
+                s, _ = mt.sync(s, step=1)
+            stall = (time.perf_counter() - t0) * 1e3
+            if use_async:
+                mt.drain(s)
+            return round(stall, 3)
+
+        report["sync_stall_ms"] = {
+            "sync": tier_run(False), "async": tier_run(True),
+        }
+        return report
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _profile_phases(trainer, batches):
     """Host-timed per-phase breakdown (training/profiler.py): jitted
     sub-programs isolate the sparse phases, deltas attribute the rest."""
@@ -397,6 +494,7 @@ def workload():
     ex_per_sec = head["examples_per_sec"]
 
     traffic = _traffic_report(trainer, budget_mode, dedup_stats)
+    ckpt = _ckpt_report()
     phases = (
         _profile_phases(trainer, batches)
         if os.environ.get("BENCH_PROFILE") == "1"
@@ -444,6 +542,11 @@ def workload():
                 # tools/roofline.py --assert-traffic checks against the
                 # model (ops/traffic.py).
                 "traffic": traffic,
+                # Host-choreography stall accounting (round 9): training-
+                # thread ms per checkpoint / tier sync (sync vs async) and
+                # the incremental-save transfer diet (dirty-compacted vs
+                # full-table device->host bytes).
+                "ckpt": ckpt,
                 **({"phases": phases} if phases else {}),
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,

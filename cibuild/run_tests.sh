@@ -36,6 +36,9 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_dedup.py --smoke
 echo "== traffic-diet microbench (CPU smoke: diet + legacy-apply arms) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --traffic --smoke
 
+echo "== checkpoint choreography microbench (CPU smoke: sync + async paths) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py \
     | tee /tmp/deeprec_bench_smoke.out

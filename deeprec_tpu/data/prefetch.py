@@ -32,16 +32,32 @@ class Prefetcher:
         depth: int = 2,
         transform: Optional[Callable] = None,
         on_consume: Optional[Callable] = None,
+        sharding=None,
     ):
         """on_consume: invoked (in the CONSUMER thread) each time a batch is
         delivered from __next__. The ring runs `depth` batches ahead of the
         train loop, so producer-side positions (a reader's internal index)
         overstate progress by the in-flight count; stream-position
         checkpoints must track deliveries, not productions — wire the
-        reader's `mark_consumed` here (CriteoStats, Trainer.stage)."""
+        reader's `mark_consumed` here (CriteoStats, Trainer.stage).
+
+        sharding: placement for the DEFAULT transform (a jax.sharding
+        .Sharding, e.g. NamedSharding(mesh, P("data"))). The bare
+        `jax.device_put` default lands every batch on device 0 — feeding a
+        sharded trainer that way transfers twice (host->dev0, then dev0->
+        mesh inside the step). Pass the mesh sharding (or use
+        Trainer.stage, whose transform already places mesh-wide) so the
+        staged transfer lands split across devices. Ignored when an
+        explicit `transform` is given."""
         self.source = iter(source)
         self.depth = max(1, depth)
-        self.transform = transform or (lambda b: jax.device_put(b))
+        if transform is None:
+            transform = (
+                (lambda b: jax.device_put(b, sharding))
+                if sharding is not None
+                else (lambda b: jax.device_put(b))
+            )
+        self.transform = transform
         self.on_consume = on_consume
         self.q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
@@ -112,7 +128,9 @@ class Prefetcher:
 
 
 def staged(source, depth: int = 2, transform=None,
-           on_consume=None) -> Prefetcher:
-    """tf.staged analog: `for batch in staged(reader): ...`"""
+           on_consume=None, sharding=None) -> Prefetcher:
+    """tf.staged analog: `for batch in staged(reader): ...`. Pass
+    `sharding` when feeding a sharded trainer without a custom transform
+    so batches land mesh-split instead of on device 0."""
     return Prefetcher(source, depth=depth, transform=transform,
-                      on_consume=on_consume)
+                      on_consume=on_consume, sharding=sharding)

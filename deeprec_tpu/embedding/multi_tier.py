@@ -22,7 +22,10 @@ AdamAsync beta powers) are not per-row state and stay on device.
 from __future__ import annotations
 
 import dataclasses
+import functools as _ft
 import os
+import threading
+import time
 from typing import Optional
 
 import jax
@@ -30,7 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeprec_tpu.config import StorageType
-from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
+from deeprec_tpu.embedding.table import (
+    META_FREQ,
+    EmbeddingTable,
+    TableState,
+    empty_key,
+)
 from deeprec_tpu.native import HostKV
 
 
@@ -257,6 +265,60 @@ class TierStats:
     disk_size: int = 0
 
 
+# ------------------------------------------- device-side extraction (async)
+
+
+@_ft.partial(jax.jit, static_argnums=(0, 1))
+def _demote_extract_jit(table, size: int, state: TableState, n_out):
+    """Device half of a demotion: pick the `n_out` coldest (LFU) / oldest
+    (LRU) occupied rows and GATHER their packed (values + per-row slot)
+    rows on device at static budget `size` (ops/compact.quantize_rows
+    bucket). Only `size` packed rows cross device->host — the legacy
+    sync() pulled the full [C, D] values and every slot array to the host
+    just to index a few rows out of them. All outputs are fresh buffers
+    (donation-safe for the background IO thread). `keep` is the rebuild
+    mask dropping exactly the first `n_out` selected rows."""
+    from deeprec_tpu.ops.packed import gather_rows_any
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    cfg = table.cfg
+    C = state.capacity
+    sent = jnp.asarray(empty_key(cfg), state.keys.dtype)
+    occ = state.keys != sent
+    score = (
+        state.version if cfg.ev.storage.cache_strategy == "lru"
+        else state.freq
+    )
+    # unoccupied slots sort last; ties inside a score are argsort-order
+    masked = jnp.where(occ, score, jnp.iinfo(jnp.int32).max)
+    take = jnp.argsort(masked)[:size].astype(jnp.int32)
+    valid = jnp.arange(size, dtype=jnp.int32) < n_out
+    cols = [gather_rows_any(state.values, take, C).astype(jnp.float32)]
+    for name in sorted(state.slots):
+        if name.startswith(SCALAR_PREFIX):
+            continue  # per-table scalars stay on device (not per-row state)
+        g = gather_rows_any(state.slots[name], take, C)
+        cols.append(g.reshape(size, -1).astype(jnp.float32))
+    keep = jnp.ones((C,), bool).at[
+        jnp.where(valid, take, C)
+    ].set(False, mode="drop")
+    return {
+        "keys": jnp.where(valid, state.keys[take], sent),
+        "rows": jnp.concatenate(cols, axis=1),
+        "freqs": state.meta[META_FREQ, take],
+        "versions": state.version[take],
+        "keep": keep,
+    }
+
+
+@jax.jit
+def _tier_snapshot_jit(state: TableState):
+    """Fresh-buffer copies of (keys, freq) for the background promote scan
+    — the live leaves may be donated by the next train dispatch while the
+    worker is still reading."""
+    return jnp.copy(state.keys), jnp.copy(state.freq)
+
+
 class MultiTierTable:
     """Wraps an EmbeddingTable with a host overflow tier.
 
@@ -294,6 +356,17 @@ class MultiTierTable:
         # rebuild so rows reborn in freed slots restart from the optimizer's
         # init (e.g. Adagrad initial accumulator), never a raw 0.
         self.slot_fills = tuple(slot_fills or ())
+        # Overlapped-sync state (sync_async): one background IO round in
+        # flight; `_pending` holds promotion candidates the worker found,
+        # applied at the NEXT sync boundary. The worker never erases tier
+        # rows — erasure decisions happen at apply time, so a discarded
+        # round loses nothing. sync_stall_ms accumulates CALLER-side
+        # blocking time; on_io is a test seam run in the worker before IO.
+        self._worker: Optional[threading.Thread] = None
+        self._worker_err: Optional[BaseException] = None
+        self._pending: Optional[dict] = None
+        self.sync_stall_ms: float = 0.0
+        self.on_io = None
 
     # --------------------------------------------------------- packed rows
 
@@ -393,6 +466,13 @@ class MultiTierTable:
         healing probe chains and resetting insert_fails — when there was
         nothing to demote."""
         stats = TierStats()
+        # Serialize behind any in-flight background round: the worker owns
+        # the tier stores while running (HostKV is not thread-safe), and
+        # sync()'s own promote scan rediscovers anything the round found
+        # (the worker never erases), so pending candidates simply drop.
+        self._settle()
+        self._pending = None
+        stats.spilled += self._take_spilled()
         self._ensure_tiers(state)
         keys = np.asarray(state.keys)
         occ = keys != empty_key(self.table.cfg)
@@ -492,7 +572,7 @@ class MultiTierTable:
             out = order[:n_spill]
             self.disk.put(ks[out], vs[out], fs[out], vers[out])
             self.host.erase(ks[out])
-            stats.spilled = int(n_spill)
+            stats.spilled += int(n_spill)
 
         stats.host_size = len(self.host)
         stats.device_size = int(self.table.size(state))
@@ -500,12 +580,230 @@ class MultiTierTable:
             stats.disk_size = len(self.disk)
         return state, stats
 
+    # ------------------------------------------------------ overlapped sync
+
+    def sync_async(self, state: TableState, step: int,
+                   slot_fills: Optional[tuple] = None
+                   ) -> tuple[TableState, TierStats]:
+        """Overlapped tier migration: the caller pays only the device half
+        (demote selection + packed-row gather + rebuild, all dispatched
+        async; one live-count scalar read), while the HostKV/DiskKV IO —
+        demoted-row puts, the promote scan, the disk spill — runs on a
+        background thread that overlaps the next K-step dispatches.
+
+        Double-buffered promotion: candidates the background round finds
+        are applied at the NEXT sync_async/drain boundary, re-validated
+        against the CURRENT device frequency so a key that trained past
+        its host copy during the overlap window is never clobbered
+        (ambiguous keys keep their tier copy and retry next round).
+        Rounds serialize — entering a new round first drains the previous
+        one. Not for concurrent use with lookup_with_fallback mid-round
+        (serving readers must drain() first)."""
+        t0 = time.perf_counter()
+        stats = TierStats()
+        self._ensure_tiers(state)
+        state, stats.promoted = self._apply_pending(state)
+        stats.spilled = self._take_spilled()  # last round's host->disk moves
+
+        C = state.capacity
+        live = int(self.table.size(state))  # the one host-side scalar read
+        demote_pkg = None
+        if live > int(self.high * C):
+            from deeprec_tpu.ops.compact import quantize_rows
+
+            n_out = live - int(self.low * C)
+            size = quantize_rows(n_out, C)
+            ext = _demote_extract_jit(
+                self.table, size, state, jnp.asarray(n_out, jnp.int32)
+            )
+            keep = ext.pop("keep")
+            state = self.table.rebuild(
+                state, keep=keep,
+                slot_fills=tuple(slot_fills) if slot_fills else self.slot_fills,
+            )
+            demote_pkg = (ext, n_out)
+            stats.demoted = n_out
+        snap = _tier_snapshot_jit(state)
+        # Sizes reflect the boundary, not the in-flight round — and must be
+        # read BEFORE the worker starts mutating the (not thread-safe)
+        # stores: demoted rows land in the host tier (and any spill
+        # happens) while training runs, visible at the next boundary.
+        stats.host_size = len(self.host)
+        stats.device_size = live - stats.demoted
+        if self.disk is not None:
+            stats.disk_size = len(self.disk)
+        self._worker = threading.Thread(
+            target=self._worker_main, args=(demote_pkg, snap), daemon=True,
+            name=f"tier-io-{self.table.cfg.name}-{step}",
+        )
+        self._worker.start()
+        self.sync_stall_ms += (time.perf_counter() - t0) * 1e3
+        return state, stats
+
+    def join(self) -> None:
+        """Wait for the in-flight background round WITHOUT applying its
+        promotions (shutdown/teardown). Pending candidates stay queued for
+        the next boundary — nothing is lost: the worker never erases tier
+        rows, so a discarded round leaves every copy where it was."""
+        t = self._worker
+        if t is not None:
+            t.join()
+            self._worker = None
+
+    def _settle(self) -> None:
+        """join() + surface a worker failure (the error-checked barrier
+        every tier-store access goes through)."""
+        self.join()
+        err, self._worker_err = self._worker_err, None
+        if err is not None:
+            raise RuntimeError(f"tier IO worker failed: {err}") from err
+
+    def _take_spilled(self) -> int:
+        """Host->disk spill count of the last background round (the worker
+        records it; TierStats surfaces it at the next boundary)."""
+        n, self._spilled_bg = getattr(self, "_spilled_bg", 0), 0
+        return n
+
+    def drain(self, state: TableState) -> tuple[TableState, TierStats]:
+        """Finish the in-flight background round and apply its promotions
+        now (checkpoint/serving boundaries). No-op when idle."""
+        t0 = time.perf_counter()
+        stats = TierStats()
+        state, stats.promoted = self._apply_pending(state)
+        stats.spilled = self._take_spilled()
+        stats.host_size = len(self.host) if self.host is not None else 0
+        stats.device_size = int(self.table.size(state))
+        if self.disk is not None:
+            stats.disk_size = len(self.disk)
+        self.sync_stall_ms += (time.perf_counter() - t0) * 1e3
+        return state, stats
+
+    def _worker_main(self, demote_pkg, snap) -> None:
+        """Background IO round: put demoted rows, scan for promotion
+        candidates against the post-rebuild key snapshot, spill host
+        overflow. READ-only on promotion sources — erasure happens at
+        apply time on the training thread."""
+        try:
+            if self.on_io is not None:
+                self.on_io()  # test seam (ordering-based overlap tests)
+            if demote_pkg is not None:
+                ext, n_out = demote_pkg
+                self.host.put(
+                    np.asarray(ext["keys"])[:n_out].astype(np.int64),
+                    np.asarray(ext["rows"])[:n_out],
+                    np.asarray(ext["freqs"])[:n_out],
+                    np.asarray(ext["versions"])[:n_out],
+                )
+            keys_snap = np.asarray(snap[0])
+            freq_snap = np.asarray(snap[1])
+            occ = keys_snap != empty_key(self.table.cfg)
+            dev_keys = keys_snap[occ].astype(np.int64)
+            pending = None
+            if len(dev_keys):
+                h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
+                from_disk = np.zeros(len(dev_keys), bool)
+                if self.disk is not None and (~found).any():
+                    miss = ~found
+                    d_vals, d_freq, d_ver, d_found = self.disk.get(
+                        dev_keys[miss]
+                    )
+                    if d_found.any():
+                        mix = np.nonzero(miss)[0][d_found]
+                        h_vals[mix] = d_vals[d_found]
+                        h_freq[mix] = d_freq[d_found]
+                        h_ver[mix] = d_ver[d_found]
+                        found[mix] = True
+                        from_disk[mix] = True
+                if found.any():
+                    pending = {
+                        "keys": dev_keys[found],
+                        "rows": h_vals[found],
+                        "freqs": h_freq[found],
+                        "snap_freq": freq_snap[occ][found],
+                        "from_disk": from_disk[found],
+                    }
+            self._pending = pending
+            # spill: bounded host tier overflows to the disk tier
+            if (
+                self.disk is not None
+                and self.host_capacity
+                and len(self.host) > self.host_capacity
+            ):
+                n_spill = len(self.host) - self.host_capacity
+                ks, vs, fs, vers = self.host.export()
+                order = (
+                    np.argsort(vers) if self.cache_strategy == "lru"
+                    else np.argsort(fs)
+                )
+                out = order[:n_spill]
+                self.disk.put(ks[out], vs[out], fs[out], vers[out])
+                self.host.erase(ks[out])
+                self._spilled_bg = int(n_spill)
+        except BaseException as e:
+            self._worker_err = e
+
+    def _apply_pending(self, state: TableState) -> tuple[TableState, int]:
+        """Drain the worker and apply its promotion candidates, re-checked
+        against the CURRENT device freq. Erasure rules (sync() parity, one
+        round late): promoted -> tier copies dropped; device-already-newer
+        at snapshot time -> stale copy dropped; ambiguous (device passed
+        the host copy DURING the overlap) -> tier copy kept for the next
+        round rather than clobbering fresh training."""
+        from deeprec_tpu.ops.compact import quantize_rows
+
+        self._settle()
+        r, self._pending = self._pending, None
+        if not r:
+            return state, 0
+        keys = r["keys"]
+        n = len(keys)
+        # pow2-bucketed probe so recurring applies reuse compiled shapes
+        m = quantize_rows(n, state.capacity, floor=8)
+        sent = empty_key(self.table.cfg)
+        kp = np.full((m,), sent, np.dtype(state.keys.dtype))
+        kp[:n] = keys
+        from deeprec_tpu.embedding.table import probe_jit
+
+        _, slot_ix, _, _ = probe_jit(
+            self.table, state.keys, jnp.asarray(kp), jnp.zeros((m,), bool)
+        )
+        slot_ix = np.asarray(slot_ix)[:n]
+        present = slot_ix >= 0
+        freq_now = np.asarray(
+            state.freq[jnp.asarray(np.where(present, slot_ix, 0))]
+        )
+        refreshed = present & (freq_now <= r["freqs"])
+        stale = present & ~refreshed & (r["snap_freq"] > r["freqs"])
+        k = int(refreshed.sum())
+        if k:
+            mm = quantize_rows(k, state.capacity, floor=8)
+            ixp = np.full((mm,), -1, np.int32)
+            ixp[:k] = slot_ix[refreshed]
+            rowsp = np.zeros((mm, r["rows"].shape[1]), np.float32)
+            rowsp[:k] = r["rows"][refreshed]
+            fp = np.zeros((mm,), np.int32)
+            fp[:k] = r["freqs"][refreshed]
+            state = self._unpack_rows(state, ixp, rowsp)  # -1 rows skipped
+            meta_ix = jnp.asarray(np.where(ixp >= 0, ixp, state.capacity))
+            state = state.replace(
+                meta=state.meta.at[META_FREQ, meta_ix].add(
+                    jnp.asarray(fp), mode="drop"
+                )
+            )
+        drop = refreshed | stale
+        if drop.any():
+            self.host.erase(keys[drop])
+            if self.disk is not None and (r["from_disk"] & drop).any():
+                self.disk.erase(keys[r["from_disk"] & drop])
+        return state, k
+
     # ------------------------------------------------------------- serving
 
     def lookup_with_fallback(self, state: TableState, ids) -> jnp.ndarray:
         """Readonly lookup that also consults the host tier (then the disk
         tier) for misses — the serving-path equivalent of HbmDram's
         CopyEmbeddingsFromCPUToGPU."""
+        self._settle()  # the worker owns the tier stores while a round runs
         emb = np.array(self.table.lookup_readonly(state, ids))  # writable copy
         if self.host is None and self.disk is None:  # nothing ever demoted
             return jnp.asarray(emb)
@@ -533,6 +831,7 @@ class MultiTierTable:
 
     def spill(self, path: Optional[str] = None) -> None:
         """Persist the host tier (and the disk tier's index)."""
+        self._settle()  # never snapshot mid-round
         if self.host is not None:
             self.host.save(path or self.storage_path or "host_tier.bin")
         if self.disk is not None:

@@ -157,19 +157,17 @@ def hash_dedup(
 
     # Budget compaction: the j-th occupied scratch slot (slot order) takes
     # dense index j in 1..size-1; the rest compact out as overflow.
-    # Deliberately scatter-free — a prefix-sum + searchsorted + gathers —
-    # because scatter is the expensive primitive here (an [S]-lane scatter
-    # measured ~50x a gather on CPU); the one remaining scatter is the
-    # [N]-lane counts segment-add.
+    # Deliberately scatter-free — the shared prefix-sum + searchsorted
+    # compaction (ops/compact.py, also behind the incremental-checkpoint
+    # dirty export) — because scatter is the expensive primitive here (an
+    # [S]-lane scatter measured ~50x a gather on CPU); the one remaining
+    # scatter is the [N]-lane counts segment-add.
+    from deeprec_tpu.ops.compact import rank_compact
+
     occ = scratch != sent  # [S]
-    rank = jnp.cumsum(occ.astype(jnp.int32))  # occupied slot -> 1-based rank
-    n_occ = rank[-1]
-    # uids[j] = the id in the slot of rank j: invert the monotone rank via
-    # binary search (j past n_occ resolves to S -> gated back to sentinel).
-    tail_j = jnp.arange(1, size, dtype=jnp.int32)
-    sel = jnp.searchsorted(rank, tail_j, side="left")
+    sel, n_occ, rank = rank_compact(occ, size - 1)
     uids_tail = jnp.where(
-        tail_j <= n_occ, scratch.at[sel].get(mode="clip"), sent
+        sel >= 0, scratch.at[sel].get(mode="clip"), sent
     )
     uids = jnp.concatenate([jnp.full((1,), sent, flat.dtype), uids_tail])
 

@@ -19,14 +19,28 @@ Parity with DeepRec's EV checkpoint machinery (SURVEY.md §3.3):
 
 Format: a directory per step, numpy .npz per table plus dense.npz and a JSON
 manifest. Host-side; runs at checkpoint cadence, not on the hot path.
+
+Off-the-hot-path choreography (round 9): every save is split into a STAGE
+half (device work only: for incremental saves a jitted dirty-row compaction
+so the device->host transfer scales with the dirty fraction, not capacity;
+for full saves a donation-safe device snapshot) and a WRITE half (host
+numpy materialization + npz IO + manifest-last commit). `save()` runs both
+on the caller; `save_async()` / `save_incremental_async()` run the write
+half on a background writer thread so the npz IO overlaps the next train
+dispatches — at most one save in flight, `wait()` drains it, and a killed
+writer leaves a manifest-less dir that `_list()` already ignores (the
+manifest stays the completeness marker).
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import re
 import shutil
-from typing import Dict, List, Optional, Tuple
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -228,6 +242,112 @@ def import_rows(
     )
 
 
+# ----------------------------------------- device-side dirty compaction
+
+import functools as _ft
+
+from deeprec_tpu.embedding.table import META_DIRTY, META_FREQ, META_VERSION
+
+
+@_ft.partial(jax.jit, static_argnums=(1,))
+def _dirty_count_jit(state: TableState, sentinel: int) -> jnp.ndarray:
+    """Occupied-and-dirty row count of one LOCAL table state — the one
+    scalar an incremental save reads from the device to size its
+    compacted export."""
+    occ = state.keys != jnp.asarray(sentinel, state.keys.dtype)
+    return jnp.sum(occ & (state.meta[META_DIRTY] != 0)).astype(jnp.int32)
+
+
+@_ft.partial(jax.jit, static_argnums=(1, 2))
+def _compact_dirty_jit(
+    state: TableState, sentinel: int, size: int
+) -> Dict[str, jnp.ndarray]:
+    """Compact one LOCAL table state's dirty rows ON DEVICE at static
+    budget `size` (ops/compact.py prefix-sum compaction, ascending slot
+    order — the same order the legacy host-side `np.nonzero` export
+    produced, so files stay byte-identical after truncation).
+
+    Everything returned is a FRESH buffer (jit outputs never alias
+    non-donated inputs), so an async writer can materialize it while the
+    training loop donates the live state through the next dispatches.
+    Rows past the true dirty count are garbage the host truncates; the
+    full key array rides along (`_all_keys`) for the delta's live set.
+    """
+    from deeprec_tpu.ops.compact import rank_compact
+    from deeprec_tpu.ops.packed import gather_rows_any
+
+    C = state.capacity
+    sent = jnp.asarray(sentinel, state.keys.dtype)
+    occ = state.keys != sent
+    dirty = occ & (state.meta[META_DIRTY] != 0)
+    idx, _, _ = rank_compact(dirty, size)
+    safe = jnp.where(idx >= 0, idx, 0)
+    out = {
+        "keys": jnp.where(idx >= 0, state.keys[safe], sent),
+        "values": gather_rows_any(state.values, safe, C),
+        "freqs": state.meta[META_FREQ, safe],
+        "versions": state.meta[META_VERSION, safe],
+        "_all_keys": jnp.copy(state.keys),
+    }
+    for sname, arr in state.slots.items():
+        key = "slot:" + sname
+        out[key] = (
+            gather_rows_any(arr, safe, C) if is_per_row(key)
+            else jnp.copy(arr)
+        )
+    if state.bloom is not None:
+        out["bloom"] = jnp.copy(state.bloom)
+    return out
+
+
+@jax.jit
+def _copy_tree(tree):
+    """Donation-safe device snapshot: fresh buffers for every leaf, so the
+    async writer's host copies survive the training loop donating the
+    originals (jnp.copy lowers to an XLA copy — outputs never alias)."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+def _prefetch_host(tree) -> None:
+    """Best-effort: start the device->host copies now so the writer
+    thread's np.asarray calls find the bytes already on their way."""
+    for leaf in jax.tree.leaves(tree):
+        fn = getattr(leaf, "copy_to_host_async", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                pass
+
+
+def _tree_bytes(tree) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype")
+    )
+
+
+@dataclasses.dataclass
+class _SavePlan:
+    """Everything the WRITE half needs, detached from the live TrainState:
+    device snapshots / compacted exports (fresh buffers), dataset positions
+    snapshotted at stage time (the training loop advances readers while an
+    async writer runs), and the manifest ingredients."""
+
+    path: str
+    kind: str
+    step: int
+    parts: bool
+    write: bool
+    state: Optional[TrainState]  # full saves: the (possibly snapshotted) state
+    incr: Optional[Dict[str, Dict[str, list]]]  # incr: bundle->tag->[(sid, arrays, n)]
+    dense: Any
+    opt_state: Any
+    positions: Optional[Dict[str, dict]]
+    stats: Dict[str, float]
+
+
 # -------------------------------------------------------- checkpoint manager
 
 
@@ -281,6 +401,19 @@ class CheckpointManager:
         self.keep = keep
         self.sharded_io = sharded_io
         self.datasets = dict(datasets or {})
+        # Async-writer state: at most one save in flight; wait() drains and
+        # re-raises. on_write is a test seam invoked in the writer thread
+        # before any file IO (crash/overlap injection).
+        self._writer: Optional[threading.Thread] = None
+        self._writer_err: Optional[Tuple[BaseException, str]] = None
+        self._force_full = False  # failed incr writer -> next save is full
+        self.on_write = None
+        # Stall/traffic accounting (bench.py, tools/bench_ckpt.py):
+        # ckpt_stall_ms accumulates CALLER-side blocking time across saves;
+        # last_save records {kind, path, async, stall_ms, transfer_bytes,
+        # write_ms (async, once the writer finishes)}.
+        self.ckpt_stall_ms: float = 0.0
+        self.last_save: Dict[str, Any] = {}
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------- helpers
@@ -454,6 +587,151 @@ class CheckpointManager:
             exports[tag] = merged
         return exports
 
+    # ------------------------------------- incremental staging (device half)
+
+    @staticmethod
+    def _local_device_block(leaf, k: int, s: int):
+        """One owned shard's block with the shard axis dropped, as a DEVICE
+        array (the np-returning `_local_block` is the full-transfer legacy
+        read; the compacted exporter must not pull [C_local, D] leaves to
+        the host just to pick a few dirty rows out of them)."""
+        for sh in leaf.addressable_shards:
+            if (sh.index[k].start or 0) == s:
+                return jnp.squeeze(sh.data, axis=k)
+        raise KeyError(f"shard {s} is not addressable on this process")
+
+    def _member_local_state(self, ts: TableState, m: Optional[int],
+                            s: Optional[int], k: int) -> TableState:
+        """LOCAL TableState view (device leaves) for member `m` of shard
+        `s` (None = unstacked / unsharded)."""
+        def get(leaf):
+            x = self._local_device_block(leaf, k, s) if s is not None else leaf
+            return x[m] if m is not None else x
+
+        return jax.tree.map(get, ts)
+
+    def _stage_incr(self, state: TrainState):
+        """Device half of an incremental save: per (bundle, member, shard),
+        read ONE dirty-count scalar, quantize it to a power-of-two budget
+        (ops/compact.quantize_rows — drift re-traces at most log2(C) times
+        per table) and run the jitted compaction. Returns
+        ({bundle: {tag: [(shard_id, device_arrays, n)]}}, transfer_bytes)
+        where transfer_bytes is what actually crosses device->host: the
+        padded compacted rows + the [C] key array per shard — dirty-
+        fraction-scaled, not capacity-scaled."""
+        from deeprec_tpu.ops.compact import quantize_rows
+
+        out: Dict[str, Dict[str, list]] = {}
+        jobs = []  # (pkgs-list, shard_id, sentinel, sub_state, count_device)
+        for bname, b in self.trainer.bundles.items():
+            ts = state.tables[bname]
+            sent = empty_key(b.table.cfg)
+            k = self._shard_axis(bname) if self._is_sharded() else 0
+            if not self._is_sharded():
+                sids: List[Optional[int]] = [None]
+            elif self._use_parts():
+                sids = list(self._owned_ids(ts.keys, k))
+            else:
+                sids = list(range(self.trainer.num_shards))
+            members = range(len(b.features)) if b.stacked else [None]
+            out[bname] = {}
+            for m in members:
+                tag = f"t{m}" if m is not None else "t"
+                pkgs: list = []
+                out[bname][tag] = pkgs
+                for s in sids:
+                    # Pass 1: dispatch every count (async) — the first
+                    # int() below drains the dispatch queue ONCE for all
+                    # of them instead of one flush per (bundle, member,
+                    # shard).
+                    sub = self._member_local_state(ts, m, s, k)
+                    jobs.append((pkgs, s, sent, sub,
+                                 _dirty_count_jit(sub, sent)))
+        total = 0
+        for pkgs, s, sent, sub, cnt in jobs:
+            n = int(cnt)
+            size = quantize_rows(n, sub.capacity)
+            arrays = _compact_dirty_jit(sub, sent, size)
+            total += _tree_bytes(arrays)
+            pkgs.append((s, arrays, n))
+        return out, total
+
+    # -------------------------------------- incremental assembly (IO half)
+
+    def _materialize_pkg(self, b, arrays: Dict[str, jnp.ndarray], n: int):
+        """One shard's staged compaction -> (row dict truncated to the true
+        dirty count, live keys, bloom, per-table scalar entries). Applies
+        the same save-time counter-filter drop as `export_table_arrays`, on
+        the already-small compacted arrays."""
+        cfg = b.table.cfg
+        np_arrays = {key: np.asarray(v) for key, v in arrays.items()}
+        all_keys = np_arrays.pop("_all_keys")
+        bloom = np_arrays.pop("bloom", None)
+        per_table = {
+            key: v for key, v in np_arrays.items()
+            if key.startswith("slot:") and not is_per_row(key)
+        }
+        rows = {
+            key: v[:n] for key, v in np_arrays.items() if key not in per_table
+        }
+        if (
+            not cfg.ev.ckpt.save_filtered_features
+            and cfg.ev.counter_filter is not None
+            and cfg.ev.counter_filter.filter_freq > 0
+        ):
+            keep = rows["freqs"] >= cfg.ev.counter_filter.filter_freq
+            rows = {key: v[keep] for key, v in rows.items()}
+        live = all_keys[all_keys != empty_key(cfg)]
+        return rows, live, bloom, per_table
+
+    def _assemble_incr(self, plan: _SavePlan, bname: str,
+                       parts: bool) -> Dict[str, Dict[str, np.ndarray]]:
+        """Merge a bundle's staged per-shard compactions into the exact
+        file layout the legacy host-side incremental export produced
+        (gathered single / gathered sharded / parts) — restore code is
+        untouched."""
+        b = self.trainer.bundles[bname]
+        exports = {}
+        for tag, pkgs in plan.incr[bname].items():
+            rows_list, live_list, blooms, offsets = [], [], [], [0]
+            per_table: Dict[str, np.ndarray] = {}
+            shard_ids = []
+            for sid, arrays, n in pkgs:
+                rows, live, bloom, scal = self._materialize_pkg(b, arrays, n)
+                rows_list.append(rows)
+                live_list.append(live)
+                if bloom is not None:
+                    blooms.append(bloom)
+                per_table.update(scal)
+                offsets.append(offsets[-1] + rows["keys"].shape[0])
+                shard_ids.append(sid)
+            if len(pkgs) == 1 and pkgs[0][0] is None:
+                # plain Trainer: single gathered file, no partition metadata
+                merged = {**rows_list[0], **per_table}
+                if blooms:
+                    merged["bloom"] = blooms[0]
+            else:
+                merged = {
+                    key: np.concatenate([r[key] for r in rows_list])
+                    for key in rows_list[0]
+                }
+                merged.update(per_table)
+                if blooms:
+                    merged["bloom_parts"] = np.stack(blooms)
+                merged["partition_offset"] = np.asarray(offsets, np.int64)
+                if parts:
+                    merged["shard_ids"] = np.asarray(shard_ids, np.int64)
+                    merged["num_shards"] = np.asarray(
+                        self.trainer.num_shards, np.int64
+                    )
+            merged["live_keys"] = (
+                np.concatenate(live_list)
+                if live_list
+                else np.empty((0,), rows_list[0]["keys"].dtype)
+            )
+            exports[tag] = merged
+        return exports
+
     def _clear_dirty(self, state: TrainState) -> TrainState:
         # Zero the META_DIRTY row of the fused metadata leaf; the columnar
         # multiply broadcasts over any leading (group/shard) axes and keeps
@@ -506,18 +784,174 @@ class CheckpointManager:
 
     def save_incremental(self, state: TrainState) -> Tuple[TrainState, str]:
         """Delta checkpoint: rows touched since the previous (full or incr)
-        save. The consumer replays deltas over the latest full save."""
+        save, compacted ON DEVICE so the device->host transfer scales with
+        the dirty fraction. The consumer replays deltas over the latest
+        full save."""
         return self._save(state, "incr")
 
+    # ------------------------------------------------------- async saves
+
+    def save_async(self, state: TrainState) -> Tuple[TrainState, str]:
+        """Full checkpoint with the write half on a background thread.
+
+        The caller-side cost is the device snapshot dispatch (fresh
+        buffers, so later donation of the live state cannot touch them)
+        plus starting the host copies; np.savez + manifest run on the
+        writer while the next dispatches train. Returns immediately with
+        (dirty-cleared state, path); the checkpoint is durable only once
+        `wait()` returns — a crash mid-write leaves a manifest-less dir
+        that restore ignores (the existing crash contract). At most one
+        save is in flight: a second save_*_async first drains the first.
+        Transiently holds one extra device-side copy of the tables;
+        multi-process runs fall back to the synchronous path (the barrier
+        choreography must run on the dispatch thread)."""
+        return self._save_async(state, "full")
+
+    def save_incremental_async(self, state: TrainState) -> Tuple[TrainState, str]:
+        """Delta checkpoint off the training thread: the device-compacted
+        dirty rows (small, dirty-fraction-sized buffers) are staged on the
+        caller, the npz write happens on the writer thread."""
+        return self._save_async(state, "incr")
+
+    def _save_async(self, state: TrainState, kind: str) -> Tuple[TrainState, str]:
+        if jax.process_count() > 1:
+            # sync_global_devices from a writer thread would interleave
+            # with the training thread's collectives — degrade to the
+            # synchronous multi-host path, which is already correct.
+            return self._save(state, kind)
+        self.wait()  # at most one save in flight
+        kind = self._effective_kind(kind)
+        t0 = time.perf_counter()
+        plan = self._stage(state, kind, snapshot=True)
+        # Account (and rebind last_save) BEFORE the writer starts: a fast
+        # writer could otherwise finish and stamp write_ms into the
+        # PREVIOUS save's record right as this one replaces it.
+        record = self._account(plan, t0, background=True)
+        self._writer = threading.Thread(
+            target=self._writer_main, args=(plan, record), daemon=True,
+            name=f"ckpt-writer-{kind}-{plan.step}",
+        )
+        self._writer.start()
+        return self._clear_dirty(state), plan.path
+
+    def _writer_main(self, plan: _SavePlan, record: Dict[str, Any]) -> None:
+        try:
+            if self.on_write is not None:
+                self.on_write(plan.path)  # test seam (crash/overlap tests)
+            t0 = time.perf_counter()
+            self._write_plan(plan)
+            record["write_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            if plan.kind == "full":
+                self._force_full = False  # chain re-anchored durably
+        except BaseException as e:  # surfaced by wait()/next save/restore
+            self._writer_err = (e, plan.kind)
+
+    def wait(self) -> None:
+        """Drain the in-flight async save, if any. Until this returns the
+        checkpoint is not durable (no manifest). Re-raises a writer
+        failure — after which the half-written dir has no manifest and is
+        invisible to restore, exactly like a crash. A failed INCREMENTAL
+        writer additionally escalates the next save to FULL: that delta's
+        dirty bits were already cleared on the training thread, so only a
+        full re-anchor can put its rows back in the chain."""
+        t = getattr(self, "_writer", None)
+        if t is not None:
+            t.join()
+            self._writer = None
+        err = getattr(self, "_writer_err", None)
+        self._writer_err = None
+        if err is not None:
+            e, kind = err
+            if kind == "incr":
+                self._force_full = True
+            raise RuntimeError(f"async checkpoint writer failed: {e}") from e
+
+    def close(self) -> None:
+        self.wait()
+
+    def _effective_kind(self, kind: str) -> str:
+        if kind == "incr" and getattr(self, "_force_full", False):
+            return "full"  # see wait(): a lost delta voids the incr chain
+        return kind
+
+    # ------------------------------------------------------- save halves
+
     def _save(self, state: TrainState, kind: str) -> Tuple[TrainState, str]:
+        self.wait()  # serialize behind any in-flight async save
+        kind = self._effective_kind(kind)
+        t0 = time.perf_counter()
+        plan = self._stage(state, kind, snapshot=False)
+        self._write_plan(plan)
+        if kind == "full":
+            self._force_full = False
+        self._account(plan, t0, background=False)
+        return self._clear_dirty(state), plan.path
+
+    def _account(self, plan: _SavePlan, t0: float,
+                 background: bool) -> Dict[str, Any]:
+        stall = (time.perf_counter() - t0) * 1e3
+        self.ckpt_stall_ms = getattr(self, "ckpt_stall_ms", 0.0) + stall
+        self.last_save = {
+            "kind": plan.kind, "path": plan.path, "async": background,
+            "stall_ms": round(stall, 3), **plan.stats,
+        }
+        return self.last_save
+
+    def _stage(self, state: TrainState, kind: str, snapshot: bool) -> _SavePlan:
+        """Device half of a save: everything that must read the live state.
+        With snapshot=True every carried array is a FRESH buffer (device
+        copies / jit outputs), so the plan stays valid while the training
+        loop donates the live state through subsequent dispatches."""
         step = int(state.step)
         path = os.path.join(self.dir, f"{kind}-{step}")
-        write = self._is_writer()
-        parts = self._use_parts()
         # The manifest at this path is about to change (clear + rewrite);
         # drop any cached copy so a later restore() on this manager
         # validates against the new one.
         getattr(self, "_manifest_cache", {}).pop(path, None)
+        write = self._is_writer()
+        parts = self._use_parts()
+        positions = (
+            {name: r.save() for name, r in self.datasets.items()}
+            if self.datasets else None
+        )
+        incr = None
+        snap_state = state
+        if kind == "incr" and jax.process_count() > 1 and not parts:
+            # Explicit sharded_io=False on a multi-process run: shards this
+            # process cannot address have no device-local block to compact.
+            # Keep the legacy gathered export (process_allgather + host
+            # dirty mask) — correctness over the transfer diet here.
+            transfer = _tree_bytes(state.tables)
+        elif kind == "incr":
+            incr, transfer = self._stage_incr(state)
+            snap_state = None
+        elif snapshot:
+            snap_state = TrainState(
+                step=state.step, tables=_copy_tree(state.tables),
+                dense=state.dense, opt_state=state.opt_state,
+            )
+            transfer = _tree_bytes(snap_state.tables)
+        else:
+            transfer = _tree_bytes(state.tables)
+        dense = _copy_tree(state.dense) if snapshot else state.dense
+        opt = _copy_tree(state.opt_state) if snapshot else state.opt_state
+        transfer += _tree_bytes(dense) + _tree_bytes(opt)
+        if snapshot:
+            _prefetch_host(snap_state.tables if snap_state is not None else incr)
+            _prefetch_host((dense, opt))
+        return _SavePlan(
+            path=path, kind=kind, step=step, parts=parts, write=write,
+            state=snap_state, incr=incr, dense=dense, opt_state=opt,
+            positions=positions, stats={"transfer_bytes": int(transfer)},
+        )
+
+    def _write_plan(self, plan: _SavePlan) -> None:
+        """Host half of a save: materialize, write npz files, commit the
+        manifest LAST (completeness marker), GC. Runs on the caller (sync)
+        or the writer thread (async — single-process only, so every
+        `_sync` below is a no-op there)."""
+        path, kind, step = plan.path, plan.kind, plan.step
+        write, parts = plan.write, plan.parts
         try:
             if write or parts or self.datasets:
                 os.makedirs(path, exist_ok=True)
@@ -554,8 +988,10 @@ class CheckpointManager:
                         os.remove(stale)
                 self._sync(f"ckpt-{kind}-{step}-clear")
                 for bname in self.trainer.bundles:
-                    exported = self._export_bundle_parts(
-                        state, bname, kind == "incr"
+                    exported = (
+                        self._assemble_incr(plan, bname, parts=True)
+                        if kind == "incr"
+                        else self._export_bundle_parts(plan.state, bname, False)
                     )
                     for tag, arrays in exported.items():
                         np.savez(
@@ -564,14 +1000,22 @@ class CheckpointManager:
                             ),
                             **arrays,
                         )
-                self._write_datasets(path)
+                self._write_positions(path, plan.positions)
                 # The manifest is the completeness marker (_list() ignores
                 # dirs without one): it must not exist until every process
                 # has finished writing its part files AND dataset positions.
                 self._sync(f"ckpt-{kind}-{step}-parts")
             else:
                 for bname in self.trainer.bundles:
-                    exported = self._export_bundle(state, bname, kind == "incr")
+                    exported = (
+                        self._assemble_incr(plan, bname, parts=False)
+                        if plan.incr is not None
+                        # plan.incr None + kind incr = the multi-process
+                        # gathered fallback: legacy host-side dirty mask
+                        else self._export_bundle(
+                            plan.state, bname, kind == "incr"
+                        )
+                    )
                     for tag, arrays in exported.items():
                         if write:
                             np.savez(
@@ -581,13 +1025,13 @@ class CheckpointManager:
             if not parts:
                 # parts mode wrote positions before its pre-manifest
                 # barrier above; the gathered path writes them here.
-                self._write_datasets(path)
+                self._write_positions(path, plan.positions)
                 self._sync(f"ckpt-{kind}-{step}-datasets")
             if write:
                 np.savez(os.path.join(path, "dense.npz"),
-                         **_tree_to_npz_dict(state.dense))
+                         **_tree_to_npz_dict(plan.dense))
                 np.savez(os.path.join(path, "opt.npz"),
-                         **_tree_to_npz_dict(state.opt_state))
+                         **_tree_to_npz_dict(plan.opt_state))
                 manifest = {"step": step, "kind": kind}
                 if parts:
                     manifest["format"] = "parts"
@@ -600,8 +1044,9 @@ class CheckpointManager:
                     }
                 with open(os.path.join(path, "manifest.json"), "w") as f:
                     json.dump(manifest, f)
-                if kind == "full":
-                    self._gc()
+                # GC after BOTH kinds: full saves age out old fulls, and
+                # either kind sweeps incr dirs orphaned by an aged-out base.
+                self._gc()
         finally:
             # The barrier must be reached even if the writer's I/O raises:
             # without it every other process blocks in sync_global_devices
@@ -609,20 +1054,21 @@ class CheckpointManager:
             # remaining gathers — that fails loudly at the runtime level,
             # which beats a silent deadlock.)
             self._sync(f"ckpt-{kind}-{step}")
-        return self._clear_dirty(state), path
 
-    def _write_datasets(self, path: str) -> None:
+    def _write_positions(self, path: str,
+                         positions: Optional[Dict[str, dict]]) -> None:
         """Every process writes its OWN readers' positions
-        (dataset-state-in-checkpoint, KafkaDataset parity)."""
-        if not self.datasets:
+        (dataset-state-in-checkpoint, KafkaDataset parity). The positions
+        were snapshotted at STAGE time — an async writer must record where
+        the readers were when the checkpointed state was captured, not
+        wherever the still-running training loop has advanced them to."""
+        if not positions:
             return
         dpath = os.path.join(
             path, f"datasets.part{jax.process_index():05d}.json"
         )
         with open(dpath, "w") as f:
-            json.dump(
-                {name: r.save() for name, r in self.datasets.items()}, f
-            )
+            json.dump(positions, f)
 
     # ------------------------------------------------------------- restore
 
@@ -646,6 +1092,7 @@ class CheckpointManager:
         Sharded multi-process trainers stream per-shard: each process reads
         the row files and keeps only keys its shards own — no global
         gather, no host-side global materialization."""
+        self.wait()  # an in-flight async save must land (or fail) first
         full_step = self.latest_full()
         if full_step is None:
             raise FileNotFoundError(f"no full checkpoint under {self.dir}")
@@ -1079,11 +1526,22 @@ class CheckpointManager:
     # ----------------------------------------------------------------- gc
 
     def _gc(self):
+        if self.keep <= 0:
+            return  # keep everything (legacy contract)
         fulls = self._list("full")
-        for s in fulls[: -self.keep] if self.keep > 0 else []:
+        for s in fulls[: -self.keep]:
             shutil.rmtree(os.path.join(self.dir, f"full-{s}"), ignore_errors=True)
-            for i in self._list("incr"):
-                if i <= s:
-                    shutil.rmtree(
-                        os.path.join(self.dir, f"incr-{i}"), ignore_errors=True
-                    )
+        fulls = fulls[-self.keep:]
+        if not fulls:
+            return
+        # Incr dirs whose base full aged out of `keep` are orphaned: a
+        # delta at step s only ever replays over a full with step < s, and
+        # the oldest such full left is fulls[0] — without this sweep a
+        # long run accumulates unbounded incr directories between every
+        # pair of long-dead fulls (deltas newer than a KEPT full stay:
+        # they are that full's replay chain).
+        for i in self._list("incr"):
+            if i <= fulls[0]:
+                shutil.rmtree(
+                    os.path.join(self.dir, f"incr-{i}"), ignore_errors=True
+                )

@@ -77,6 +77,16 @@ class PhaseProfiler:
             jax.block_until_ready(out)
         return out
 
+    def record(self, name: str, seconds: float) -> None:
+        """Fold an externally measured duration into phase `name` — the
+        entry point for HOST phases whose cost is accounted elsewhere:
+        checkpoint stalls (CheckpointManager.last_save["stall_ms"]),
+        multi-tier sync stalls (MultiTierTable.sync_stall_ms), writer
+        drain time. These subsystems time themselves (their stalls span
+        their own internal sync points), so the profiler takes the number
+        instead of wrapping the call."""
+        self._times.setdefault(name, []).append(float(seconds))
+
     def reset(self) -> None:
         self._times.clear()
 

@@ -785,6 +785,7 @@ class Trainer:
         max_capacity: Optional[int] = None,
         hbm_budget_bytes: Optional[int] = None,
         step: Optional[int] = None,
+        tier_async: bool = False,
     ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
         """Close the capacity loop DeepRec's tables close implicitly
         (embedding_var.h:142 LookupOrCreateKey never refuses a key): consume
@@ -804,6 +805,12 @@ class Trainer:
         cold rows demote to the host store instead of the table growing.
         This is the automated device-placement decision (the reference
         places oversized EVs on CPU by hand; DeepRec multi_tier_storage.h).
+
+        tier_async=True overlaps each member tier's HostKV/DiskKV IO with
+        the next dispatches (MultiTierTable.sync_async): maintain() pays
+        only the device-side extraction, promotions found in the
+        background land at the NEXT maintain() boundary. Capacity-
+        pressure syncs (hbm_budget_bytes force path) stay synchronous.
         """
         import numpy as np
 
@@ -841,7 +848,7 @@ class Trainer:
             )
             if multi_tier:
                 members, demoted, promoted = self._tier_sync(
-                    b, idxs, members, step
+                    b, idxs, members, step, tier_async=tier_async
                 )
                 rep.update(demoted=demoted, promoted=promoted)
                 ts = self._restack(members, lead)
@@ -891,18 +898,32 @@ class Trainer:
         )
 
     def _tier_sync(self, b: Bundle, idxs, members, step: int,
-                   force: bool = False):
+                   force: bool = False, tier_async: bool = False):
         """Run the host-tier sync over every member state; returns
-        (members, total_demoted, total_promoted)."""
+        (members, total_demoted, total_promoted). tier_async=True routes
+        through MultiTierTable.sync_async — the HostKV/DiskKV IO of every
+        member overlaps the next dispatches, promotions land at the next
+        maintain() boundary. Capacity-pressure syncs (force=True) stay
+        synchronous: the caller needs the healed table NOW."""
         demoted = promoted = 0
         members = list(members)
         for k, (i, m) in enumerate(zip(idxs, members)):
             mt = self._multi_tier_for(b, i)
-            m, stats = mt.sync(m, step, force=force)
+            if tier_async and not force:
+                m, stats = mt.sync_async(m, step)
+            else:
+                m, stats = mt.sync(m, step, force=force)
             members[k] = m
             demoted += stats.demoted
             promoted += stats.promoted
         return members, demoted, promoted
+
+    def tier_stall_ms(self) -> float:
+        """Accumulated caller-side multi-tier sync stall across every
+        member tier (bench.py `sync_stall_ms` accounting)."""
+        return sum(
+            mt.sync_stall_ms for mt in getattr(self, "_tiers", {}).values()
+        )
 
     def _restack(self, members, lead):
         """Reassemble member states into the bundle's stacked layout."""

@@ -88,6 +88,44 @@ def test_stage_overlaps_io_with_compute():
     # MINIMUM delay).
 
 
+def test_prefetcher_sharding_places_on_mesh():
+    """The bare `staged()` default lands batches on device 0 (then a
+    sharded step re-transfers them); the `sharding=` knob threads the mesh
+    placement through the DEFAULT transform so the staged transfer lands
+    already split. Pins both placements, and that Trainer.stage's ring
+    (the auto path) stays mesh-placed end to end."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeprec_tpu.data.prefetch import staged
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh
+
+    mesh = make_mesh()
+    gen = SyntheticCriteo(batch_size=32, num_cat=4, num_dense=2, vocab=100)
+
+    # default transform: everything on ONE device (the confirmed hazard)
+    ring = staged(iter([gen.batch()]))
+    b0 = next(ring)
+    ring.close()
+    assert {len(v.sharding.device_set) for v in b0.values()} == {1}
+
+    # sharding= threads the mesh through the default transform
+    sh = NamedSharding(mesh, P("data"))
+    ring = staged(iter([gen.batch()]), sharding=sh)
+    b1 = next(ring)
+    ring.close()
+    assert all(v.sharding == sh for v in b1.values())
+
+    # the auto-stage ring (Trainer.stage) places mesh-wide via its own
+    # transform — batches delivered by the ring are split over every device
+    tr = ShardedTrainer(small_wdl(), Adagrad(lr=0.1), mesh=mesh)
+    ring = tr.stage(iter([gen.batch()]))
+    b2 = next(ring)
+    ring.close()
+    assert {len(v.sharding.device_set) for v in b2.values()} == {
+        mesh.devices.size
+    }
+
+
 def test_sharded_stage_places_on_mesh():
     from deeprec_tpu.parallel import ShardedTrainer, make_mesh
 

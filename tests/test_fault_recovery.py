@@ -73,6 +73,77 @@ WORKER = textwrap.dedent(
 )
 
 
+def test_async_writer_killed_mid_save_restores_prior_chain(tmp_path):
+    """A writer that dies MID-SAVE (some table files on disk, no manifest)
+    must be invisible to restore: the manifest is the completeness marker,
+    so the torn dir is skipped and restore() falls back to the previous
+    full+incr chain BIT-EXACTLY. Deterministic kill via the writer's
+    pre-IO seam — the 'files written then death' state is staged by the
+    seam itself, which is exactly what a SIGKILL between two np.savez
+    calls leaves behind."""
+    import jax
+    import numpy as np
+    import optax
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    def mk():
+        model = WDL(emb_dim=4, capacity=1 << 10, hidden=(16,), num_cat=2,
+                    num_dense=2)
+        return Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3))
+
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=128, num_cat=2, num_dense=2, vocab=400,
+                          seed=0)
+
+    def step(tr, st):
+        return tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in gen.batch().items()})[0]
+
+    import jax.numpy as jnp
+
+    tr = mk()
+    st = tr.init(0)
+    ck = CheckpointManager(str(tmp_path), tr)
+    for _ in range(2):
+        st = step(tr, st)
+    st, _ = ck.save(st)                      # full @2
+    st = step(tr, st)
+    st, _ = ck.save_incremental(st)          # incr @3 — the good chain
+    good = CheckpointManager(str(tmp_path), mk()).restore()
+
+    st = step(tr, st)
+
+    def killed_writer(path):
+        # the partial state a mid-save kill leaves: dir created, a real
+        # table file already on disk, manifest never written
+        os.makedirs(path, exist_ok=True)
+        bname = next(iter(tr.bundles))
+        np.savez(os.path.join(path, f"table_{bname}_t0.npz"),
+                 junk=np.zeros(3))
+        raise KeyboardInterrupt("simulated SIGKILL")
+
+    ck.on_write = killed_writer
+    st, torn = ck.save_incremental_async(st)
+    with pytest.raises(RuntimeError, match="writer failed"):
+        ck.wait()
+    assert not os.path.exists(os.path.join(torn, "manifest.json"))
+    assert os.path.exists(torn)  # torn dir IS there — and must be ignored
+
+    restored = CheckpointManager(str(tmp_path), mk()).restore()
+    assert int(restored.step) == int(good.step) == 3
+    for bname in tr.bundles:
+        for name in ("keys", "meta", "values"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(good.tables[bname], name)),
+                np.asarray(getattr(restored.tables[bname], name)),
+            )
+
+
 @pytest.mark.slow
 def test_sigkill_mid_training_resumes_and_completes(tmp_path):
     ckpt = str(tmp_path / "ckpt")

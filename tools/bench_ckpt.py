@@ -1,0 +1,173 @@
+"""Microbenchmark: checkpoint / multi-tier choreography stalls, sync vs async.
+
+Measures what the round-9 off-the-hot-path work actually moved off the
+training thread (`training/checkpoint.py`, `embedding/multi_tier.py`):
+
+  * save rows[] — per (capacity, dirty_fraction): the training-thread
+    stall of an incremental save on the synchronous path vs the async
+    writer (stage-only), the background writer's own write time, and the
+    device->host transfer bytes of the dirty-compacted export next to the
+    full-table bytes the legacy exporter pulled (`compaction_reduction`
+    is the diet; it should track 1 - dirty_fraction up to pow2 padding
+    and the [C] key array).
+  * full_save — sync-vs-async stall for a full checkpoint (the async win
+    here is the npz IO, not the transfer: full saves move every row).
+  * tier — MultiTierTable.sync() vs sync_async(): caller-side stall of a
+    demotion burst (the sync path pulls full [C, D] values + slots to the
+    host; the async path gathers the demoted rows on device and hands the
+    HostKV IO to a background round).
+
+Prints ONE JSON line (the bench.py convention). `--smoke` shrinks the grid
+so CI merely proves both paths work (cibuild/run_tests.sh).
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench_save(capacity, dirty_frac, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=16, capacity=capacity, hidden=(32,), num_cat=4,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1))
+    st = tr.init(0)
+    rng = np.random.default_rng(0)
+    fill = int(capacity * 0.5)
+
+    def batch(n_ids, seed_ids):
+        ids = seed_ids.astype(np.int32)
+        b = {f"C{i+1}": jnp.asarray(ids) for i in range(4)}
+        b["I1"] = jnp.asarray(rng.standard_normal((n_ids, 1)).astype(np.float32))
+        b["I2"] = jnp.asarray(rng.standard_normal((n_ids, 1)).astype(np.float32))
+        b["label"] = jnp.asarray((rng.random(n_ids) < 0.5).astype(np.float32))
+        return b
+
+    # fill ~half the table, take a full save so dirty bits clear
+    st, mets = tr.train_step(st, batch(fill, np.arange(fill)))
+    jax.block_until_ready(mets["loss"])
+    tmp = tempfile.mkdtemp(prefix="deeprec_bench_ckpt_")
+    try:
+        out = {"capacity": capacity, "dirty_fraction": dirty_frac}
+        ck = CheckpointManager(os.path.join(tmp, "s"), tr)
+        st, _ = ck.save(st)
+        full_bytes = ck.last_save["transfer_bytes"]
+
+        sync_ms, async_ms, write_ms, incr_bytes = [], [], [], 0
+        for r in range(reps):
+            n_dirty = max(1, int(fill * dirty_frac))
+            ids = rng.choice(fill, size=n_dirty, replace=False)
+            st, mets = tr.train_step(st, batch(n_dirty, ids))
+            jax.block_until_ready(mets["loss"])
+            st_s, _ = ck.save_incremental(st)
+            sync_ms.append(ck.last_save["stall_ms"])
+            incr_bytes = ck.last_save["transfer_bytes"]
+            # async from the SAME pre-clear state: identical delta
+            cka = CheckpointManager(os.path.join(tmp, f"a{r}"), tr)
+            st, _ = cka.save_incremental_async(st)
+            async_ms.append(cka.last_save["stall_ms"])
+            cka.wait()
+            write_ms.append(cka.last_save.get("write_ms", 0.0))
+            st = st_s  # keep ONE cleared lineage so deltas stay comparable
+        out.update(
+            sync_stall_ms=round(min(sync_ms), 3),
+            async_stall_ms=round(min(async_ms), 3),
+            writer_ms=round(min(write_ms), 3),
+            incr_transfer_bytes=int(incr_bytes),
+            full_transfer_bytes=int(full_bytes),
+            compaction_reduction=round(1.0 - incr_bytes / full_bytes, 4),
+        )
+        # full save, both ways
+        t0 = time.perf_counter()
+        st, _ = ck.save(st)
+        out["full_sync_stall_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        ckf = CheckpointManager(os.path.join(tmp, "af"), tr)
+        t0 = time.perf_counter()
+        st, _ = ckf.save_async(st)
+        out["full_async_stall_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        ckf.wait()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_tier(capacity, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.config import (
+        EmbeddingVariableOption, StorageOption, TableConfig,
+    )
+    from deeprec_tpu.embedding.multi_tier import MultiTierTable
+    from deeprec_tpu.embedding.table import EmbeddingTable
+
+    def run(use_async):
+        best = float("inf")
+        for _ in range(reps):
+            cfg = TableConfig(
+                name="bench_tier", dim=16, capacity=capacity,
+                ev=EmbeddingVariableOption(storage=StorageOption(
+                    storage_type="hbm_dram")),
+            )
+            t = EmbeddingTable(cfg)
+            mt = MultiTierTable(t, high_watermark=0.7, low_watermark=0.5)
+            s = t.create()
+            s, res = t.lookup_unique(
+                s, jnp.arange(int(capacity * 0.85), dtype=jnp.int32), step=0
+            )
+            jax.block_until_ready(res.embeddings)
+            t0 = time.perf_counter()
+            s, stats = (mt.sync_async(s, 1) if use_async else mt.sync(s, 1))
+            best = min(best, (time.perf_counter() - t0) * 1e3)
+            assert stats.demoted > 0
+            if use_async:
+                mt.drain(s)
+        return round(best, 3)
+
+    return {
+        "capacity": capacity,
+        "sync_stall_ms": run(False),
+        "async_stall_ms": run(True),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="fast CI grid: one shape, one rep")
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+    reps = 1 if args.smoke else max(1, args.reps)
+    caps = [1 << 13] if args.smoke else [1 << 14, 1 << 16]
+    fracs = [0.05] if args.smoke else [0.01, 0.05, 0.25]
+
+    rows = [
+        _bench_save(c, f, reps) for c in caps for f in fracs
+    ]
+    tier = _bench_tier(caps[0], reps)
+    import jax
+
+    print(json.dumps({
+        "metric": "ckpt_choreography_stall_ms",
+        "device": jax.devices()[0].platform,
+        "save": rows,
+        "tier": tier,
+    }))
+
+
+if __name__ == "__main__":
+    main()
