@@ -39,6 +39,9 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --traffic --smoke
 echo "== checkpoint choreography microbench (CPU smoke: sync + async paths) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
 
+echo "== serving bench (CPU smoke: single + group dispatch, delta update mid-load, /v1/stats) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py \
     | tee /tmp/deeprec_bench_smoke.out

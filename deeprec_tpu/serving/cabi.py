@@ -24,8 +24,9 @@ Config JSON accepted by :func:`create_server` (= the C ``model_config``):
       "model": "wdl",                  # modelzoo registry name
       "ckpt_dir": "/path/to/ckpts",    # required
       "model_args": {"emb_dim": 16, "capacity": 1048576},
-      "max_batch": 256,                # ModelServer coalescing bucket cap
-      "max_wait_ms": 2.0,
+      "max_batch": 256,                # ModelServer coalescing cap (ROWS)
+      "max_wait_ms": 2.0,              # coalescing deadline upper bound
+      "adaptive": true,                # arrival-rate-tuned deadline (EWMA)
       "poll_secs": 10.0,               # 0 disables background hot-swap
       "warmup": false                  # precompile every batch bucket
     }
@@ -58,6 +59,7 @@ def create_server(config_json: str) -> ModelServer:
         max_batch=int(cfg.get("max_batch", 256)),
         max_wait_ms=float(cfg.get("max_wait_ms", 2.0)),
         poll_updates_secs=float(cfg.get("poll_secs", 0.0)),
+        adaptive=bool(cfg.get("adaptive", True)),
     )
     if cfg.get("warmup"):
         example = _synth_example(pred)
@@ -181,13 +183,15 @@ def process_json(server: ModelServer, payload: bytes) -> Tuple[int, bytes]:
     except ValueError as e:
         return 400, json.dumps({"error": str(e)}).encode()
     try:
-        probs = server.request(batch)
+        probs, version = server.request_versioned(batch)
         out = (
             {k: np.asarray(v).tolist() for k, v in probs.items()}
             if isinstance(probs, dict)
             else np.asarray(probs).tolist()
         )
-        return 200, json.dumps({"predictions": out}).encode()
+        return 200, json.dumps(
+            {"predictions": out, "model_version": version}
+        ).encode()
     except Exception as e:
         return 500, json.dumps({"error": str(e)}).encode()
 

@@ -9,10 +9,16 @@ full device batches automatically).
 
 Protocol:
   POST /v1/predict   {"features": {"C1": [..ids..], "I1": [[..]], ...}}
-                  -> {"predictions": [...]} (or {"task": [...]} for MTL)
-  GET  /v1/model_info -> {"step": N, "table_sizes": {...}}
+                  -> {"predictions": [...], "model_version": V}
+                     (or {"task": [...]} predictions for MTL)
+  GET  /v1/model_info -> {"step": N, "table_sizes": {...}, "model_version": V}
+  GET  /v1/stats     -> per-stage latency histograms (queue/pad/device/
+                        post/e2e), batch shape stats, model update counters
   POST /v1/reload    -> {"updated": bool}   (poll full/delta updates now)
   GET  /healthz      -> 200 "ok"
+
+Request bodies are capped (`max_body_bytes`, default 16 MiB): oversized
+or malformed payloads get a structured 400 JSON error, never a 500.
 
 Run: python -m deeprec_tpu.serving.http_server --model wdl --ckpt DIR
 or embed: ``HttpServer(server, port=8500).start()``.
@@ -53,6 +59,7 @@ class _Handler(BaseHTTPRequestHandler):
     # set by HttpServer
     servers: dict = None  # name -> ModelServer
     default: str = None
+    max_body: int = 16 << 20  # request-body byte cap (structured 400 past it)
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -81,6 +88,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, "ok")
         elif self.path == "/v1/model_info":
             self._send(200, self.model_server.predictor.model_info())
+        elif self.path == "/v1/stats":
+            # Live per-stage serving histograms — the same accounting
+            # tools/bench_serving.py records per measured configuration.
+            self._send(200, self.model_server.stats_snapshot())
+        elif (self.path.startswith("/v1/models/")
+              and self.path.endswith("/stats")):
+            srv = self._named(self.path[len("/v1/models/"):-len("/stats")])
+            if srv is not None:
+                self._send(200, srv.stats_snapshot())
         elif self.path == "/v1/models":
             self._send(200, {"models": sorted(self.servers)})
         elif self.path.startswith("/v1/models/"):
@@ -113,6 +129,18 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length", 0))
         except (TypeError, ValueError):
             return self._send(400, {"error": "bad Content-Length"})
+        if n < 0:
+            return self._send(400, {"error": "bad Content-Length"})
+        if n > self.max_body:
+            # Reject BEFORE reading: an oversized body must cost a bounded
+            # read and a structured 400, not an allocation + a 500. The
+            # connection is closed (we never consumed the body).
+            self.close_connection = True
+            return self._send(400, {
+                "error": "request body too large",
+                "content_length": n,
+                "limit_bytes": self.max_body,
+            })
         raw = self.rfile.read(n)
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
         # Only explicit protobuf media types take the protobuf path;
@@ -171,30 +199,47 @@ class _Handler(BaseHTTPRequestHandler):
                 # call — a grouped request is already a batch, coalescing
                 # it with strangers' rows would dilute the dedup.
                 try:
-                    probs = server.predictor.predict(batch, group_users=True)
+                    probs, version = server.predictor.predict_versioned(
+                        batch, group_users=True)
                 except ValueError as e:  # no tower split: client error
                     return self._send(400, {"error": str(e)})
             else:
-                probs = server.request(batch)
+                probs, version = server.request_versioned(batch)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}
             else:
                 out = np.asarray(probs).tolist()
-            self._send(200, {"predictions": out})
+            # model_version stamps WHICH snapshot served this request — a
+            # coalesced batch shares one, so clients can detect update
+            # boundaries (and the torn-read test can pin atomicity).
+            self._send(200, {"predictions": out, "model_version": version})
         except Exception as e:  # request-level failure, keep serving
             self._send(500, {"error": str(e)})
+
+
+class _ThreadingServer(ThreadingHTTPServer):
+    # The stdlib default listen backlog is 5: under concurrent
+    # connection-per-request clients, a momentarily busy host (e.g. a
+    # model update competing for CPU) overflows the accept queue, the
+    # kernel drops the SYN, and the client retries after the TCP
+    # retransmission timeout — observed as a mysterious ~1.0 s request
+    # spike during updates (the bulk of round-5's during_update_max_ms).
+    request_queue_size = 128
+    daemon_threads = True
 
 
 class HttpServer:
     """Bind one server — a ModelServer, a ServerGroup, or a {name: server}
     dict for multi-model serving — to a TCP port. start() is non-blocking.
-    Servers are duck-typed: anything with `.request()` and `.predictor`
-    works (ServerGroup routes requests to its least-loaded replica). With
-    a dict, the TF-Serving routes address each model by name and the bare
-    routes hit `default_model` (first name if unset)."""
+    Servers are duck-typed: anything with `.request_versioned()`,
+    `.stats_snapshot()` and `.predictor` works (ServerGroup feeds requests
+    through its shared queue to whichever device-pinned member is free).
+    With a dict, the TF-Serving routes address each model by name and the
+    bare routes hit `default_model` (first name if unset)."""
 
     def __init__(self, model_server, port: int = 8500,
-                 host: str = "127.0.0.1", default_model: Optional[str] = None):
+                 host: str = "127.0.0.1", default_model: Optional[str] = None,
+                 max_body_bytes: int = 16 << 20):
         if isinstance(model_server, dict):
             servers = dict(model_server)
         else:
@@ -205,8 +250,9 @@ class HttpServer:
         if default not in servers:
             raise ValueError(f"default_model {default!r} not in {sorted(servers)}")
         handler = type("BoundHandler", (_Handler,),
-                       {"servers": servers, "default": default})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+                       {"servers": servers, "default": default,
+                        "max_body": int(max_body_bytes)})
+        self.httpd = _ThreadingServer((host, port), handler)
         self.port = self.httpd.server_address[1]  # resolved if port=0
         self._thread: Optional[threading.Thread] = None
 

@@ -1,17 +1,22 @@
-"""Serving: jit-compiled predictor with hot-swapped full/delta model updates.
+"""Serving: jit-compiled predictor with zero-stall full/delta model updates.
 
 Parity with DeepRec's serving stack (SURVEY.md §2.7/§3.4) re-cut for TPU:
   * Processor initialize()/process()  -> Predictor(model, ckpt_dir) /
     predict(batch) — one jitted readonly forward, no training machinery.
   * ModelInstanceMgr's FullModelUpdate/DeltaModelUpdate background polling
-    (model_instance.h:44-232) -> poll_updates(): picks up new full
-    checkpoints and replays incremental deltas IN PLACE on the live sparse
-    tables, then atomically swaps the state reference.
+    (model_instance.h:44-232) -> poll_updates(): builds the NEXT model
+    state on a shadow copy (full restore or delta replay, never touching
+    the live reference), pre-warms the jitted predict against the
+    registered batch buckets, then publishes with one atomic reference
+    swap. The predict path takes no lock at all: it reads one immutable
+    (version, state) snapshot, so a request is served entirely from one
+    model version and `during-update` latency is steady-state latency.
   * SessionGroup's N-sessions concurrency (direct_session_group.h) ->
-    ModelServer: a micro-batching queue in front of the jitted function.
-    JAX dispatch is thread-safe and XLA executes one program at a time per
-    device, so the TPU-native equivalent of "N sessions" is request
-    coalescing into full batches, not N executors.
+    ModelServer: an adaptive micro-batching queue in front of the jitted
+    function (flush on bucket-full or an arrival-rate-tuned deadline).
+    ServerGroup is a shared-queue dispatcher that pins one member per
+    distinct device — and degrades to a single member on a single-device
+    host instead of N members thrashing one backend.
 """
 from __future__ import annotations
 
@@ -19,7 +24,8 @@ import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from itertools import chain
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +33,7 @@ import numpy as np
 import optax
 
 from deeprec_tpu.optim.sparse import GradientDescent
+from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.training.checkpoint import CheckpointManager
 from deeprec_tpu.training.trainer import Trainer, TrainState
 
@@ -38,6 +45,26 @@ class BadRequest(ValueError):
     def __init__(self, message: str, **details):
         super().__init__(message)
         self.details = {"error": message, **details}
+
+
+def pad_ragged(rows: List, L: int, pad_value, dtype) -> np.ndarray:
+    """Bulk pad/trim a ragged list-of-bags to [B, L]: one flatten, one
+    index grid, one scatter — no per-row Python list building (the old
+    `[(r + [pad] * (L - len(r)))[:L] for r in v]` walked every bag in
+    the interpreter, which dominated parse time for long histories)."""
+    B = len(rows)
+    lens = np.fromiter(map(len, rows), np.intp, count=B)
+    total = int(lens.sum())
+    out = np.full((B, L), pad_value, dtype)
+    if total == 0:
+        return out
+    flat = np.fromiter(chain.from_iterable(rows), dtype, count=total)
+    starts = np.cumsum(lens) - lens
+    col = np.arange(total) - np.repeat(starts, lens)
+    keep = col < L
+    row = np.repeat(np.arange(B, dtype=np.intp), lens)
+    out[row[keep], col[keep]] = flat[keep]
+    return out
 
 
 def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]:
@@ -68,8 +95,7 @@ def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]
                 f = specs[k]
                 L = f.max_len
                 if L and isinstance(v, list) and v and isinstance(v[0], list):
-                    rows = [(r + [f.pad_value] * (L - len(r)))[:L] for r in v]
-                    arr = np.asarray(rows, want)
+                    arr = pad_ragged(v, L, f.pad_value, want)
                 else:
                     arr = np.asarray(v).astype(want)
                     if L:
@@ -99,8 +125,61 @@ def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]
     return batch
 
 
+class _Snapshot(NamedTuple):
+    """The unit of atomicity for the serving hot path: readers grab ONE
+    reference to this immutable pair and serve the whole request from it,
+    so a concurrent update can never produce a torn (half-old, half-new)
+    read. `version` increments on every published update."""
+
+    version: int
+    state: TrainState
+
+
+class _ArrivalEWMA:
+    """EWMA of request inter-arrival time and rows-per-request — the
+    signal the adaptive batcher tunes its coalescing deadline from. One
+    instance may be shared by every member of a ServerGroup (arrivals
+    enter through one front door, members drain one shared queue)."""
+
+    ALPHA = 0.1
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = None
+        self._tau = None
+        self._rows = None
+
+    def note(self, t: float, rows: int) -> None:
+        with self._lock:
+            if self._last is not None:
+                dt = max(t - self._last, 0.0)
+                self._tau = (
+                    dt if self._tau is None
+                    else (1 - self.ALPHA) * self._tau + self.ALPHA * dt
+                )
+            self._last = t
+            self._rows = (
+                float(rows) if self._rows is None
+                else (1 - self.ALPHA) * self._rows + self.ALPHA * rows
+            )
+
+    def estimate(self) -> Tuple[Optional[float], float]:
+        """(mean inter-arrival seconds or None, mean rows per request)."""
+        with self._lock:
+            return self._tau, self._rows or 1.0
+
+
 class Predictor:
     """Load-latest-and-serve. Thread-safe; updates swap atomically.
+
+    The hot path is lock-free: `predict` reads one `_Snapshot` reference
+    (a GIL-atomic load) and never blocks on an in-flight update.
+    `poll_updates`/`reload` serialize among THEMSELVES with `_lock`, build
+    the next state off to the side (`CheckpointManager.restore_into` /
+    `restore(chunk=...)` — functional replay, fixed import chunk so no
+    update ever traces a fresh XLA program mid-serving), warm the jitted
+    predict on the registered batch buckets, then publish the new
+    snapshot.
 
     `stores` optionally maps table names to a feature-store object with
     ``get(keys) -> (values, freq, version, found)`` (HostKV signature) —
@@ -111,24 +190,40 @@ class Predictor:
     """
 
     def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None,
-                 device=None):
+                 device=None, restore_chunk="auto"):
         self.model = model
         # Serving needs no optimizer; slot-less sparse opt keeps restore lean
         # (checkpointed slot arrays are skipped when the template has none).
         self._trainer = Trainer(model, GradientDescent(), optax.identity())
         self._ck = CheckpointManager(ckpt_dir, self._trainer)
-        self._state: Optional[TrainState] = None
+        if restore_chunk == "auto":
+            # Every import slice copies the full values array once, so the
+            # slice count must stay small relative to capacity: floor 4096
+            # (one static shape, cheap slices for serving-cadence deltas),
+            # scaled up for big tables so a full reload stays O(~16)
+            # slices instead of O(capacity/4096).
+            cap = max((t.cfg.capacity
+                       for t in self._trainer.tables.values()), default=4096)
+            restore_chunk = max(4096, 1 << (max(cap // 16, 1) - 1).bit_length())
+        self._snap: Optional[_Snapshot] = None
         # Replica pinning (ServerGroup): committing the state to `device`
         # makes every jitted predict follow it there — N replicas on N
         # devices serve concurrently (uncommitted request arrays follow
         # the committed state under JAX placement rules).
         self._device = device
+        self._restore_chunk = int(restore_chunk)
         self._applied: set = set()
-        # Reentrant: poll_updates holds it across its check-then-act (a
-        # concurrent full reload must not interleave with a delta replay)
-        # and may call reload() which takes it again.
+        # Serializes UPDATERS only (concurrent poll_updates / reload /
+        # HTTP /v1/reload); the predict path never touches it.
         self._lock = threading.RLock()
         self.stores = dict(stores or {})
+        self.update_count = 0
+        self.last_update_ms = 0.0
+        # Test seam: called after the next state is fully built and
+        # warmed, immediately before the snapshot swap — lets tests gate
+        # the publish on an event (torn-read pinning) without wall-clock.
+        self._pre_swap: Optional[Callable[[], None]] = None
+        self._warm_batches: Dict[tuple, Dict[str, np.ndarray]] = {}
         self._predict_step = jax.jit(self._predict_impl)
         self._predict_grouped_step = jax.jit(
             self._predict_grouped_impl, static_argnums=2
@@ -136,21 +231,71 @@ class Predictor:
         self._forward_step = jax.jit(self._forward_impl)
         self._lookup_step = jax.jit(self._lookup_views)
         self.reload()
+        # Compile the delta-replay programs NOW (chunked import + prune
+        # rebuild): the first poll_updates under live traffic must be
+        # cache-hit dispatch, not a GIL-held trace next to requests.
+        self._ck.warm_replay(self._snap.state, self._restore_chunk)
 
     # ------------------------------------------------------------- updates
 
+    @property
+    def _state(self) -> TrainState:
+        """Back-compat view of the live state (tests, tooling)."""
+        return self._snap.state
+
+    @property
+    def version(self) -> int:
+        """Monotonic model version: bumps on every published update."""
+        return self._snap.version
+
     def reload(self) -> None:
-        """Full reload from the latest checkpoint chain (FullModelUpdate)."""
+        """Full reload from the latest checkpoint chain (FullModelUpdate).
+        Builds the fresh state entirely off the serving path, then swaps."""
         with self._lock:
             # List BEFORE restoring: a delta landing mid-restore then stays
             # un-applied and is picked up by the next poll (replaying a delta
             # restore() already consumed is idempotent, missing one is not).
             dirs = set(self._dirs())
-            state = self._ck.restore()
+            state = self._ck.restore(chunk=self._restore_chunk)
             if self._device is not None:
                 state = jax.device_put(state, self._device)
-            self._state = state
-            self._applied = dirs
+            self._publish(state, dirs)
+
+    def _publish(self, state: TrainState, applied: set) -> None:
+        """Warm-then-swap: run the jitted predict for every registered
+        batch bucket against the INCOMING state (any straggler compile or
+        cold cache is paid here, on the updater thread), then replace the
+        snapshot reference — the only write the serving path ever sees."""
+        self._warm_state(state)
+        if self._pre_swap is not None:
+            self._pre_swap()
+        prev = self._snap
+        self._snap = _Snapshot(prev.version + 1 if prev else 0, state)
+        self._applied = set(applied)
+
+    def _warm_state(self, state: TrainState) -> None:
+        # list(): a concurrent warmup() may register new buckets mid-walk
+        for b in list(self._warm_batches.values()):
+            jb = {k: jnp.asarray(v) for k, v in b.items()}
+            if self.stores:
+                views, _ = self._lookup_step(state, jb)
+                jax.block_until_ready(self._forward_step(state, views, jb))
+            else:
+                jax.block_until_ready(self._predict_step(state, jb))
+
+    def register_warm_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        """Remember one example batch per shape signature; every future
+        update re-runs these against the incoming state before the swap
+        (ModelServer.warmup registers its whole bucket ladder)."""
+        sig = tuple(sorted(
+            (k, np.asarray(v).shape, str(np.asarray(v).dtype))
+            for k, v in batch.items()
+        ))
+        with self._lock:  # vs a background poll publishing concurrently
+            if sig not in self._warm_batches:
+                self._warm_batches[sig] = {
+                    k: np.asarray(v) for k, v in batch.items()
+                }
 
     def _dirs(self) -> List[str]:
         fulls = self._ck._list("full")
@@ -162,41 +307,47 @@ class Predictor:
 
     def poll_updates(self) -> bool:
         """Apply anything new: a newer full checkpoint triggers a full
-        reload; new deltas replay onto the live state (DeltaModelUpdate).
-        Returns True if the model changed. Safe to call concurrently (HTTP
-        /v1/reload + background poller): the whole check-then-act runs
-        under the lock, so a stale delta can never replay over a newer
-        full reload."""
+        reload; new deltas replay onto a SHADOW copy of the live state
+        (DeltaModelUpdate) — the live snapshot is never touched until the
+        finished, warmed replacement swaps in. Returns True if the model
+        changed. Safe to call concurrently (HTTP /v1/reload + background
+        poller): the whole check-then-act runs under the updater lock, so
+        a stale delta can never replay over a newer full reload."""
+        t0 = time.perf_counter()
         with self._lock:
             new = [d for d in self._dirs() if d not in self._applied]
             if not new:
                 return False
             if any(d.startswith("full-") for d in new):
                 self.reload()
-                return True
-            state = self._state
-            last_step = int(state.step)
-            for d in sorted(new, key=lambda s: int(s.split("-")[1])):
-                state = self._ck._apply_ckpt(
-                    state, os.path.join(self._ck.dir, d), load_dense=True
-                )
-                last_step = max(last_step, int(d.split("-")[1]))
-                self._applied.add(d)
-            state = TrainState(
-                step=jnp.asarray(last_step, jnp.int32),
-                tables=state.tables,
-                dense=state.dense,
-                opt_state=state.opt_state,
-            )
-            if self._device is not None:
-                state = jax.device_put(state, self._device)
-            self._state = state
+            else:
+                state = self._snap.state
+                applied = set(self._applied)
+                for d in sorted(new, key=lambda s: int(s.split("-")[1])):
+                    state = self._ck.restore_into(
+                        state, os.path.join(self._ck.dir, d),
+                        chunk=self._restore_chunk,
+                    )
+                    applied.add(d)
+                if self._device is not None:
+                    state = jax.device_put(state, self._device)
+                self._publish(state, applied)
+            self.update_count += 1
+            self.last_update_ms = round((time.perf_counter() - t0) * 1e3, 3)
         return True
 
     # ------------------------------------------------------------- predict
 
     def predict(self, batch: Dict[str, np.ndarray], group_users: bool = False):
-        """Probabilities for one batch (dict keyed per task for MTL).
+        """Probabilities for one batch (dict keyed per task for MTL)."""
+        return self.predict_versioned(batch, group_users)[0]
+
+    def predict_versioned(
+        self, batch: Dict[str, np.ndarray], group_users: bool = False
+    ):
+        """(probabilities, model_version) for one batch — the version is
+        read atomically WITH the state, so the pair certifies which model
+        produced the answer (response stamping, torn-read tests).
         Label-free: the serving path runs lookup + forward + sigmoid only —
         no loss, no dummy labels, no training machinery.
 
@@ -211,7 +362,8 @@ class Predictor:
         does). Outputs are row-for-row identical to the plain path.
         Ignores feature stores (read-through is a per-row correction that
         the grouped trace doesn't carry)."""
-        state = self._state  # atomic reference read
+        snap = self._snap  # ONE atomic read; the whole request uses it
+        state = snap.state
         if group_users:
             if not hasattr(self.model, "apply_with_user"):
                 raise ValueError(
@@ -243,13 +395,13 @@ class Predictor:
 
             batch = {k: pad(v) for k, v in batch.items()}
             probs = self._predict_grouped_step(state, batch, g)
-            return jax.tree.map(lambda a: np.asarray(a)[:b], probs)
+            return jax.tree.map(lambda a: np.asarray(a)[:b], probs), snap.version
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self.stores:
             probs = self._predict_with_stores(state, batch)
         else:
             probs = self._predict_step(state, batch)
-        return jax.tree.map(np.asarray, probs)
+        return jax.tree.map(np.asarray, probs), snap.version
 
     def _lookup_views(self, state, batch):
         """Readonly lookup pass: feature -> (unique embs, inverse, mask)
@@ -349,15 +501,16 @@ class Predictor:
 
     @property
     def step(self) -> int:
-        return int(self._state.step)
+        return int(self._snap.state.step)
 
     def model_info(self) -> Dict:
         """get_serving_model_info parity."""
-        state = self._state  # one snapshot: no torn step/sizes mix under
+        snap = self._snap  # one snapshot: no torn step/sizes mix under
         sizes = {}  # a concurrent hot-swap
         for name, t in self._trainer.tables.items():
-            sizes[name] = int(t.size(self._trainer.table_state(state, name)))
-        return {"step": int(state.step), "table_sizes": sizes}
+            sizes[name] = int(t.size(self._trainer.table_state(snap.state, name)))
+        return {"step": int(snap.state.step), "table_sizes": sizes,
+                "model_version": snap.version}
 
 
 def _run_poll_loop(owner, stop: threading.Event, secs: float) -> None:
@@ -385,6 +538,18 @@ class ModelServer:
 
     The SessionGroup analog — concurrency through batching, not through N
     session replicas (docs/docs_en/SessionGroup.md's goal, TPU-shaped).
+
+    Dispatch is deadline-based: a batch flushes when its bucket fills
+    (`max_batch` ROWS, not requests) or its deadline passes. With
+    `adaptive=True` (default) the deadline is tuned per batch from an
+    EWMA of the arrival rate: under sparse traffic the batcher dispatches
+    immediately (waiting can't fill the bucket, it only adds latency),
+    under heavy traffic it waits just long enough to fill the bucket,
+    capped by `max_wait_ms`. `adaptive=False` restores the fixed wait.
+
+    `request_queue`/`stats`/`arrivals` let several members share one
+    front (ServerGroup): every member drains the same queue and accounts
+    into the same histograms.
     """
 
     def __init__(
@@ -393,11 +558,21 @@ class ModelServer:
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
         poll_updates_secs: float = 0.0,
+        adaptive: bool = True,
+        request_queue: Optional["queue.Queue"] = None,
+        stats: Optional[ServingStats] = None,
+        arrivals: Optional[_ArrivalEWMA] = None,
     ):
         self.predictor = predictor
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
-        self._q: "queue.Queue" = queue.Queue()
+        self.adaptive = adaptive
+        self.stats = stats if stats is not None else ServingStats()
+        self._arrivals = arrivals if arrivals is not None else _ArrivalEWMA()
+        self._q: "queue.Queue" = (
+            request_queue if request_queue is not None else queue.Queue()
+        )
+        self._carry = None  # request deferred to lead the next batch
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -411,27 +586,79 @@ class ModelServer:
     def _poll_loop(self, secs):
         _run_poll_loop(self, self._stop, secs)
 
+    # Sparse-traffic cutoff: skip the coalescing wait entirely once the
+    # mean inter-arrival is this many windows long — the chance another
+    # request lands inside the window is small enough that waiting only
+    # adds latency. Closed-loop/bursty clients sit well under this (their
+    # EWMA is a few windows at most), so bursts still coalesce.
+    SPARSE_FACTOR = 8.0
+
+    def _pick_wait(self, rows: int) -> float:
+        """Coalescing deadline for a batch currently holding `rows` rows."""
+        if rows >= self.max_batch:
+            return 0.0
+        if not self.adaptive:
+            return self.max_wait
+        tau, rows_per_req = self._arrivals.estimate()
+        if tau is None:
+            return self.max_wait  # no history yet: behave like fixed
+        if tau >= self.SPARSE_FACTOR * self.max_wait:
+            return 0.0  # sparse traffic: dispatch now, waiting can't fill
+        need = (self.max_batch - rows) / max(rows_per_req, 1.0)
+        return min(self.max_wait, tau * need)
+
+    def _take(self, pending, rows, nxt) -> int:
+        """Admit `nxt` into the forming batch unless it would push the row
+        count past max_batch — an overflowing batch falls off the bucket
+        ladder and traces a fresh arrival-timing-dependent XLA shape, the
+        exact stall class this server exists to prevent. The rejected
+        request leads the NEXT batch instead. Returns the new row count
+        (== max_batch signals 'batch is full, dispatch')."""
+        if pending and rows + nxt[1] > self.max_batch:
+            self._carry = nxt
+            return self.max_batch
+        pending.append(nxt)
+        return rows + nxt[1]
+
     def _run(self):
         while not self._stop.is_set():
-            try:
-                first = self._q.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            pending = [first]
-            deadline = time.monotonic() + self.max_wait
-            while len(pending) < self.max_batch:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    break
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
                 try:
-                    pending.append(self._q.get(timeout=left))
+                    first = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            pending = [first]
+            rows = first[1]
+            # Opportunistic drain first: whatever is ALREADY queued rides
+            # along for free — batching backlog never needs a deadline.
+            while rows < self.max_batch:
+                try:
+                    nxt = self._q.get_nowait()
                 except queue.Empty:
                     break
+                rows = self._take(pending, rows, nxt)
+            wait = self._pick_wait(rows)
+            if wait > 0 and rows < self.max_batch:
+                deadline = time.monotonic() + wait
+                while rows < self.max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=left)
+                    except queue.Empty:
+                        break
+                    rows = self._take(pending, rows, nxt)
             self._serve(pending)
 
-    def _serve(self, pending: List[Tuple[Dict, "queue.Queue"]]):
-        reqs = [r for r, _ in pending]
-        sizes = [next(iter(r.values())).shape[0] for r in reqs]
+    def _serve(self, pending: List[Tuple[Dict, int, "queue.Queue", float]]):
+        t0 = time.monotonic()
+        for _, _, _, t_enq in pending:
+            self.stats.record_stage("queue", t0 - t_enq)
+        reqs = [r for r, _, _, _ in pending]
+        sizes = [n for _, n, _, _ in pending]
         batch = {
             k: np.concatenate([np.asarray(r[k]) for r in reqs])
             for k in reqs[0]
@@ -446,19 +673,26 @@ class ModelServer:
                 k: np.concatenate([v, np.repeat(v[-1:], bucket - total, axis=0)])
                 for k, v in batch.items()
             }
+        self.stats.record_stage("pad", time.monotonic() - t0)
         try:
-            probs = self.predictor.predict(batch)
+            t1 = time.monotonic()
+            probs, version = self.predictor.predict_versioned(batch)
+            t2 = time.monotonic()
+            self.stats.record_stage("device", t2 - t1)
             off = 0
-            for (_, reply), n in zip(pending, sizes):
+            for (_, _, reply, _), n in zip(pending, sizes):
                 sl = (
                     {k: v[off : off + n] for k, v in probs.items()}
                     if isinstance(probs, dict)
                     else probs[off : off + n]
                 )
-                reply.put(sl)
+                reply.put((sl, version))
                 off += n
+            self.stats.record_stage("post", time.monotonic() - t2)
+            self.stats.record_batch(len(pending), total)
         except Exception as e:
-            for _, reply in pending:
+            self.stats.record_error(len(pending))
+            for _, _, reply, _ in pending:
                 reply.put(e)
 
     def _buckets(self) -> List[int]:
@@ -483,7 +717,10 @@ class ModelServer:
         """Precompile every batch bucket from one example row, so the first
         production burst never waits on XLA. Returns the number of buckets
         compiled. The serving counterpart of the reference's warmup
-        requests (Processor.md warmup section)."""
+        requests (Processor.md warmup section). Each bucket batch is also
+        registered with the predictor, so every future model update
+        re-warms the same ladder against the incoming state BEFORE the
+        snapshot swap (warm-before-swap)."""
         one = {k: np.asarray(v)[:1] for k, v in example.items()}
         sizes = self._buckets()
         for size in sizes:
@@ -491,15 +728,42 @@ class ModelServer:
                 k: np.concatenate([v] * size, axis=0) for k, v in one.items()
             }
             self.predictor.predict(batch)
+            self.predictor.register_warm_batch(batch)
         return len(sizes)
 
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
         """Blocking predict for one (mini-)request — the process() call."""
+        return self.request_versioned(features, timeout)[0]
+
+    def request_versioned(
+        self, features: Dict[str, np.ndarray], timeout: float = 30.0
+    ):
+        """(result, model_version) — the version the whole request was
+        served from (one snapshot; coalesced neighbors share it)."""
         reply: "queue.Queue" = queue.Queue(maxsize=1)
-        self._q.put((features, reply))
+        rows = (
+            int(np.asarray(next(iter(features.values()))).shape[0])
+            if features else 0
+        )
+        t0 = time.monotonic()
+        self._arrivals.note(t0, rows)
+        self._q.put((features, rows, reply, t0))
         out = reply.get(timeout=timeout)
+        self.stats.record_stage("e2e", time.monotonic() - t0)
         if isinstance(out, Exception):
             raise out
+        return out
+
+    def stats_snapshot(self) -> Dict:
+        """Live serving stats + model identity — the `/v1/stats` body."""
+        out = self.stats.snapshot()
+        p = self.predictor
+        out["model"] = {
+            "version": p.version,
+            "step": p.step,
+            "updates": p.update_count,
+            "last_update_ms": p.last_update_ms,
+        }
         return out
 
     def close(self):
@@ -522,7 +786,8 @@ class _GroupPredictor:
     def poll_updates(self) -> bool:
         # Rolling update: replicas refresh one at a time, the others keep
         # serving the previous version — SessionGroup's model-update story
-        # without a serving gap.
+        # without a serving gap. Each member's refresh is itself
+        # zero-stall (shadow build + warm + swap).
         changed = False
         for m in self._members:
             changed = bool(m.poll_updates()) or changed
@@ -539,34 +804,47 @@ class _GroupPredictor:
 
 
 class ServerGroup:
-    """N serving replicas sharing one checkpoint watcher — the
+    """N serving replicas behind ONE shared request queue — the
     DirectSessionGroup analog (direct_session_group.h:28,
-    docs/docs_en/SessionGroup.md). Each replica is a full ModelServer
-    (own coalescing queue + worker thread) whose Predictor state is
-    committed to its own device; requests go to the least-loaded replica.
+    docs/docs_en/SessionGroup.md). One member is pinned per DISTINCT
+    device, so on a multi-device host the members drain the shared queue
+    in parallel, each dispatching to its own chip; on a single-device
+    host the group degrades to a single member (requested replicas are
+    capped at the device count) — N members time-slicing one backend is
+    strictly worse than one member batching for it, which is exactly the
+    negative scaling the old least-loaded/per-member-queue design showed
+    (SERVING_BENCH round 5: group-4 at 336 rps vs 719 single).
 
-    On a multi-device host this is true device parallelism; on a single
-    chip it still removes host-side head-of-line blocking (request
-    parsing/concat of a big batch no longer stalls every later arrival —
-    the reference's per-session threadpool rationale).
-    """
+    The shared queue replaces least-loaded routing: work is pulled by
+    whichever member is free (no routing decision can back the wrong
+    queue), and every member accounts into one ServingStats."""
 
     def __init__(self, model, ckpt_dir: str, *, replicas: int = 2,
                  devices=None, stores: Optional[Dict] = None,
                  max_batch: int = 256, max_wait_ms: float = 2.0,
-                 poll_updates_secs: float = 0.0):
+                 poll_updates_secs: float = 0.0, adaptive: bool = True):
         if devices is None:
             avail = jax.local_devices()
-            devices = [avail[i % len(avail)] for i in range(replicas)]
+            devices = avail[: max(1, min(replicas, len(avail)))]
+        else:
+            # One member per DISTINCT device even for explicit lists (the
+            # old API modulo-duplicated devices; N members sharing one
+            # backend is the anti-scaling regime this class exists to
+            # prevent) — order-preserving dedup.
+            devices = list(dict.fromkeys(devices))
+        self.stats = ServingStats()
+        self._arrivals = _ArrivalEWMA()
+        self._q: "queue.Queue" = queue.Queue()
         self.members = [
             ModelServer(
                 Predictor(model, ckpt_dir, stores=stores, device=d),
                 max_batch=max_batch, max_wait_ms=max_wait_ms,
+                adaptive=adaptive, request_queue=self._q, stats=self.stats,
+                arrivals=self._arrivals,
             )
             for d in devices
         ]
         self.predictor = _GroupPredictor([s.predictor for s in self.members])
-        self._rr = 0
         self._stop = threading.Event()
         self._poller = None
         if poll_updates_secs > 0:
@@ -579,19 +857,30 @@ class ServerGroup:
     def _poll_loop(self, secs: float):
         _run_poll_loop(self, self._stop, secs)
 
-    def _pick(self) -> "ModelServer":
-        """Least-loaded replica; round-robin breaks ties so idle groups
-        still spread arrivals across devices."""
-        n = len(self.members)
-        self._rr = (self._rr + 1) % n
-        order = self.members[self._rr:] + self.members[: self._rr]
-        return min(order, key=lambda s: s._q.qsize())
-
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
-        return self._pick().request(features, timeout=timeout)
+        # Any member's request() enqueues onto the SHARED queue; whichever
+        # member is free serves it.
+        return self.members[0].request(features, timeout=timeout)
+
+    def request_versioned(
+        self, features: Dict[str, np.ndarray], timeout: float = 30.0
+    ):
+        return self.members[0].request_versioned(features, timeout=timeout)
 
     def warmup(self, example: Dict[str, np.ndarray]) -> int:
         return sum(s.warmup(example) for s in self.members)
+
+    def stats_snapshot(self) -> Dict:
+        out = self.stats.snapshot()
+        ps = [s.predictor for s in self.members]
+        out["replicas"] = len(self.members)
+        out["model"] = {
+            "version": ps[0].version,
+            "step": ps[0].step,
+            "updates": sum(p.update_count for p in ps),
+            "last_update_ms": max(p.last_update_ms for p in ps),
+        }
+        return out
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,92 @@
+"""Serving observability: per-request stage timers aggregated into
+histograms.
+
+Every request through the micro-batching front is accounted in four
+stages, the same decomposition bench.py's phase profiler gives training
+steps:
+
+  * ``queue``  — enqueue until a batcher worker picks the request up
+                 (coalescing wait + head-of-line blocking)
+  * ``pad``    — concat + bucket-pad of the coalesced batch
+  * ``device`` — the jitted predict (dispatch + device compute + D2H)
+  * ``post``   — per-request slicing and reply delivery
+  * ``e2e``    — enqueue to reply received (the client-visible latency)
+
+One ``ServingStats`` may be shared by several ``ModelServer`` members
+(a ``ServerGroup`` passes one instance to every member), so the numbers
+describe the serving front as a whole. Snapshots are cheap JSON-ready
+dicts — `GET /v1/stats` returns one live, and tools/bench_serving.py
+records one per measured configuration.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from deeprec_tpu.training.profiler import LatencyHistogram
+
+STAGES = ("queue", "pad", "device", "post", "e2e")
+
+
+class ServingStats:
+    """Thread-safe aggregate of the serving front's stage timers plus
+    batch-shape and error counters."""
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self.stage = {s: LatencyHistogram() for s in STAGES}
+        self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)  # rows, not s
+        self.requests = 0
+        self.batches = 0
+        self.rows = 0
+        self.errors = 0
+
+    # ----------------------------------------------------------- recording
+
+    def record_stage(self, stage: str, seconds: float) -> None:
+        self.stage[stage].record(seconds)
+
+    def record_batch(self, n_requests: int, n_rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.requests += n_requests
+            self.rows += n_rows
+        self.batch_rows.record(float(n_rows))
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    # ----------------------------------------------------------- reporting
+
+    def snapshot(self) -> Dict:
+        """JSON-ready view: per-stage latency summaries + counters. The
+        batch_rows histogram reuses the latency summary shape with rows in
+        place of milliseconds (keys renamed accordingly)."""
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "batches": self.batches,
+                "rows": self.rows,
+                "errors": self.errors,
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+            }
+        out["stages"] = {s: h.summary() for s, h in self.stage.items()}
+        rows = self.batch_rows.summary()
+        out["batch_rows"] = {
+            "count": rows["count"],
+            "mean": round(rows["mean_ms"] / 1e3, 2),
+            "p50": rows["p50_ms"] / 1e3,
+            "p99": rows["p99_ms"] / 1e3,
+            "max": rows["max_ms"] / 1e3,
+        }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self.stage = {s: LatencyHistogram() for s in STAGES}
+            self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)
+            self.requests = self.batches = self.rows = self.errors = 0
+            self._t0 = time.monotonic()

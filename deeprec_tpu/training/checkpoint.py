@@ -159,6 +159,7 @@ def import_rows(
     rows: Dict[str, np.ndarray],
     strict: bool = True,
     bucket: bool = False,
+    chunk: Optional[int] = None,
 ) -> TableState:
     """Insert checkpointed rows into a (fresh or live) local table state.
 
@@ -171,13 +172,35 @@ def import_rows(
     padding would transiently copy the whole values array). Only PER-ROW
     arrays pad; per-table entries (scalar optimizer slots, bloom) pass
     through untouched.
+
+    chunk=N (overrides bucket) imports in sequential fixed-size slices of
+    exactly N rows (last slice padded): ONE static shape per table, ever.
+    This is the zero-stall serving discipline — power-of-two bucketing
+    still traces a fresh XLA program the first time each bucket size
+    appears, and that trace holds the GIL for hundreds of ms while live
+    requests wait. With a fixed chunk the program compiles once at
+    startup/warmup and every later full reload or delta replay is pure
+    cache-hit dispatch. Per-table entries (scalar slots, bloom) are
+    whole-table values, identical in every slice, so re-applying them per
+    slice is idempotent. Costs one full values-array copy per slice —
+    pick a chunk that keeps the slice count small at your row scale.
     """
     n = rows["keys"].shape[0]
     if n == 0:
         if "bloom" in rows and state.bloom is not None:
             state = state.replace(bloom=jnp.asarray(rows["bloom"]))
         return state
-    m = (1 << (n - 1).bit_length()) if bucket else n
+    if chunk is not None and n > chunk:
+        for off in range(0, n, chunk):
+            sl = {
+                k: (v[off:off + chunk] if is_per_row(k) else v)
+                for k, v in rows.items()
+            }
+            state = import_rows(table, state, sl, strict=strict, chunk=chunk)
+        return state
+    m = chunk if chunk is not None else (
+        (1 << (n - 1).bit_length()) if bucket else n
+    )
 
     def _padded(k, a):
         per_row = k in ("keys", "values", "freqs", "versions") or (
@@ -247,6 +270,23 @@ def import_rows(
 import functools as _ft
 
 from deeprec_tpu.embedding.table import META_DIRTY, META_FREQ, META_VERSION
+
+
+@_ft.partial(jax.jit, static_argnums=(0, 3))
+def _rebuild_keep_jit(table, state: TableState, keep: jnp.ndarray,
+                      slot_fills) -> TableState:
+    """Jitted keep-mask rebuild for delta-replay pruning (_prune_to_live):
+    compile-cached per (table, slot_fills, shapes) so serving-cadence
+    replays never re-trace the probe loop."""
+    return table.rebuild(state, keep=keep, slot_fills=slot_fills)
+
+
+@_ft.partial(jax.jit, static_argnums=(0, 3))
+def _rebuild_keep_sharded_jit(table, state: TableState, keep: jnp.ndarray,
+                              slot_fills) -> TableState:
+    return jax.vmap(
+        lambda s, kp: table.rebuild(s, keep=kp, slot_fills=slot_fills)
+    )(state, keep)
 
 
 @_ft.partial(jax.jit, static_argnums=(1,))
@@ -1085,13 +1125,19 @@ class CheckpointManager:
         fulls = self._list("full")
         return fulls[-1] if fulls else None
 
-    def restore(self, template: Optional[TrainState] = None) -> TrainState:
+    def restore(self, template: Optional[TrainState] = None,
+                chunk: Optional[int] = None) -> TrainState:
         """Latest full checkpoint + all newer deltas, onto the trainer's
         CURRENT topology (mesh size / process count / capacity may all
         differ from save time — this is the elastic-rescale mechanism).
         Sharded multi-process trainers stream per-shard: each process reads
         the row files and keeps only keys its shards own — no global
-        gather, no host-side global materialization."""
+        gather, no host-side global materialization.
+
+        `chunk` (serving restores) imports rows in fixed-size slices so
+        the import program has ONE static shape across every reload —
+        ignored on the sharded streaming path, which already imports
+        file-sized chunks and runs off the serving hot path."""
         self.wait()  # an in-flight async save must land (or fail) first
         full_step = self.latest_full()
         if full_step is None:
@@ -1110,12 +1156,78 @@ class CheckpointManager:
             return self._restore_streaming(template, chain, step)
         state = template if template is not None else self.trainer.init(0)
         for path in chain:
-            state = self._apply_ckpt(state, path, load_dense=True)
+            state = self._apply_ckpt(state, path, load_dense=True,
+                                     chunk=chunk)
         return TrainState(
             step=jnp.asarray(step, jnp.int32),
             tables=state.tables,
             dense=state.dense,
             opt_state=state.opt_state,
+        )
+
+    def warm_replay(self, state: TrainState, chunk: int) -> None:
+        """Compile the delta-replay programs — the chunked row import and
+        the keep-mask prune rebuild — against `state`'s table shapes, so
+        the FIRST live replay (poll_updates under traffic) is pure
+        cache-hit dispatch instead of a GIL-held trace. The dummy import
+        uses empty-key sentinel rows, inert by construction; all outputs
+        are discarded. Single-host layouts only (sharded streaming
+        restores run off the serving path)."""
+        from deeprec_tpu.embedding.table import empty_key
+
+        for bname, b in self.trainer.bundles.items():
+            ts = state.tables[bname]
+            sub = jax.tree.map(lambda a: a[0], ts) if b.stacked else ts
+            keys_np = np.asarray(sub.keys)
+            if keys_np.ndim != 1:
+                continue
+            cfg = b.table.cfg
+            rows = {
+                "keys": np.full((chunk,), empty_key(cfg), keys_np.dtype),
+                "values": np.zeros((chunk, cfg.dim), np.float32),
+                "freqs": np.zeros((chunk,), np.int32),
+                "versions": np.zeros((chunk,), np.int32),
+            }
+            for sname, arr in sub.slots.items():
+                if is_per_row("slot:" + sname):
+                    a = np.asarray(arr)
+                    rows["slot:" + sname] = np.zeros(
+                        (chunk,) + a.shape[1:], np.float32
+                    )
+            out = import_rows(b.table, sub, rows, strict=False, chunk=chunk)
+            fills = self.trainer._slot_fills(b)
+            jax.block_until_ready(_rebuild_keep_jit(
+                b.table, sub, jnp.ones(keys_np.shape, bool), fills
+            ))
+            jax.block_until_ready(out)
+
+    def restore_into(self, state: TrainState, path: str,
+                     chunk: Optional[int] = None,
+                     load_dense: bool = True) -> TrainState:
+        """Replay ONE checkpoint dir (full or incr) onto `state` and
+        return the resulting TrainState — the shadow-copy building block
+        of zero-stall serving updates (Predictor.poll_updates).
+
+        Contract: the input `state` is NEVER mutated — all updates are
+        functional (fresh arrays), so a reader holding the old reference
+        keeps serving a complete, consistent model while the caller
+        assembles the next one; the caller publishes the returned state
+        with one atomic reference swap. The replayed result is
+        bit-identical on table contents to applying the same dir in
+        place (pinned by tests/test_serving_update.py). The returned
+        step advances to the dir's manifest step (never backwards)."""
+        out = self._apply_ckpt(state, path, load_dense=load_dense,
+                               chunk=chunk)
+        step = int(state.step)
+        mf = os.path.join(path, "manifest.json")
+        if os.path.exists(mf):
+            with open(mf) as f:
+                step = max(step, json.load(f)["step"])
+        return TrainState(
+            step=jnp.asarray(step, jnp.int32),
+            tables=out.tables,
+            dense=out.dense,
+            opt_state=out.opt_state,
         )
 
     def _restore_datasets(self, chain: List[str]) -> None:
@@ -1419,10 +1531,14 @@ class CheckpointManager:
             merged["bloom_parts"] = np.stack([b for _, b in pairs])
         return merged
 
-    def _apply_ckpt(self, state: TrainState, path: str, load_dense: bool) -> TrainState:
+    def _apply_ckpt(self, state: TrainState, path: str, load_dense: bool,
+                    chunk: Optional[int] = None) -> TrainState:
         # Delta replays recur at serving cadence with a different row
         # count each time — bucket those to stabilize compiled shapes;
-        # one-shot full restores import exact-size.
+        # one-shot full restores import exact-size. A serving caller
+        # passes `chunk` instead: ONE static import shape for full and
+        # delta alike (see import_rows), so no replay ever traces a new
+        # XLA program while requests are in flight.
         bucket = os.path.basename(path).startswith("incr-")
         tables = dict(state.tables)
         for bname, b in self.trainer.bundles.items():
@@ -1437,7 +1553,7 @@ class CheckpointManager:
                     rows.pop("partition_offset", None)
                     live = rows.pop("live_keys", None)
                     sub = self._import_local(b.table, sub, rows,
-                                             bucket=bucket)
+                                             bucket=bucket, chunk=chunk)
                     if live is not None:
                         # delta semantics: anything absent from the delta's
                         # live set was evicted since the previous save
@@ -1461,21 +1577,33 @@ class CheckpointManager:
     def _prune_to_live(self, b, sub: TableState, live: np.ndarray) -> TableState:
         """Drop keys not in the delta's live set (evicted between saves) —
         rebuild-based, so probe chains heal and freed optimizer slot rows
-        restart at the optimizer's init value."""
+        restart at the optimizer's init value. Jit-wrapped with a stable
+        cache key (table, fills): the old eager closure re-traced the
+        rebuild probe loop on EVERY delta replay, a GIL-held stall at
+        serving cadence (poll_updates) — now it compiles once per table
+        shape and every later replay is cache-hit dispatch."""
+        from deeprec_tpu.embedding.table import empty_key
+
         fills = self.trainer._slot_fills(b)
         keys = np.asarray(sub.keys)
+        # Nothing evicted since the previous save (every occupied key is in
+        # the live set) -> the rebuild is an identity: skip it. Deltas at
+        # serving cadence with stable key sets pay zero rebuild work.
+        occupied_live = np.isin(keys, live) | (keys == empty_key(b.table.cfg))
+        if occupied_live.all():
+            return sub
         if keys.ndim == 2:  # sharded: [N, C_local]
             keep = np.stack([np.isin(k, live) for k in keys])
-            fn = jax.vmap(
-                lambda s, kp: b.table.rebuild(s, keep=kp, slot_fills=fills)
+            return _rebuild_keep_sharded_jit(
+                b.table, sub, jnp.asarray(keep), fills
             )
-            return fn(sub, jnp.asarray(keep))
-        return b.table.rebuild(
-            sub, keep=jnp.asarray(np.isin(keys, live)), slot_fills=fills
+        return _rebuild_keep_jit(
+            b.table, sub, jnp.asarray(np.isin(keys, live)), fills
         )
 
     def _import_local(self, table, sub: TableState, rows,
-                      bucket: bool = False) -> TableState:
+                      bucket: bool = False,
+                      chunk: Optional[int] = None) -> TableState:
         """Import rows into a local (possibly shard-stacked) table state."""
         if self._is_sharded():
             N = self.trainer.num_shards
@@ -1501,7 +1629,7 @@ class CheckpointManager:
                 shard_rows.pop("bloom", None)  # legacy merged-sketch files
                 local = jax.tree.map(lambda a: a[s], sub)
                 local = import_rows(table, local, shard_rows,
-                                    bucket=bucket)
+                                    bucket=bucket, chunk=chunk)
                 cbf = table.cfg.ev.cbf_filter
                 if cbf is not None and local.bloom is not None and same_topology:
                     local = local.replace(
@@ -1521,7 +1649,7 @@ class CheckpointManager:
                     local = local.replace(bloom=bloom)
                 shards.append(local)
             return jax.tree.map(lambda *xs: jnp.stack(xs), *shards)
-        return import_rows(table, sub, rows, bucket=bucket)
+        return import_rows(table, sub, rows, bucket=bucket, chunk=chunk)
 
     # ----------------------------------------------------------------- gc
 

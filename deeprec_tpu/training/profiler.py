@@ -8,8 +8,10 @@ step-windowed helper matching the reference's "--timeline N" UX.
 """
 from __future__ import annotations
 
+import bisect
 import contextlib
 import os
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
@@ -102,6 +104,83 @@ class PhaseProfiler:
                 "min_ms": round(min(ts) * 1e3, 3),
             }
         return out
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-scale latency histogram: O(1) record, bounded
+    memory, mergeable — the accounting primitive behind serving's
+    per-stage timers (serving/stats.py) and anything else that needs
+    percentiles without keeping every sample.
+
+    Buckets grow geometrically from `lo` seconds; values above the last
+    bound land in an overflow bucket whose percentile estimate is the
+    tracked exact max. Thread-safe (one small lock per record)."""
+
+    GROWTH = 1.5
+
+    def __init__(self, lo: float = 50e-6, hi: float = 120.0):
+        bounds = []
+        b = lo
+        while b < hi:
+            bounds.append(b)
+            b *= self.GROWTH
+        self._bounds = bounds  # upper edge of each bucket, seconds
+        self._counts = [0] * (len(bounds) + 1)  # +1 overflow
+        self._n = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        s = float(seconds)
+        i = bisect.bisect_left(self._bounds, s)
+        with self._lock:
+            self._counts[i] += 1
+            self._n += 1
+            self._sum += s
+            if s > self._max:
+                self._max = s
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        with other._lock:
+            counts, n = list(other._counts), other._n
+            tot, mx = other._sum, other._max
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._n += n
+            self._sum += tot
+            self._max = max(self._max, mx)
+
+    def percentile(self, q: float) -> float:
+        """Upper-bucket-edge estimate of the q-quantile in seconds."""
+        with self._lock:
+            n, counts, mx = self._n, list(self._counts), self._max
+        if n == 0:
+            return 0.0
+        target = min(int(q * n), n - 1)
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen > target:
+                # clamp to the exact max: a coarse bucket's upper edge can
+                # exceed every sample in it (p99 > max is self-contradictory)
+                return min(self._bounds[i], mx) if i < len(self._bounds) else mx
+        return mx
+
+    def summary(self) -> Dict[str, float]:
+        """{count, mean_ms, p50_ms, p90_ms, p99_ms, max_ms} — the shape
+        `/v1/stats` and SERVING_BENCH.json report per stage."""
+        with self._lock:
+            n, tot, mx = self._n, self._sum, self._max
+        return {
+            "count": n,
+            "mean_ms": round(tot / n * 1e3, 3) if n else 0.0,
+            "p50_ms": round(self.percentile(0.50) * 1e3, 3),
+            "p90_ms": round(self.percentile(0.90) * 1e3, 3),
+            "p99_ms": round(self.percentile(0.99) * 1e3, 3),
+            "max_ms": round(mx * 1e3, 3),
+        }
 
 
 class StepWindowTracer:

@@ -1,0 +1,563 @@
+"""Zero-stall serving update discipline (PR 5).
+
+Pins the read-mostly/copy-on-update contract:
+  * torn-read: a predict racing poll_updates() is served entirely from
+    the old or entirely from the new snapshot — never a mix — proven by
+    EVENT ORDERING through the predictor's pre-swap seam, not wall-clock
+    (the PR4 gated-seam style);
+  * shadow replay (restore_into, fixed-chunk imports) is bit-identical
+    on table ints to the legacy whole-delta in-place-style replay;
+  * the live snapshot is never touched while the next one is built;
+  * parse_features' vectorized ragged padding matches the old per-row
+    Python loop on ragged / over-long / scalar-bag inputs;
+  * HTTP robustness: oversized and malformed bodies get structured 400s;
+  * /v1/stats serves live per-stage histograms;
+  * ServerGroup pins one member per distinct device and degrades to a
+    single member on a single-device host (shared-queue dispatcher).
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.serving import HttpServer, ModelServer, Predictor, ServerGroup
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.training.checkpoint import (
+    CheckpointManager,
+    _state_to_np,
+)
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def strip_labels(b):
+    return {k: np.asarray(v) for k, v in b.items() if not k.startswith("label")}
+
+
+def make_trained(tmp_path, steps=5):
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(32,), num_cat=4,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=128, num_cat=4, num_dense=2, vocab=800,
+                          seed=33)
+    batches = [J(gen.batch()) for _ in range(steps)]
+    for b in batches:
+        st, _ = tr.train_step(st, b)
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+    return model, tr, st, ck, batches
+
+
+def advance_delta(tr, st, ck, batches, n=3):
+    for _ in range(n):
+        st, _ = tr.train_step(st, batches[0])
+    st, _ = ck.save_incremental(st)
+    return st
+
+
+# --------------------------------------------------------------- torn read
+
+
+def test_torn_read_predict_never_mixes_versions(tmp_path):
+    """Gate the snapshot swap on an event: predicts issued while the next
+    state is FULLY BUILT but unpublished must serve the old version
+    end-to-end; predicts after the swap serve the new one. Ordering is
+    enforced by events, not sleeps."""
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    p = Predictor(model, str(tmp_path))
+    req = strip_labels(batches[0])
+    old_probs, v0 = p.predict_versioned(req)
+
+    st = advance_delta(tr, st, ck, batches)
+    _, expect_new = tr.eval_step(st, batches[0])
+
+    built = threading.Event()
+    release = threading.Event()
+
+    def gate():
+        built.set()
+        assert release.wait(timeout=60)
+
+    p._pre_swap = gate
+    poll_result = {}
+
+    def updater():
+        poll_result["changed"] = p.poll_updates()
+
+    th = threading.Thread(target=updater)
+    th.start()
+    assert built.wait(timeout=60)
+    # The next state exists and is warmed; the live snapshot must still be
+    # the OLD one, and a predict must be old-version in BOTH fields.
+    mid_probs, v_mid = p.predict_versioned(req)
+    assert v_mid == v0
+    np.testing.assert_array_equal(np.asarray(mid_probs),
+                                  np.asarray(old_probs))
+    assert p.model_info()["model_version"] == v0
+    release.set()
+    th.join(timeout=60)
+    assert poll_result["changed"] is True
+
+    new_probs, v1 = p.predict_versioned(req)
+    assert v1 == v0 + 1
+    np.testing.assert_allclose(np.asarray(new_probs),
+                               np.asarray(expect_new), atol=1e-6)
+    # the OLD snapshot's arrays were never invalidated by the update
+    # (no donation, no in-place writes): predicts against the retained
+    # reference still reproduce the old answers exactly
+    assert np.abs(np.asarray(new_probs) - np.asarray(old_probs)).max() > 1e-6
+
+
+def test_torn_read_through_model_server_stamped_versions(tmp_path):
+    """Same contract through the coalescing front: requests racing a gated
+    update each carry ONE stamped version, and every pre-swap answer is
+    the old model's bit-for-bit."""
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=64,
+                         max_wait_ms=2)
+    p = server.predictor
+    req = strip_labels(batches[0])
+    single = {k: v[:4] for k, v in req.items()}
+    old_out, v0 = server.request_versioned(single)
+
+    st = advance_delta(tr, st, ck, batches)
+    built = threading.Event()
+    release = threading.Event()
+    p._pre_swap = lambda: (built.set(), release.wait(timeout=60)) and None
+
+    th = threading.Thread(target=p.poll_updates)
+    th.start()
+    try:
+        assert built.wait(timeout=60)
+        outs = [None] * 6
+        errs = []
+
+        def client(i):
+            try:
+                outs[i] = server.request_versioned(single)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(outs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        for out, v in outs:
+            assert v == v0  # swap is gated: every answer is old-version
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(old_out))
+    finally:
+        release.set()
+        th.join(timeout=60)
+    new_out, v1 = server.request_versioned(single)
+    assert v1 == v0 + 1
+    assert np.abs(np.asarray(new_out) - np.asarray(old_out)).max() > 1e-6
+    server.close()
+
+
+# ------------------------------------------------- shadow replay parity
+
+
+def table_ints(ck, state):
+    """Occupied-row content of every table, sorted by (member, key):
+    slot ASSIGNMENT may legally differ between import orders (probe claim
+    races), table CONTENT may not — so compare the key→row mapping, with
+    float payloads viewed as raw bytes for bit-exactness."""
+    from deeprec_tpu.embedding.table import empty_key
+
+    out = {}
+    for bname, b in ck.trainer.bundles.items():
+        nps = _state_to_np(state.tables[bname])
+        C = nps["keys"].shape[-1]
+        keys = nps["keys"].reshape(-1)
+        member = np.repeat(np.arange(keys.shape[0] // C), C)
+        vals = nps["values"].reshape(keys.shape[0], -1)
+        freq = nps["freq"].reshape(-1)
+        ver = nps["version"].reshape(-1)
+        occ = keys != empty_key(b.table.cfg)
+        order = np.lexsort((keys[occ], member[occ]))
+        out[bname] = {
+            "keys": keys[occ][order],
+            "member": member[occ][order],
+            "value_bits": np.ascontiguousarray(
+                vals[occ][order]).view(np.uint8),
+            "freq": freq[occ][order],
+            "version": ver[occ][order],
+        }
+    return out
+
+
+FIELDS = ("keys", "member", "value_bits", "freq", "version")
+
+
+def test_shadow_chunked_replay_bit_identical_to_legacy(tmp_path):
+    """restore_into with a fixed chunk == the legacy one-shot import,
+    bit-identical on table ints; and the input (live) state is untouched
+    by every replay (the functional contract the atomic swap rests on)."""
+    import os
+
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    p = Predictor(model, str(tmp_path))  # restores with default chunk
+    live = p._state
+    req = strip_labels(batches[0])
+    old_probs = np.asarray(p.predict(req))
+
+    st = advance_delta(tr, st, ck, batches)
+    incr = sorted(d for d in p._dirs() if d.startswith("incr-"))
+    assert incr, "expected an incremental checkpoint"
+    path = os.path.join(str(tmp_path), incr[-1])
+    legacy = ck._apply_ckpt(live, path, load_dense=True)  # one-shot import
+    b_ints = table_ints(ck, legacy)
+    for chunk in (64, 4096):
+        shadow = ck.restore_into(live, path, chunk=chunk)
+        a_ints = table_ints(ck, shadow)
+        for bname in a_ints:
+            for field in FIELDS:
+                np.testing.assert_array_equal(
+                    a_ints[bname][field], b_ints[bname][field],
+                    err_msg=f"{bname}/{field} chunk={chunk}")
+        assert int(shadow.step) == int(st.step)
+    # live snapshot untouched: the predictor still serves the OLD answers
+    np.testing.assert_array_equal(np.asarray(p.predict(req)), old_probs)
+    assert p.step == 5
+
+
+def test_full_restore_chunked_matches_unchunked(tmp_path):
+    """Full restore through the fixed-chunk path serves the same model as
+    the exact-shape restore (Predictor init parity across chunk sizes)."""
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    exact = ck.restore()
+    chunked = ck.restore(chunk=128)
+    a_ints, b_ints = table_ints(ck, chunked), table_ints(ck, exact)
+    for bname in a_ints:
+        for field in FIELDS:
+            np.testing.assert_array_equal(
+                a_ints[bname][field], b_ints[bname][field],
+                err_msg=f"{bname}/{field}")
+
+
+# ---------------------------------------------- parse_features vectorized
+
+
+def _legacy_ragged_pad(v, L, pad_value, want):
+    """The pre-PR5 per-row Python implementation, kept as the parity
+    oracle for the vectorized pad_ragged."""
+    rows = [(r + [pad_value] * (L - len(r)))[:L] for r in v]
+    return np.asarray(rows, want)
+
+
+def test_parse_features_vectorized_parity(tmp_path):
+    from deeprec_tpu.serving.predictor import pad_ragged
+
+    rng = np.random.default_rng(0)
+    L, pad_value = 6, -1
+    cases = {
+        "ragged": [[7, 8, 9], [10], [], [1, 2, 3, 4, 5]],
+        "over_long": [list(range(12)), list(range(9)), [3]],
+        "exact": [[1, 2, 3, 4, 5, 6], [9, 9, 9, 9, 9, 9]],
+        "random": [list(map(int, rng.integers(0, 100, rng.integers(0, 11))))
+                   for _ in range(64)],
+    }
+    for name, v in cases.items():
+        for want in (np.dtype(np.int64), np.dtype(np.int32)):
+            got = pad_ragged(v, L, pad_value, want)
+            ref = _legacy_ragged_pad(v, L, pad_value, want)
+            np.testing.assert_array_equal(got, ref, err_msg=name)
+            assert got.dtype == ref.dtype
+
+    # end-to-end through parse_features on a real model: ragged, over-long
+    # and scalar-bag forms all coerce identically to the legacy rules
+    from deeprec_tpu.data import SyntheticBehaviorSequence
+    from deeprec_tpu.models import DIN
+
+    model = DIN(emb_dim=4, capacity=1 << 10, hidden=(8,))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticBehaviorSequence(batch_size=16, vocab=100, seq_len=6,
+                                    seed=1)
+    st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp_path), tr)
+    ck.save(st)
+    p = Predictor(model, str(tmp_path))
+    from deeprec_tpu.serving.predictor import parse_features
+
+    seq_feats = [f for f in tr.sparse_specs if f.max_len]
+    assert seq_feats
+    feats = {
+        "user": [1, 2, 3],
+        "target_item": [3, 4, 5],
+        "target_cat": [5, 6, 7],
+        "hist_items": [[7, 8, 9], list(range(20)), []],   # ragged+overlong
+        "hist_cats": [[1], [2, 3], [4, 5, 6, 7, 8, 9, 10]],
+    }
+    batch = parse_features(p, feats)
+    for f in seq_feats:
+        L = f.max_len
+        ref = _legacy_ragged_pad(feats[f.name], L, f.pad_value,
+                                 p.feature_dtypes[f.name])
+        np.testing.assert_array_equal(batch[f.name], ref)
+    # scalar bags still widen to [B, 1] then pad
+    scalar = dict(feats)
+    scalar["hist_items"] = [7, 8, 9]
+    b2 = parse_features(p, scalar)
+    assert b2["hist_items"].shape == (3, seq_feats[0].max_len)
+    # garbage inside a bag is a BadRequest, not a crash
+    from deeprec_tpu.serving.predictor import BadRequest
+
+    bad = dict(feats)
+    bad["hist_items"] = [["x", "y"], [1]]
+    with pytest.raises(BadRequest):
+        parse_features(p, bad)
+
+
+# ------------------------------------------------------- HTTP robustness
+
+
+def test_http_body_cap_and_malformed_json(tmp_path):
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=32,
+                         max_wait_ms=1)
+    http = HttpServer(server, port=0, max_body_bytes=4096).start()
+    base = f"http://127.0.0.1:{http.port}"
+    feats = {k: np.asarray(v)[:2].tolist()
+             for k, v in strip_labels(batches[0]).items()}
+
+    def post(body, headers=None):
+        req = urllib.request.Request(
+            base + "/v1/predict", data=body,
+            headers=headers or {"Content-Type": "application/json"},
+            method="POST")
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        # oversized body: structured 400 with the limit, not a 500/OOM
+        big = json.dumps(
+            {"features": {k: v * 500 for k, v in feats.items()}}
+        ).encode()
+        assert len(big) > 4096
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(big)
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read())
+        assert err["error"] == "request body too large"
+        assert err["limit_bytes"] == 4096
+        assert err["content_length"] == len(big)
+
+        # malformed JSON: structured 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(b'{"features": {oops')
+        assert ei.value.code == 400
+        assert "bad json" in json.loads(ei.value.read())["error"]
+
+        # and the server still serves fine afterwards, version-stamped
+        out = json.loads(post(
+            json.dumps({"features": feats}).encode()).read())
+        assert len(out["predictions"]) == 2
+        assert out["model_version"] == server.predictor.version
+    finally:
+        http.stop()
+        server.close()
+
+
+def test_http_stats_endpoint_live(tmp_path):
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=32,
+                         max_wait_ms=1)
+    http = HttpServer(server, port=0).start()
+    base = f"http://127.0.0.1:{http.port}"
+    feats = {k: np.asarray(v)[:4].tolist()
+             for k, v in strip_labels(batches[0]).items()}
+
+    def call(path, payload=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="GET" if payload is None else "POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        for _ in range(3):
+            call("/v1/predict", {"features": feats})
+        stats = call("/v1/stats")
+        assert stats["requests"] == 3 and stats["rows"] == 12
+        assert stats["batches"] >= 1 and stats["errors"] == 0
+        for stage in ("queue", "pad", "device", "post", "e2e"):
+            s = stats["stages"][stage]
+            assert s["count"] >= 3, stage
+            assert s["max_ms"] >= 0.0 and s["p99_ms"] >= s["p50_ms"] >= 0.0
+        assert stats["model"]["version"] == server.predictor.version
+        assert stats["model"]["step"] == 5
+
+        # a delta update shows up in the update counters + version bump
+        advance_delta(tr, st, ck, batches)
+        assert call("/v1/reload", {})["updated"] is True
+        stats2 = call("/v1/stats")
+        assert stats2["model"]["updates"] == 1
+        assert stats2["model"]["version"] == stats["model"]["version"] + 1
+        assert stats2["model"]["last_update_ms"] > 0
+        # the named-model route serves the same body shape
+        named = call("/v1/models/default/stats")
+        assert named["model"]["version"] == stats2["model"]["version"]
+    finally:
+        http.stop()
+        server.close()
+
+
+# ----------------------------------------------------- group dispatcher
+
+
+def test_server_group_shared_queue_and_device_pinning(tmp_path):
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    assert len(jax.local_devices()) >= 2
+    group = ServerGroup(model, str(tmp_path), replicas=3, max_wait_ms=1.0)
+    try:
+        # one member per DISTINCT device, all draining one shared queue
+        assert len(group.members) == 3
+        qs = {id(m._q) for m in group.members}
+        assert qs == {id(group._q)}
+        devs = [
+            next(iter(jax.tree.leaves(m.predictor._state))).devices().pop()
+            for m in group.members
+        ]
+        assert len(set(devs)) == 3
+        req = strip_labels(batches[0])
+        expect = np.asarray(Predictor(model, str(tmp_path)).predict(req))
+        outs = [None] * 8
+        errs = []
+
+        def client(i):
+            try:
+                sl = {k: v[i * 4: i * 4 + 4] for k, v in req.items()}
+                outs[i] = np.asarray(group.request(sl))
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        np.testing.assert_allclose(np.concatenate(outs), expect[:32],
+                                   rtol=2e-5, atol=2e-5)
+        snap = group.stats_snapshot()
+        assert snap["replicas"] == 3 and snap["requests"] == 8
+    finally:
+        group.close()
+
+
+def test_server_group_degrades_to_single_member_on_one_device(
+        tmp_path, monkeypatch):
+    """The negative-scaling fix: requested replicas cap at the device
+    count — N members thrashing one backend is replaced by one member
+    batching for it."""
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    one = jax.local_devices()[:1]
+    monkeypatch.setattr(jax, "local_devices", lambda *a, **k: one)
+    group = ServerGroup(model, str(tmp_path), replicas=4, max_wait_ms=1.0)
+    try:
+        assert len(group.members) == 1
+        assert group.predictor.model_info()["replicas"] == 1
+        req = strip_labels(batches[0])
+        out = np.asarray(group.request({k: v[:4] for k, v in req.items()}))
+        assert out.shape == (4,)
+    finally:
+        group.close()
+
+
+def test_batches_never_overflow_bucket_ladder(tmp_path):
+    """A request that would push the forming batch past max_batch ROWS is
+    carried to the NEXT batch instead of producing an off-ladder shape
+    (off-ladder totals trace fresh XLA programs under live traffic)."""
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=8,
+                         max_wait_ms=5.0)
+    req = strip_labels(batches[0])
+    five = {k: v[:5] for k, v in req.items()}
+    outs = [None] * 10
+    errs = []
+
+    def client(i):
+        try:
+            outs[i] = np.asarray(server.request(five))
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not errs, errs
+        assert all(o is not None and o.shape == (5,) for o in outs)
+        snap = server.stats.snapshot()
+        assert snap["requests"] == 10
+        # no batch ever exceeded max_batch rows (5+5 > 8 -> one per batch)
+        assert snap["batch_rows"]["max"] <= 8
+    finally:
+        server.close()
+
+
+# ----------------------------------------------------- adaptive batching
+
+
+def test_adaptive_wait_policy(tmp_path):
+    """Deadline tuning is pure arithmetic over the EWMA estimate — pin the
+    policy, not wall-clock: full buckets never wait, sparse traffic never
+    waits, dense traffic waits only long enough to fill the bucket,
+    capped by max_wait."""
+    from deeprec_tpu.serving.predictor import _ArrivalEWMA
+
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    server = ModelServer(Predictor(model, str(tmp_path)), max_batch=64,
+                         max_wait_ms=2.0)
+    try:
+        # no history yet: fixed behavior
+        assert server._pick_wait(8) == server.max_wait
+        # full bucket: dispatch now
+        assert server._pick_wait(64) == 0.0
+        # sparse traffic (inter-arrival many windows out): dispatch now
+        server._arrivals._tau, server._arrivals._rows = 0.5, 8.0
+        assert server._pick_wait(8) == 0.0
+        # bursty-but-live traffic (a few windows): wait the cap — closed-
+        # loop bursts must still coalesce
+        server._arrivals._tau = 2.5 * server.max_wait
+        assert server._pick_wait(8) == server.max_wait
+        # dense traffic: wait ≈ tau * requests-needed, under the cap
+        server._arrivals._tau = 50e-6
+        want = 50e-6 * (64 - 8) / 8.0
+        assert abs(server._pick_wait(8) - want) < 1e-9
+        # ...and the cap binds when the bucket is far from full
+        server._arrivals._rows = 1.0
+        assert server._pick_wait(1) == server.max_wait
+        # fixed mode ignores the estimator entirely
+        server.adaptive = False
+        assert server._pick_wait(8) == server.max_wait
+
+        ew = _ArrivalEWMA()
+        ew.note(0.0, 4)
+        assert ew.estimate() == (None, 4.0)  # one arrival: no interval yet
+        ew.note(0.010, 4)
+        tau, rows = ew.estimate()
+        assert tau == pytest.approx(0.010) and rows == 4.0
+    finally:
+        server.close()

@@ -10,11 +10,18 @@ gap). Run:
         [--seconds 5] [--rows 8] [--out SERVING_BENCH.json]
 
 Prints one JSON line per configuration:
-    {"config": "group-2", "rps": ..., "p50_ms": ..., "p99_ms": ...}
-and, for the largest group, an extra phase where a new checkpoint lands
-mid-load and rolls across the replicas:
+    {"config": "group-2", "rps": ..., "p50_ms": ..., "p99_ms": ...,
+     "stages": {"queue": {...}, "pad": {...}, "device": {...},
+                "post": {...}, "e2e": {...}}}
+(the `stages` breakdown is the server's own /v1/stats accounting for the
+measured window) and, for the largest group, extra phases where a new
+checkpoint lands mid-load and rolls across the replicas:
     {"config": "group-4+rolling-update", ..., "during_update_p99_ms": ...,
-     "model_version_advanced": true}
+     "during_update_max_ms": ..., "model_version_advanced": true}
+
+`--smoke` runs a tiny two-config pass (CI: compiles both the single and
+group dispatch paths, lands one delta update mid-load, checks /v1/stats
+over HTTP) and asserts structure, not timings.
 
 On a TPU host run WITHOUT JAX_PLATFORMS=cpu to serve from the chip.
 """
@@ -70,6 +77,12 @@ def build(tmp, emb_dim=16, steps=5):
             st, _ = ck.save(st)
         return int(st.step)
 
+    # Prime the trainer-side incremental-save programs (dirty compaction
+    # traces/compiles on first use): the co-located trainer is bench
+    # STIMULUS, not the system under test — on this shared host its
+    # first-save compiles would otherwise bleed into the measured serving
+    # window. Production serving hosts don't run the trainer at all.
+    save_next("delta")
     return model, req, save_next
 
 
@@ -132,7 +145,7 @@ def pct(lat, q):
     return lat[min(int(q * len(lat)), len(lat) - 1)]
 
 
-def summarize(name, recs, seconds, clients, rows, extra=None):
+def summarize(name, recs, seconds, clients, rows, extra=None, server=None):
     lat = [dt for _, dt in recs]
     out = {
         "config": name,
@@ -145,6 +158,15 @@ def summarize(name, recs, seconds, clients, rows, extra=None):
         "p99_ms": round(1e3 * pct(lat, 0.99), 2),
         "backend": __import__("jax").default_backend(),
     }
+    if server is not None:
+        # the server's own stage accounting for the measured window —
+        # identical numbers to a live GET /v1/stats
+        snap = server.stats_snapshot()
+        out["stages"] = snap["stages"]
+        out["batches"] = snap["batches"]
+        out["model"] = snap["model"]
+        if "replicas" in snap:
+            out["replicas"] = snap["replicas"]
     out.update(extra or {})
     return out
 
@@ -159,7 +181,13 @@ def main():
                     help="rows per client request")
     ap.add_argument("--out", default=None,
                     help="also write the result list to this JSON file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CI pass: single + group-2, one delta update "
+                         "mid-load, structural asserts (stats present, "
+                         "version advanced, zero failed requests)")
     args = ap.parse_args()
+    if args.smoke:
+        args.groups, args.seconds, args.clients, args.rows = "2", 1.2, 4, 4
     groups = [int(g) for g in args.groups.split(",") if g]
 
     import numpy as np
@@ -191,42 +219,68 @@ def main():
                 model, tmp, replicas=g, max_batch=256, max_wait_ms=1.0)))
             for g in groups
         ]
+        if args.smoke:
+            configs = [c for c in configs if c[0] != "single-nobatch"]
         for name, make in configs:
             server = make()
             server.warmup({k: np.asarray(v)[:args.rows]
                            for k, v in req.items()})
             http = HttpServer(server, port=0).start()
             try:
-                # settle, then measure
+                # settle, then measure (stats cover the measured window only)
                 drive(http.port, payloads, 0.5, 2)
+                server.stats.reset()
                 recs = drive(http.port, payloads, args.seconds, args.clients)
                 out = summarize(name, recs, args.seconds, args.clients,
-                                args.rows)
+                                args.rows, server=server)
                 results.append(out)
                 print(json.dumps(out), flush=True)
+                if args.smoke:
+                    check_smoke_config(out, http)
 
                 if groups and name == f"group-{max(groups)}":
-                    # full reload first, then the delta (DeltaModelUpdate)
-                    # path — the blip the incremental format exists to shrink
-                    results.append(rolling_update_phase(
-                        server, http, payloads, args, name, save_next))
-                    results.append(rolling_update_phase(
-                        server, http, payloads, args, name,
-                        lambda: save_next("delta"), label="+delta-update"))
-                    # second delta hits the compile cache (import_rows
-                    # buckets row counts) — the serving-cadence steady state
-                    results.append(rolling_update_phase(
-                        server, http, payloads, args, name,
-                        lambda: save_next("delta"),
-                        label="+delta-update-warm"))
+                    phases = [(save_next, "+rolling-update"),
+                              (lambda: save_next("delta"), "+delta-update"),
+                              # second delta runs entirely on warm compile
+                              # caches — the serving-cadence steady state
+                              (lambda: save_next("delta"),
+                               "+delta-update-warm")]
+                    if args.smoke:
+                        phases = phases[1:2]  # one delta update is enough
+                    for fn, label in phases:
+                        results.append(rolling_update_phase(
+                            server, http, payloads, args, name, fn,
+                            label=label))
             finally:
                 http.stop()
                 server.close()
+        if args.smoke:
+            check_smoke_results(results, groups)
+            print("bench_serving smoke OK", flush=True)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump({"results": results,
                            "protocol": vars(args)}, f, indent=1)
         return results
+
+
+def check_smoke_config(out, http):
+    """Structural asserts for one measured config: the stage breakdown is
+    present and the SAME accounting is served live over /v1/stats."""
+    for stage in ("queue", "pad", "device", "post", "e2e"):
+        assert out["stages"][stage]["count"] > 0, (out["config"], stage)
+    live = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{http.port}/v1/stats", timeout=10).read())
+    assert set(live["stages"]) == set(out["stages"])
+    assert live["model"]["version"] >= 0
+
+
+def check_smoke_results(results, groups):
+    by_name = {r["config"]: r for r in results}
+    upd = by_name[f"group-{max(groups)}+delta-update"]
+    assert upd["model_version_advanced"], upd
+    assert upd["during_update_p99_ms"] is not None
+    assert upd["model"]["updates"] >= 1
 
 
 def rolling_update_phase(server, http, payloads, args, name, save_next,
@@ -237,6 +291,7 @@ def rolling_update_phase(server, http, payloads, args, name, save_next,
     model version actually advanced with zero failed requests (drive()
     raises on any failure)."""
     v0 = server.predictor.model_info().get("step")
+    server.stats.reset()
     window = {}
     done = threading.Event()
 
@@ -271,7 +326,7 @@ def rolling_update_phase(server, http, payloads, args, name, save_next,
     v1 = server.predictor.model_info().get("step")
     out = summarize(
         name + label, recs, elapsed, args.clients,
-        args.rows,
+        args.rows, server=server,
         extra={
             "steady_p99_ms": (
                 round(1e3 * pct(steady, 0.99), 2) if steady else None),
