@@ -399,6 +399,10 @@ def _profile_phases(trainer, batches):
         lambda tables, b, step: trainer._lookup_all(tables, b, step, True)[0],
         donate_argnums=0,
     )
+    # The hoistable routing phase (id dedup + id exchange; ids only, no
+    # table state) — what pipeline_mode="lookahead" overlaps with the
+    # dense compute. Timed standalone so the overlap model has a number.
+    route_jit = jax.jit(lambda b: trainer._route_all(b, True))
 
     def sparse(tables, b, step):
         tables, views, bundle_res = trainer._lookup_all(
@@ -424,9 +428,14 @@ def _profile_phases(trainer, batches):
     # compile outside the timed loop; thread the donated tables through
     tables = lookup_jit(dict(state.tables), b0, step0)
     tables = sparse_jit(tables, b0, step0)
+    routes = route_jit(b0)
     jax.block_until_ready(jax.tree.leaves(tables)[0])
+    jax.block_until_ready(jax.tree.leaves(routes)[0])
     for i in range(8):
         b = batches[i % len(batches)]
+        with prof.phase("route"):
+            routes = route_jit(b)
+            jax.block_until_ready(jax.tree.leaves(routes)[0])
         with prof.phase("lookup"):
             tables = lookup_jit(tables, b, step0)
             jax.block_until_ready(jax.tree.leaves(tables)[0])
@@ -441,6 +450,104 @@ def _profile_phases(trainer, batches):
         rep["step"]["min_ms"] - rep["lookup_plus_apply"]["min_ms"], 3
     )
     return rep
+
+
+def _pipeline_report(trainer, batches, B, k_curve, K, pipeline_arg, smoke):
+    """In-step pipelining artifact (round 11): measure the K-step scan
+    under each `pipeline_mode` on the identical protocol (`_measure_k` per
+    arm; the "off" arm is the already-measured k_curve entry), time the
+    hoistable routing phase standalone, and put the measured pipelined
+    step next to the overlap model (`ops/traffic.py
+    modeled_overlap_step`: exchange time max'd with — not added to —
+    dense time).  `tools/roofline.py --assert-overlap <json>` gates CI on
+    this section: the pipelined arms must not regress past tolerance and
+    the overlap efficiency (modeled / measured) must be recorded."""
+    from deeprec_tpu.ops import traffic as T
+    from deeprec_tpu.training import Trainer
+
+    chunks = 4
+    reps = 2 if smoke else 3
+    timed_steps = 8 if smoke else int(os.environ.get("BENCH_TIMED_STEPS", "32"))
+    # "grid" = off + lookahead. The chunked arm only differs on SHARDED
+    # exchanges (ShardedTable.exchange_chunks); on this single-device
+    # protocol it compiles the identical program, so the grid skips it —
+    # tools/bench_async.py --pipeline-mode chunked is the mesh measurement.
+    # An explicit --pipeline-mode chunked still measures it here on request.
+    modes = ["off", "lookahead"]
+    if pipeline_arg in ("lookahead", "chunked"):
+        modes = ["off", pipeline_arg]
+
+    # Pipelining only engages on the K-step scan; measure every arm at the
+    # same K >= 2 (the already-measured k_curve entry serves the "off" arm
+    # when it matches).
+    K_pipe = max(K, 2)
+    grid = {}
+    for mode in modes:
+        if mode == "off" and str(K_pipe) in k_curve:
+            head = k_curve[str(K_pipe)]
+            grid[mode] = {
+                "ms_per_step": head["ms_per_step"],
+                "examples_per_sec": head["examples_per_sec"],
+            }
+            continue
+        # Same model object + optimizers as the headline trainer (bundles
+        # are rebuilt per trainer, so sharing the stateless model is safe)
+        # — the arms can never drift from the measured protocol.
+        tr = Trainer(
+            trainer.model, trainer.sparse_opt, trainer.dense_opt,
+            grad_averaging=trainer.grad_averaging,
+            unique_budget=trainer.unique_budget, pipeline_mode=mode,
+            pipeline_chunks=chunks,
+        )
+        stats, _ = _measure_k(tr, batches, B, K_pipe, timed_steps, reps)
+        grid[mode] = {
+            "ms_per_step": stats["ms_per_step"],
+            "examples_per_sec": stats["examples_per_sec"],
+        }
+
+    # Phase decomposition for the model: route (hoistable), dense
+    # (overlap target), other (stays serial: value gather + embedding
+    # exchange + apply + dense update). Sub-program timings come off the
+    # single-step path; the off-arm K-scan step anchors the total.
+    phases = _profile_phases(trainer, batches)
+    route_ms = phases["route"]["min_ms"]
+    dense_ms = max(
+        0.0, phases["step"]["min_ms"] - phases["lookup_plus_apply"]["min_ms"]
+    )
+    step_off_ms = grid["off"]["ms_per_step"]
+    other_ms = max(0.0, step_off_ms - dense_ms - route_ms)
+    modeled = {
+        mode: round(T.modeled_overlap_step(
+            dense_ms=dense_ms, route_ms=route_ms, other_ms=other_ms,
+            mode=mode, chunks=chunks,
+        ), 3)
+        for mode in grid
+    }
+    pipe_modes = [m for m in grid if m != "off"]
+    eff = {
+        m: round(modeled[m] / grid[m]["ms_per_step"], 4)
+        for m in pipe_modes
+        if grid[m]["ms_per_step"] > 0
+    }
+    report = {
+        "modes": grid,
+        "chunks": chunks,
+        "steps_per_dispatch": K_pipe,
+        "phase_ms": {
+            "route": route_ms,
+            "dense": round(dense_ms, 3),
+            "other": round(other_ms, 3),
+        },
+        "modeled_ms": modeled,
+        # modeled max(exchange, dense) step vs the measured pipelined step:
+        # 1.0 = the overlap the model promises fully materialized; CPU runs
+        # (no async collectives) sit below it by construction.
+        "overlap_efficiency": eff,
+        "modeled_buffer_bytes": round(T.dlrm_reference_traffic(
+            pipeline_mode="lookahead",
+        )["pipeline_buffer_bytes"]),
+    }
+    return report, phases
 
 
 def workload():
@@ -495,8 +602,18 @@ def workload():
 
     traffic = _traffic_report(trainer, budget_mode, dedup_stats)
     ckpt = _ckpt_report()
+    # In-step pipelining grid: measured off/lookahead(/chunked) arms +
+    # the overlap model + overlap efficiency (round 11). "off" skips it.
+    pipeline_arg = os.environ.get("BENCH_PIPELINE", "grid")
+    pipeline, pipe_phases = (
+        _pipeline_report(trainer, batches, B, k_curve, K, pipeline_arg, smoke)
+        if pipeline_arg != "off"
+        else (None, None)
+    )
+    # --profile reuses the phase breakdown the pipeline report already
+    # measured instead of running the (multi-second) protocol twice.
     phases = (
-        _profile_phases(trainer, batches)
+        (pipe_phases or _profile_phases(trainer, batches))
         if os.environ.get("BENCH_PROFILE") == "1"
         else None
     )
@@ -547,6 +664,11 @@ def workload():
                 # the incremental-save transfer diet (dirty-compacted vs
                 # full-table device->host bytes).
                 "ckpt": ckpt,
+                # In-step pipelining (round 11): per-mode K-scan step time,
+                # phase decomposition (route / dense / other), the overlap
+                # model and its efficiency vs measurement — gated by
+                # tools/roofline.py --assert-overlap in CI smoke.
+                **({"pipeline": pipeline} if pipeline else {}),
                 **({"phases": phases} if phases else {}),
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
@@ -577,6 +699,15 @@ def main():
                    help="hash dedup unique budget: 'auto' (measured EMA, "
                         "default), an int (fixed ids per lookup), or 'off' "
                         "(legacy full-batch sort-unique)")
+    p.add_argument("--pipeline-mode",
+                   default=os.environ.get("BENCH_PIPELINE", "grid"),
+                   choices=["off", "lookahead", "chunked", "grid"],
+                   help="in-step pipelining arms to measure on the K-step "
+                        "scan: 'grid' (default) records off + lookahead "
+                        "with the overlap model under JSON 'pipeline' "
+                        "(chunked only differs on sharded exchanges — see "
+                        "tools/bench_async.py); a single mode measures "
+                        "just off + that arm; 'off' skips the section")
     p.add_argument("--profile", action="store_true",
                    help="add a per-phase step breakdown (lookup / sparse "
                         "apply / dense+overhead, training/profiler.py) to "
@@ -595,6 +726,7 @@ def main():
     os.environ["BENCH_REPS"] = str(args.reps)
     os.environ["BENCH_TIMED_STEPS"] = str(args.timed_steps)
     os.environ["BENCH_UNIQUE_BUDGET"] = str(args.unique_budget)
+    os.environ["BENCH_PIPELINE"] = str(args.pipeline_mode)
     if args.profile:
         os.environ["BENCH_PROFILE"] = "1"
     if args.smoke:

@@ -43,7 +43,8 @@ echo "== serving bench (CPU smoke: single + group dispatch, delta update mid-loa
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke
 
 echo "== bench (CPU smoke; real numbers come from TPU) =="
-env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 python bench.py \
+env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
+    BENCH_PIPELINE=grid python bench.py \
     | tee /tmp/deeprec_bench_smoke.out
 tail -n 1 /tmp/deeprec_bench_smoke.out > /tmp/deeprec_bench_smoke.json
 
@@ -51,6 +52,11 @@ echo "== traffic model vs measured op counts (drift fails the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-traffic /tmp/deeprec_bench_smoke.json
 
+echo "== in-step pipelining grid vs overlap model (regression fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-overlap /tmp/deeprec_bench_smoke.json
+
 echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
-    BENCH_TIMED_STEPS=4 BENCH_K=4 python bench.py --unique-budget off
+    BENCH_TIMED_STEPS=4 BENCH_K=4 BENCH_PIPELINE=off \
+    python bench.py --unique-budget off

@@ -454,6 +454,62 @@ class EmbeddingTable:
             unique_size,
         )
 
+    def _route_ids(
+        self, ids: jnp.ndarray, pad_value: int,
+        unique_size: Optional[int],
+    ):
+        """Routing half of a lookup (ops/dedup.py `route_ids`): flatten +
+        pad-collapse + dedup. Pure function of the id batch — no table
+        state — so pipelined trainers hoist it a full step ahead."""
+        from deeprec_tpu.ops import dedup
+
+        return dedup.route_ids(
+            ids, pad_value=pad_value, sentinel=empty_key(self.cfg),
+            unique_size=unique_size,
+        )
+
+    def _resolve_routed(
+        self,
+        state: TableState,
+        route,
+        *,
+        step,
+        train: bool,
+        salt=None,
+    ) -> Tuple[TableState, UniqueLookup]:
+        """Key/metadata half on a prepared route: probe/insert, metadata
+        stamp, init-scatter for created rows, admission, dedup telemetry —
+        everything EXCEPT the value-row gather (`_finish_resolved`). The
+        returned result carries placeholder (0-sized) embeddings/rows;
+        `rows.size == 0` is the documented "not gathered yet" sentinel.
+
+        Hoist contract (the basis of the exact pipelined scan): nothing
+        here reads or writes the VALUE rows an apply touches — keys/meta
+        are apply-invariant on the diet hot path (stamp_meta=False), and
+        the init scatter only lands on slots that were empty at claim
+        time, which a concurrent apply (whose rows were all resident at
+        its own lookup) cannot overlap. So resolve(t+1) commutes with
+        apply(t) bit-exactly.
+        """
+        uids, inverse, counts, valid, overflow = route
+        state, res = self._resolve(
+            state, uids, counts, valid, step=step, train=train, salt=salt
+        )
+        if train:
+            # Seed the auto-budget EMA (Trainer.update_budgets) on every
+            # path; the overflow counter only moves under a budget.
+            state = state.replace(
+                dedup_unique=state.dedup_unique
+                + jnp.sum(valid).astype(jnp.int32),
+                dedup_ids=state.dedup_ids + jnp.sum(counts),
+                dedup_overflow=(
+                    state.dedup_overflow + overflow
+                    if overflow is not None
+                    else state.dedup_overflow
+                ),
+            )
+        return state, dataclasses.replace(res, inverse=inverse)
+
     def _lookup_unique_impl(
         self,
         state: TableState,
@@ -477,44 +533,18 @@ class EmbeddingTable:
         hash dedup engine (ops/dedup.py) at that static budget — every
         downstream op then runs at U instead of N, ids past the budget
         serve the blocked default and count into `dedup_overflow`.
+
+        Split-phase composition: route (`_route_ids`) → resolve
+        (`_resolve_routed`) → finish (`_finish_resolved`) — the pipelined
+        trainers call the three phases individually so the value gather
+        can land after the previous step's apply while everything else
+        hoists ahead of it.
         """
-        from deeprec_tpu.ops import dedup
-
-        cfg = self.cfg
-        flat = ids.reshape(-1)
-        N = flat.shape[0]
-        sentinel = jnp.asarray(empty_key(cfg), flat.dtype)
-        # Collapse padding onto the sentinel so it dedups to one fill entry.
-        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
-        if unique_size is None:
-            uids, inverse, counts = dedup.sort_unique(
-                flat, N, sentinel=empty_key(cfg)
-            )
-            overflow = None
-        else:
-            uids, inverse, counts, overflow = dedup.hash_dedup(
-                flat, unique_size, sentinel=empty_key(cfg)
-            )
-        inverse = inverse.reshape(ids.shape)  # position -> unique, in id layout
-        valid = uids != sentinel
-
-        state, res = self._lookup_resolved(
-            state, uids, counts, valid, step=step, train=train, salt=salt
+        route = self._route_ids(ids, pad_value, unique_size)
+        state, res = self._resolve_routed(
+            state, route, step=step, train=train, salt=salt
         )
-        if train:
-            # Seed the auto-budget EMA (Trainer.update_budgets) on every
-            # path; the overflow counter only moves under a budget.
-            state = state.replace(
-                dedup_unique=state.dedup_unique
-                + jnp.sum(valid).astype(jnp.int32),
-                dedup_ids=state.dedup_ids + jnp.sum(counts),
-                dedup_overflow=(
-                    state.dedup_overflow + overflow
-                    if overflow is not None
-                    else state.dedup_overflow
-                ),
-            )
-        return state, dataclasses.replace(res, inverse=inverse)
+        return state, self._finish_resolved(state, res)
 
     def _lookup_resolved(
         self,
@@ -528,7 +558,31 @@ class EmbeddingTable:
         salt=None,
     ) -> Tuple[TableState, UniqueLookup]:
         """Core lookup on already-unique ids (also the per-shard entry point
-        for sharded tables, where dedup happened before the all-to-all)."""
+        for sharded tables, where dedup happened before the all-to-all):
+        resolve (probe/insert/meta/init/admission) + finish (value gather)."""
+        state, res = self._resolve(
+            state, uids, counts, valid, step=step, train=train, salt=salt
+        )
+        return state, self._finish_resolved(state, res)
+
+    def _resolve(
+        self,
+        state: TableState,
+        uids: jnp.ndarray,
+        counts: jnp.ndarray,
+        valid: jnp.ndarray,
+        *,
+        step: jnp.ndarray | int,
+        train: bool,
+        salt=None,
+    ) -> Tuple[TableState, UniqueLookup]:
+        """Key/metadata half of `_lookup_resolved`: probe-or-insert keys,
+        fused metadata stamp, initializer scatter for created rows and the
+        admission decision — but NOT the value-row gather, which
+        `_finish_resolved` performs (the split the pipelined trainers use
+        to place the gather after the previous step's apply). Returns the
+        updated state and a UniqueLookup whose embeddings/rows are 0-sized
+        placeholders."""
         cfg = self.cfg
         step = jnp.asarray(step, jnp.int32)
 
@@ -581,16 +635,10 @@ class EmbeddingTable:
         elif need_filter:
             f_cur = meta[META_FREQ].at[safe_ix].get(mode="clip")
 
-        emb = self._gather(values, safe_ix, state.capacity)
-
         # Admission: counter filter gates on the (just updated) frequency.
         admitted = present
         if need_filter:
             admitted = present & (f_cur >= cfg.ev.counter_filter.filter_freq)
-        blocked_default = jnp.asarray(
-            cfg.ev.init.default_value_no_permission, emb.dtype
-        )
-        masked = jnp.where(admitted[:, None], emb, blocked_default)
 
         new_state = state.replace(
             keys=keys,
@@ -606,11 +654,31 @@ class EmbeddingTable:
             counts=counts,
             valid=valid,
             admitted=admitted,
-            embeddings=masked,
-            # Raw gathered rows ride along as the apply-side residual.
-            rows=emb,
+            # Placeholders until _finish_resolved gathers the value rows.
+            embeddings=jnp.zeros((0, 0), jnp.float32),
+            rows=jnp.zeros((0, 0), jnp.float32),
         )
         return new_state, res
+
+    def _finish_resolved(
+        self, state: TableState, res: UniqueLookup, keep_rows: bool = True
+    ) -> UniqueLookup:
+        """Value half of a lookup: gather the resolved rows from
+        `state.values` and apply the admission mask. Reads the CURRENT
+        values — in the pipelined scan this runs after the previous step's
+        apply, which is exactly what keeps the lookahead staleness-free.
+        `keep_rows=False` drops the raw-row residual (callers that will
+        never reuse it — the stale-by-one apply — avoid carrying a second
+        [U, D] buffer across dispatches); `rows.size == 0` stays the
+        documented "no residual, re-gather at apply" sentinel."""
+        safe_ix = jnp.where(res.slot_ix >= 0, res.slot_ix, 0)
+        emb = self._gather(state.values, safe_ix, state.capacity)
+        blocked_default = jnp.asarray(
+            self.cfg.ev.init.default_value_no_permission, emb.dtype
+        )
+        masked = jnp.where(res.admitted[:, None], emb, blocked_default)
+        rows = emb if keep_rows else jnp.zeros((0, 0), jnp.float32)
+        return dataclasses.replace(res, embeddings=masked, rows=rows)
 
     def lookup_readonly(
         self, state: TableState, ids: jnp.ndarray, pad_value: int = -1,

@@ -192,6 +192,42 @@ def hash_dedup(
     return uids, inverse, counts, overflow
 
 
+def route_ids(
+    ids: jnp.ndarray,
+    *,
+    pad_value,
+    sentinel,
+    unique_size: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """The apply-independent ROUTING half of a lookup: flatten, collapse
+    padding onto the sentinel, dedup (hash engine at `unique_size`, legacy
+    sort at None). A pure function of the id batch — it reads NO table
+    state — which is what lets the pipelined trainers hoist it (and, for
+    sharded tables, the id exchange built on it) a full step ahead of the
+    tables it will hit (docs/perf.md "in-step pipelining").
+
+    Returns `(uids [U], inverse [ids.shape], counts [U], valid [U],
+    overflow)` — overflow is None on the legacy sort path, a scalar int32
+    under a budget. Shared by the single-table lookup front-end
+    (`EmbeddingTable._route_ids`) and both sharded exchange paths
+    (`ShardedTable.route`), which used to duplicate it.
+    """
+    flat = ids.reshape(-1)
+    sent = jnp.asarray(sentinel, flat.dtype)
+    flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sent, flat)
+    if unique_size is None:
+        uids, inverse, counts = sort_unique(
+            flat, flat.shape[0], sentinel=sentinel
+        )
+        overflow = None
+    else:
+        uids, inverse, counts, overflow = hash_dedup(
+            flat, unique_size, sentinel=sentinel
+        )
+    valid = uids != sent
+    return uids, inverse.reshape(ids.shape), counts, valid, overflow
+
+
 def sort_unique(
     flat: jnp.ndarray, size: int, *, sentinel
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

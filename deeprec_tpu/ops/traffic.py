@@ -119,11 +119,15 @@ def dlrm_reference_traffic(
     num_shards: int = 1,
     comm: Optional[str] = None,
     exchange_dtype: str = "float32",
+    pipeline_mode: str = "off",
 ) -> Dict[str, float]:
     """Whole-model per-step traffic at the reference DLRM shape (26 single-
     hot features, dim 16, Adagrad).  `unique_fraction` scales the per-table
     touched rows (the dedup budget); sharded shapes split the batch across
-    devices and add the exchange term."""
+    devices and add the exchange term.  `pipeline_mode != "off"` adds the
+    lookahead's double-buffer residency under "pipeline_buffer_bytes"
+    (per-step traffic itself is unchanged by pipelining — same ops,
+    reordered)."""
     wire_bytes = 2 if exchange_dtype == "bfloat16" else 4
     local_batch = batch // max(num_shards, 1)
     U = max(1, int(round(local_batch * unique_fraction)))
@@ -131,7 +135,92 @@ def dlrm_reference_traffic(
         unique=U, dim=dim, slot_widths=slot_widths, diet=diet,
         num_shards=num_shards, comm=comm, wire_bytes=wire_bytes,
     )
-    return {k: v * num_tables for k, v in per_table.items()}
+    out = {k: v * num_tables for k, v in per_table.items()}
+    out["pipeline_buffer_bytes"] = num_tables * pipeline_buffer_bytes(
+        unique=U, dim=dim, positions=local_batch, num_shards=num_shards,
+        comm=comm, pipeline_mode=pipeline_mode,
+    )
+    return out
+
+
+# ---------------------------------------------------------- pipelining model
+
+
+def pipeline_buffer_bytes(
+    *,
+    unique: int,
+    dim: int,
+    positions: Optional[int] = None,
+    value_bytes: int = 4,
+    key_bytes: int = 4,
+    num_shards: int = 1,
+    comm: Optional[str] = None,
+    pipeline_mode: str = "lookahead",
+) -> float:
+    """Extra RESIDENT bytes per table of the one-batch lookahead
+    (`pipeline_mode != "off"`): the pipelined K-step scan double-buffers
+    one in-flight lookup — the carried batch's finished embedding buffer,
+    its routing arrays and the owner-side residual live alongside the
+    current step's. This is capacity, not per-step traffic: the per-step
+    byte totals of `table_step_traffic` are unchanged by pipelining (the
+    same ops run, reordered), which is why `roofline.py --assert-traffic`
+    needs no pipeline-mode arms — this function accounts the HBM headroom
+    the lookahead costs instead.
+
+    `positions` is the flattened id-position count of the batch (B·L per
+    table); the carried inverse/mask/batch-ids are batch-shaped, not
+    unique-shaped, so under a dedup budget (U < positions) they dominate
+    the int side of the carry. Defaults to `unique` (the no-dedup U = N
+    case)."""
+    if pipeline_mode == "off":
+        return 0.0
+    U, D = unique, dim
+    pos = unique if positions is None else int(positions)
+    b = U * key_bytes  # carried uids
+    b += U * 4  # counts
+    b += pos * 4  # inverse (batch-shaped [B, L])
+    b += pos * key_bytes  # the prefetched batch's ids themselves
+    b += pos * 1  # per-position mask in the carried views
+    b += U * D * value_bytes  # finished local embedding buffer
+    b += U * D * value_bytes  # owner-side residual rows (reuse_rows diet)
+    if num_shards > 1 and comm == "a2a":
+        b += U * 4  # send_slot routing metadata
+    return float(b)
+
+
+def modeled_overlap_step(
+    *,
+    dense_ms: float,
+    route_ms: float,
+    other_ms: float,
+    mode: str = "off",
+    chunks: int = 1,
+) -> float:
+    """Modeled step time (ms) under the in-step pipelining schedule.
+
+    `route_ms` is the hoistable half of the lookup — id dedup + id
+    exchange + owner probe/metadata (everything the pipelined scan issues
+    ahead of the dense compute); `dense_ms` the dense fwd/bwd it hides
+    behind; `other_ms` everything that stays serial (value gather +
+    embedding exchange, grad exchange, sparse apply, dense update).
+
+      off:       dense + route + other           (strictly sequential)
+      lookahead: max(dense, route) + other       (route hidden behind dense)
+      chunked:   like lookahead, with the serial half's EXCHANGE portion
+                 internally pipelined — the model conservatively keeps
+                 other_ms whole (it cannot split gather from wire without
+                 a trace), so chunked == lookahead here; the measured
+                 difference only exists on sharded exchanges
+                 (tools/bench_async.py --pipeline-mode chunked on a mesh).
+
+    `roofline.py --assert-overlap` compares this against the measured
+    pipelined step and gates CI on the ratio (overlap efficiency)."""
+    dense_ms = max(0.0, float(dense_ms))
+    route_ms = max(0.0, float(route_ms))
+    other_ms = max(0.0, float(other_ms))
+    if mode == "off":
+        return dense_ms + route_ms + other_ms
+    return max(dense_ms, route_ms) + other_ms
 
 
 # ------------------------------------------------------------ op-count model

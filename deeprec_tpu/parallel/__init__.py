@@ -1,5 +1,5 @@
 from deeprec_tpu.parallel.mesh import make_mesh, shard_batch
-from deeprec_tpu.parallel.sharded import ShardedLookup, ShardedTable
+from deeprec_tpu.parallel.sharded import ShardedLookup, ShardedRoute, ShardedTable
 from deeprec_tpu.parallel.trainer import ShardedTrainer
 from deeprec_tpu.parallel.async_stage import AsyncShardedTrainer, AsyncState
 from deeprec_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded

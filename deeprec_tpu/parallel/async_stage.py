@@ -29,28 +29,23 @@ Semantics (documented staleness, matching the reference):
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 import optax
-from flax import struct
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from deeprec_tpu.parallel.compat import shard_map
 from deeprec_tpu.parallel.trainer import ShardedTrainer
 from deeprec_tpu.training import metrics as M
-from deeprec_tpu.training.trainer import TrainState
+from deeprec_tpu.training.trainer import PipelineCarry, TrainState
 
-
-@struct.dataclass
-class AsyncState:
-    """TrainState + the pipelined lookahead (batch t-1's lookup results)."""
-
-    inner: TrainState
-    batch: Dict[str, jnp.ndarray]  # the previous batch (ids/dense/labels)
-    views: Dict[str, Any]  # feature -> (embeddings, inverse, mask)
-    bundle_res: Dict[str, Any]  # bundle -> lookup result for the backward
+# The stale-by-one carry IS the generic pipeline carry (training/trainer.py):
+# TrainState + one batch's prefetched lookup. The exact pipelined scan
+# threads the same structure through its scan carry; the async stage is the
+# degenerate (stale) version that finishes the lookup BEFORE the previous
+# apply instead of after it.
+AsyncState = PipelineCarry
 
 
 class AsyncShardedTrainer(ShardedTrainer):
@@ -86,45 +81,6 @@ class AsyncShardedTrainer(ShardedTrainer):
             reuse_rows=False, stamp_meta=True,
         )
 
-    def _strip_residuals(self, bundle_res):
-        """Drop the forward residual (owner_res.rows, [.., O, D]) from the
-        pipelined lookup results before they enter AsyncState: the stale
-        apply never reuses it (reuse_rows=False above), and carrying it
-        would roughly double the per-table owner-side payload held across
-        dispatches and threaded through the K-step scan carry. The
-        0-sized replacement keeps each leaf's rank (shard_map out-specs
-        broadcast over the subtree) and `rows.size == 0` is the documented
-        "no residual, fall back to gather" sentinel."""
-
-        def strip(r):
-            rows = r.owner_res.rows
-            empty = jnp.zeros(rows.shape[:-2] + (0, 0), jnp.float32)
-            return r.replace(owner_res=r.owner_res.replace(rows=empty))
-
-        return {
-            bname: (
-                {k: strip(v) for k, v in r.items()}
-                if isinstance(r, dict)
-                else strip(r)
-            )
-            for bname, r in bundle_res.items()
-        }
-
-    # ------------------------------------------------------------- specs
-
-    def _pending_specs(self):
-        """Prefix spec trees (shard_map broadcasts a spec over a subtree):
-        views/batch leaves shard the leading local axis; stacked bundles
-        carry their table axis first."""
-        ax = self.axis
-        views_spec = P(ax)
-        res_spec = {
-            bname: P(None, ax) if b.stacked else P(ax)
-            for bname, b in self.bundles.items()
-        }
-        batch_spec = P(ax)
-        return views_spec, res_spec, batch_spec
-
     # --------------------------------------------------------- bootstrap
 
     def bootstrap(self, state: TrainState, first_batch) -> AsyncState:
@@ -134,7 +90,7 @@ class AsyncShardedTrainer(ShardedTrainer):
 
     def _bootstrap_impl(self, state: TrainState, batch):
         state_spec, batch_spec = self._specs_for(state, batch)
-        views_spec, res_spec, _ = self._pending_specs()
+        views_spec, res_spec, _ = self._carry_specs()
 
         @partial(
             shard_map,
@@ -148,10 +104,18 @@ class AsyncShardedTrainer(ShardedTrainer):
                 bname: self._squeeze(bname, ts)
                 for bname, ts in state.tables.items()
             }
-            tables, views, bundle_res = self._lookup_all(
-                tables, batch, state.step, True
+            # Split-phase lookup (route -> resolve -> finish) with
+            # keep_rows=False: the stale apply never reuses the forward
+            # residual (reuse_rows=False above), so the carried results
+            # drop the owner-side [O, D] row buffer instead of hauling it
+            # across dispatches and through the K-step scan carry.
+            routes = self._route_all(batch, True)
+            tables, pending = self._resolve_all(
+                tables, routes, state.step, True
             )
-            bundle_res = self._strip_residuals(bundle_res)
+            views, bundle_res = self._finish_all(
+                tables, pending, batch, True, keep_rows=False
+            )
             new_state = TrainState(
                 step=state.step,
                 tables={
@@ -215,14 +179,19 @@ class AsyncShardedTrainer(ShardedTrainer):
 
         # (2) exchange/lookup for batch t — reads the step-start tables,
         # no data dependency on (1): XLA overlaps it with the matmuls.
+        # Expressed through the split-phase lookup; finish runs BEFORE the
+        # stale apply below (that pre-apply gather IS the documented
+        # staleness — the exact pipelined scan moves it after the apply).
+        # keep_rows=False: the carried results never reuse the residual.
         tables = {
             bname: self._squeeze(bname, ts)
             for bname, ts in state.tables.items()
         }
-        tables, views_t, res_t = self._lookup_all(
-            tables, batch_t, step, True
+        routes_t = self._route_all(batch_t, True)
+        tables, pending_t = self._resolve_all(tables, routes_t, step, True)
+        views_t, res_t = self._finish_all(
+            tables, pending_t, batch_t, True, keep_rows=False
         )
-        res_t = self._strip_residuals(res_t)
 
         # (3) stale-apply batch t-1's sparse grads
         tables = self._apply_all(tables, astate.bundle_res, g_embs, step, lr)
@@ -258,7 +227,7 @@ class AsyncShardedTrainer(ShardedTrainer):
         )
 
     def _astate_spec(self, state_spec):
-        views_spec, res_spec, prev_batch_spec = self._pending_specs()
+        views_spec, res_spec, prev_batch_spec = self._carry_specs()
         return AsyncState(
             inner=state_spec, batch=prev_batch_spec, views=views_spec,
             bundle_res=res_spec,

@@ -24,15 +24,28 @@ Design (per table, inside one `shard_map` region spanning the train step):
 
 Every collective is a single XLA op riding ICI; there is no parameter-server
 process, no RPC stack, no send/recv graph partitioning.
+
+Split-phase lookup (the in-step pipelining substrate, docs/perf.md round
+11): the forward decomposes into `route` (local dedup + the ID exchange +
+owner-side dedup — a pure function of the id batch), `resolve` (owner probe/
+insert, metadata, init — reads keys/meta, never value rows) and `finish`
+(value gather + the embedding exchange). The pipelined K-step scan hoists
+route+resolve of batch t+1 ahead of batch t's dense compute and places
+finish after batch t's apply, which hides the id exchange and the probe
+bookkeeping behind the matmuls with zero staleness. `exchange_chunks > 1`
+additionally splits the value/grad exchanges into column chunks — several
+smaller collectives XLA's async scheduler can pipeline against the
+surrounding gather/segment-sum compute (`pipeline_mode="chunked"`).
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import struct
+
+from deeprec_tpu.training.profiler import phase_scope
 
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup, empty_key
 from deeprec_tpu.optim import apply as optim_apply
@@ -41,8 +54,39 @@ from deeprec_tpu.utils import hashing
 
 
 @struct.dataclass
+class ShardedRoute:
+    """Apply-independent routing half of a sharded lookup (lives inside
+    shard_map): local dedup, the id exchange and the owner-side dedup. A
+    pure function of the id batch — it reads NO table state — so the
+    pipelined scan hoists it (and the id collective it contains) a full
+    step ahead of the tables it will hit."""
+
+    inverse: jnp.ndarray  # [B, L] position -> local unique index
+    counts: jnp.ndarray  # [U] local unique counts
+    valid: jnp.ndarray  # [U]
+    o_uids: jnp.ndarray  # [O] owner-side unique ids this shard received
+    o_inverse: jnp.ndarray  # [G] exchanged-position -> owner-unique index
+    o_counts: jnp.ndarray  # [O]
+    o_valid: jnp.ndarray  # [O]
+    owned: jnp.ndarray  # [G] bool — valid rows this shard received/owns
+    # Local-dedup overflow (None on the legacy sort path).
+    loc_overflow: Optional[jnp.ndarray]
+    # a2a path only: [U] position of each local unique id in the [N*Bd]
+    # send buffer (-1 = overflow, served default this step) and the scalar
+    # overflow count; empty/None for allgather.
+    send_slot: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.int32)
+    )
+    a2a_overflow: Optional[jnp.ndarray] = None
+
+
+@struct.dataclass
 class ShardedLookup:
-    """Per-device result of a sharded lookup (lives inside shard_map)."""
+    """Per-device result of a sharded lookup (lives inside shard_map).
+
+    `resolve` returns it with 0-sized placeholder `embeddings` (the value
+    half not yet gathered/exchanged); `finish` fills them. Only finished
+    results reach the model / the apply."""
 
     inverse: jnp.ndarray  # [B, L] position -> local unique index
     counts: jnp.ndarray  # [U] local unique counts
@@ -72,6 +116,14 @@ class ShardedTable:
         default value for that step and is counted in state.a2a_overflow —
         the knob for it is a2a_slack, NOT capacity (insert_fails is the
         separate capacity/grow signal).
+
+    `exchange_chunks > 1` splits the value/grad payload exchanges into that
+    many column chunks — bitwise-identical arithmetic (per-element reduction
+    order unchanged; chunks write disjoint columns), but several smaller
+    collectives whose wire time XLA can overlap with the neighbouring
+    gather/segment-sum compute (software pipelining; the
+    `pipeline_mode="chunked"` knob threads through here). The id exchange
+    stays whole — it is already tiny.
     """
 
     def __init__(
@@ -81,12 +133,90 @@ class ShardedTable:
         axis: str = "data",
         comm: str = "allgather",
         a2a_slack: float = 2.0,
+        exchange_chunks: int = 1,
     ):
         self.table = table
         self.num_shards = num_shards
         self.axis = axis
         self.comm = comm
         self.a2a_slack = a2a_slack
+        self.exchange_chunks = max(1, int(exchange_chunks))
+
+    # --------------------------------------------------------- split phases
+
+    def route(
+        self,
+        ids: jnp.ndarray,
+        *,
+        pad_value: int = -1,
+        unique_size: Optional[int] = None,
+    ) -> ShardedRoute:
+        """Routing phase: local dedup (`unique_size` engages the hash
+        engine at that static budget), the id exchange, and the owner-side
+        dedup. Depends only on `ids` — no table state — so it can be
+        issued arbitrarily early."""
+        if self.comm == "a2a":
+            return self._route_a2a(ids, pad_value, unique_size)
+        return self._route_allgather(ids, pad_value, unique_size)
+
+    def resolve(
+        self,
+        state: TableState,
+        route: ShardedRoute,
+        *,
+        step: jnp.ndarray | int = 0,
+        train: bool = True,
+        salt=None,
+    ) -> Tuple[TableState, ShardedLookup]:
+        """Owner-side key/metadata phase on a prepared route: probe/insert
+        on the local shard, fused metadata stamp, init scatter for created
+        rows, admission, and the dedup/a2a telemetry counters. Touches
+        keys/meta/new rows only — never the value rows an apply writes —
+        so resolve(t+1) commutes bit-exactly with apply(t) (the hoist
+        contract of the pipelined scan). Returns a pending ShardedLookup
+        whose embeddings await `finish`."""
+        state, res = self.table._resolve(
+            state, route.o_uids, route.o_counts, route.o_valid, step=step,
+            train=train, salt=salt,
+        )
+        state = self._count_dedup(
+            state, route.counts, route.valid, route.loc_overflow, train
+        )
+        if train and route.a2a_overflow is not None:
+            state = state.replace(
+                a2a_overflow=state.a2a_overflow + route.a2a_overflow
+            )
+        return state, ShardedLookup(
+            inverse=route.inverse,
+            counts=route.counts,
+            valid=route.valid,
+            embeddings=jnp.zeros((0, 0), jnp.float32),
+            owner_res=res,
+            o_inverse=route.o_inverse,
+            owned=route.owned,
+            send_slot=route.send_slot,
+        )
+
+    def finish(
+        self,
+        state: TableState,
+        sl: ShardedLookup,
+        *,
+        train: bool = True,
+        keep_rows: bool = True,
+    ) -> ShardedLookup:
+        """Value phase: gather the resolved owner rows from the CURRENT
+        values array and run the embedding exchange (chunked when
+        `exchange_chunks > 1`). In the pipelined scan this runs after the
+        previous step's apply — which is exactly what keeps the lookahead
+        staleness-free. `keep_rows=False` drops the owner-side residual
+        for callers that never reuse it (the stale-by-one apply)."""
+        o_res = self.table._finish_resolved(
+            state, sl.owner_res, keep_rows=keep_rows
+        )
+        if self.comm == "a2a":
+            return self._finish_a2a(sl, o_res, train)
+        return self._finish_allgather(sl, o_res, train)
 
     def lookup_unique(
         self,
@@ -102,16 +232,15 @@ class ShardedTable:
         """`unique_size` (static) engages the hash dedup engine at that
         budget BEFORE the exchange: the all_gather/all2all id payload, the
         owner-side work and the embedding return all shrink by the same
-        U/N factor. None keeps the legacy sort-unique at U = N."""
-        if self.comm == "a2a":
-            return self._lookup_a2a(
-                state, ids, step=step, train=train, pad_value=pad_value,
-                salt=salt, unique_size=unique_size,
-            )
-        return self._lookup_allgather(
-            state, ids, step=step, train=train, pad_value=pad_value,
-            salt=salt, unique_size=unique_size,
+        U/N factor. None keeps the legacy sort-unique at U = N.
+
+        Composition of the split phases — route → resolve → finish; the
+        pipelined trainers call the phases individually."""
+        route = self.route(ids, pad_value=pad_value, unique_size=unique_size)
+        state, sl = self.resolve(
+            state, route, step=step, train=train, salt=salt
         )
+        return state, self.finish(state, sl, train=train)
 
     # ------------------------------------------------------- shared helpers
 
@@ -126,28 +255,12 @@ class ShardedTable:
             return jnp.bfloat16
         return jnp.float32
 
-    def _local_unique(self, ids, pad_value, unique_size=None):
-        """Flatten + pad-collapse + dedup the local batch (both paths).
-        Returns (sentinel, uids, inverse, counts, valid, overflow) —
-        overflow is None on the legacy path, a scalar int32 under a
-        budget (ids past it serve the default this step)."""
-        from deeprec_tpu.ops import dedup
-
-        sent_py = empty_key(self.table.cfg)
-        sentinel = jnp.asarray(sent_py, ids.dtype)
-        flat = ids.reshape(-1)
-        flat = jnp.where(flat == jnp.asarray(pad_value, flat.dtype), sentinel, flat)
-        if unique_size is None:
-            uids, inverse, counts = dedup.sort_unique(
-                flat, flat.shape[0], sentinel=sent_py
-            )
-            overflow = None
-        else:
-            uids, inverse, counts, overflow = dedup.hash_dedup(
-                flat, unique_size, sentinel=sent_py
-            )
-        valid = uids != sentinel
-        return sentinel, uids, inverse.reshape(ids.shape), counts, valid, overflow
+    def _col_chunks(self, D: int):
+        """Static [start, stop) column blocks of the value/grad exchange —
+        `exchange_chunks` near-equal pieces (each >= 1 column)."""
+        k = max(1, min(self.exchange_chunks, int(D)))
+        bounds = [round(i * D / k) for i in range(k + 1)]
+        return [(a, b) for a, b in zip(bounds, bounds[1:]) if b > a]
 
     def _owner_dedup(self, g_ids, g_counts, include, sentinel,
                      budgeted: bool = False):
@@ -193,14 +306,19 @@ class ShardedTable:
             ),
         )
 
-    def _lookup_allgather(
-        self, state, ids, *, step, train, pad_value, salt, unique_size=None
-    ) -> Tuple[TableState, ShardedLookup]:
+    # -------------------------------------------------------- allgather path
+
+    def _route_allgather(self, ids, pad_value, unique_size) -> ShardedRoute:
+        from deeprec_tpu.ops import dedup
+
         N = self.num_shards
         axis = self.axis
-        sentinel, uids, inverse, counts, valid, loc_ovf = self._local_unique(
-            ids, pad_value, unique_size
+        sent_py = empty_key(self.table.cfg)
+        uids, inverse, counts, valid, loc_ovf = dedup.route_ids(
+            ids, pad_value=pad_value, sentinel=sent_py,
+            unique_size=unique_size,
         )
+        sentinel = jnp.asarray(sent_py, uids.dtype)
 
         # Exchange unique ids (cheap: ints) so every shard sees all
         # candidates — under a budget the gathered G = N·U shrinks with U.
@@ -211,31 +329,33 @@ class ShardedTable:
         o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
             g_uids, g_counts, owned, sentinel, budgeted=unique_size is not None
         )
-
-        state, res = self.table._lookup_resolved(
-            state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
+        return ShardedRoute(
+            inverse=inverse, counts=counts, valid=valid,
+            o_uids=o_uids, o_inverse=o_inverse, o_counts=o_counts,
+            o_valid=o_valid, owned=owned, loc_overflow=loc_ovf,
         )
-        state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
+    def _finish_allgather(self, sl: ShardedLookup, o_res: UniqueLookup,
+                          train: bool) -> ShardedLookup:
         # Back to gathered layout; non-owned rows contribute zero, then one
         # reduce-scatter hands each replica its own unique rows. The value
         # payload rides the wire dtype (train: bf16 by default) — exact as a
         # reduction because each row has one nonzero contributor.
         wire = self._wire_dtype(train)
-        e_g = res.embeddings[o_inverse] * owned[:, None].astype(res.embeddings.dtype)
-        emb_local = jax.lax.psum_scatter(
-            e_g.astype(wire), axis, scatter_dimension=0, tiled=True
-        ).astype(jnp.float32)  # [U, D]
-
-        return state, ShardedLookup(
-            inverse=inverse,
-            counts=counts,
-            valid=valid,
-            embeddings=emb_local,
-            owner_res=res,
-            o_inverse=o_inverse,
-            owned=owned,
+        e_g = o_res.embeddings[sl.o_inverse] * sl.owned[:, None].astype(
+            o_res.embeddings.dtype
         )
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(e_g.shape[1])):
+            with phase_scope(f"exchange_chunk{ci}"):
+                parts.append(jax.lax.psum_scatter(
+                    e_g[:, a:b].astype(wire), self.axis,
+                    scatter_dimension=0, tiled=True,
+                ))
+        emb_local = (
+            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        ).astype(jnp.float32)  # [U, D]
+        return sl.replace(embeddings=emb_local, owner_res=o_res)
 
     # ------------------------------------------------------------- a2a path
 
@@ -245,15 +365,17 @@ class ShardedTable:
         per_dest = math.ceil(U * self.a2a_slack / self.num_shards)
         return max(8, ((per_dest + 7) // 8) * 8)  # pad to VPU-friendly size
 
-    def _lookup_a2a(
-        self, state, ids, *, step, train, pad_value, salt, unique_size=None
-    ) -> Tuple[TableState, ShardedLookup]:
-        cfg = self.table.cfg
+    def _route_a2a(self, ids, pad_value, unique_size) -> ShardedRoute:
+        from deeprec_tpu.ops import dedup
+
         N = self.num_shards
         axis = self.axis
-        sentinel, uids, inverse, counts, valid, loc_ovf = self._local_unique(
-            ids, pad_value, unique_size
+        sent_py = empty_key(self.table.cfg)
+        uids, inverse, counts, valid, loc_ovf = dedup.route_ids(
+            ids, pad_value=pad_value, sentinel=sent_py,
+            unique_size=unique_size,
         )
+        sentinel = jnp.asarray(sent_py, uids.dtype)
         # Under a budget U shrinks, so the per-destination bucket Bd and
         # both all2all payloads shrink by the same factor.
         U = uids.shape[0]
@@ -292,49 +414,47 @@ class ShardedTable:
         ).reshape(-1)
 
         recv_valid = recv_ids != sentinel
-        G2 = N * Bd
         o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
             recv_ids, recv_counts, recv_valid, sentinel,
             budgeted=unique_size is not None,
         )
-
-        state, res = self.table._lookup_resolved(
-            state, o_uids, o_counts, o_valid, step=step, train=train, salt=salt
+        return ShardedRoute(
+            inverse=inverse, counts=counts, valid=valid,
+            o_uids=o_uids, o_inverse=o_inverse, o_counts=o_counts,
+            o_valid=o_valid, owned=recv_valid, loc_overflow=loc_ovf,
+            send_slot=send_slot,
+            a2a_overflow=jnp.sum(overflow).astype(jnp.int32),
         )
-        state = self._count_dedup(state, counts, valid, loc_ovf, train)
 
+    def _finish_a2a(self, sl: ShardedLookup, o_res: UniqueLookup,
+                    train: bool) -> ShardedLookup:
+        cfg = self.table.cfg
+        N = self.num_shards
+        G2 = sl.o_inverse.shape[0]
+        Bd = G2 // N
         # Embedding return payload in the wire dtype (train: bf16 default).
         wire = self._wire_dtype(train)
-        e_out = res.embeddings[o_inverse].astype(wire)
-        e_out = e_out * recv_valid[:, None].astype(wire)
-        e_back = jax.lax.all_to_all(
-            e_out.reshape(N, Bd, -1), axis, split_axis=0, concat_axis=0,
-            tiled=True,
-        ).reshape(G2, -1).astype(jnp.float32)
+        e_out = o_res.embeddings[sl.o_inverse].astype(wire)
+        e_out = e_out * sl.owned[:, None].astype(wire)
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(e_out.shape[1])):
+            with phase_scope(f"exchange_chunk{ci}"):
+                parts.append(jax.lax.all_to_all(
+                    e_out[:, a:b].reshape(N, Bd, b - a), self.axis,
+                    split_axis=0, concat_axis=0, tiled=True,
+                ).reshape(G2, b - a))
+        e_back = (
+            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        ).astype(jnp.float32)
         # e_back[send_slot[u]] is u's embedding; overflow/invalid -> default.
-        emb_local = e_back.at[jnp.where(send_slot >= 0, send_slot, 0)].get(
+        emb_local = e_back.at[jnp.where(sl.send_slot >= 0, sl.send_slot, 0)].get(
             mode="clip"
         )
         blocked = jnp.asarray(
             cfg.ev.init.default_value_no_permission, jnp.float32
         )
-        emb_local = jnp.where((send_slot >= 0)[:, None], emb_local, blocked)
-
-        if train:
-            state = state.replace(
-                a2a_overflow=state.a2a_overflow
-                + jnp.sum(overflow).astype(jnp.int32)
-            )
-        return state, ShardedLookup(
-            inverse=inverse,
-            counts=counts,
-            valid=valid,
-            embeddings=emb_local,
-            owner_res=res,
-            o_inverse=o_inverse,
-            owned=recv_valid,
-            send_slot=send_slot,
-        )
+        emb_local = jnp.where((sl.send_slot >= 0)[:, None], emb_local, blocked)
+        return sl.replace(embeddings=emb_local, owner_res=o_res)
 
     def _apply_a2a(
         self, state, opt, sl, grad_u, *, step, lr, grad_averaging,
@@ -346,26 +466,33 @@ class ShardedTable:
         D = grad_u.shape[1]
         wire = self._wire_dtype(True)  # the backward only exists in train
         sslot_safe = jnp.where(sl.send_slot >= 0, sl.send_slot, G2)
-        g_buf = (
-            jnp.zeros((G2, D), wire)
-            .at[sslot_safe]
-            .set(grad_u.astype(wire), mode="drop")
-        )
-        g_recv = jax.lax.all_to_all(
-            g_buf.reshape(N, Bd, D), self.axis, split_axis=0, concat_axis=0,
-            tiled=True,
-        ).reshape(G2, D)
         # Segment-sum into owner-unique rows AT THE OWNER SIZE (== G2 on
         # the legacy path; a few pad slots over it under a budget). The
         # accumulation runs in fp32 on the owner side regardless of the
-        # wire dtype.
+        # wire dtype. Chunked: each column block rides its own all_to_all
+        # and lands in its own (disjoint) o_grad columns — bitwise the
+        # same result, but the wire time of chunk k overlaps the
+        # segment-sum of chunk k-1.
         O = sl.owner_res.uids.shape[0]
-        o_grad = (
-            jnp.zeros((O, D), jnp.float32)
-            .at[sl.o_inverse]
-            .add(g_recv.astype(jnp.float32)
-                 * sl.owned[:, None].astype(jnp.float32))
-        )
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(D)):
+            g_buf = (
+                jnp.zeros((G2, b - a), wire)
+                .at[sslot_safe]
+                .set(grad_u[:, a:b].astype(wire), mode="drop")
+            )
+            with phase_scope(f"exchange_chunk{ci}"):
+                g_recv = jax.lax.all_to_all(
+                    g_buf.reshape(N, Bd, b - a), self.axis, split_axis=0,
+                    concat_axis=0, tiled=True,
+                ).reshape(G2, b - a)
+            parts.append(
+                jnp.zeros((O, b - a), jnp.float32)
+                .at[sl.o_inverse]
+                .add(g_recv.astype(jnp.float32)
+                     * sl.owned[:, None].astype(jnp.float32))
+            )
+        o_grad = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         # Same local-mean-loss rescale as the allgather path.
         o_grad = o_grad / jnp.float32(N)
         return optim_apply.apply_gradients(
@@ -401,19 +528,25 @@ class ShardedTable:
                 stamp_meta=stamp_meta,
             )
         wire = self._wire_dtype(True)  # the backward only exists in train
-        g_g = jax.lax.all_gather(
-            grad_u.astype(wire), self.axis, tiled=True
-        )  # [G, D] — G = N·U shrinks with the unique budget
-        G, D = g_g.shape
+        D = grad_u.shape[1]
         # Owner-unique rows: size == G legacy, G + pad under a budget.
-        # Accumulate in fp32 whatever the wire dtype was.
+        # Accumulate in fp32 whatever the wire dtype was. Chunked: one
+        # all_gather + segment-sum per column block (disjoint o_grad
+        # columns — bitwise identical, wire/computation pipelined).
         O = sl.owner_res.uids.shape[0]
-        o_grad = (
-            jnp.zeros((O, D), jnp.float32)
-            .at[sl.o_inverse]
-            .add(g_g.astype(jnp.float32)
-                 * sl.owned[:, None].astype(jnp.float32))
-        )
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(D)):
+            with phase_scope(f"exchange_chunk{ci}"):
+                g_g = jax.lax.all_gather(
+                    grad_u[:, a:b].astype(wire), self.axis, tiled=True
+                )  # [G, b-a] — G = N·U shrinks with the unique budget
+            parts.append(
+                jnp.zeros((O, b - a), jnp.float32)
+                .at[sl.o_inverse]
+                .add(g_g.astype(jnp.float32)
+                     * sl.owned[:, None].astype(jnp.float32))
+            )
+        o_grad = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         # Per-replica losses are means over the LOCAL batch (B/N); summing N
         # replicas' grads here would make the sparse step N x the
         # single-device one while dense grads get pmean'd. Rescale so both

@@ -67,6 +67,8 @@ class ShardedTrainer(Trainer):
         remat: bool = False,
         a2a_slack: float = 2.0,
         unique_budget=None,
+        pipeline_mode: str = "off",
+        pipeline_chunks: int = 4,
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
@@ -74,13 +76,20 @@ class ShardedTrainer(Trainer):
         self.axis = axis
         self.num_shards = self.mesh.devices.size
         super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat,
-                         unique_budget=unique_budget)
+                         unique_budget=unique_budget,
+                         pipeline_mode=pipeline_mode,
+                         pipeline_chunks=pipeline_chunks)
         # Re-point bundles at per-shard capacities + collective wrappers.
+        # pipeline_mode="chunked" splits each table's value/grad exchanges
+        # into pipeline_chunks column chunks (ShardedTable.exchange_chunks)
+        # on EVERY train path (single-step and K-step scan) — bitwise
+        # identical arithmetic, overlappable wire.
+        chunks = pipeline_chunks if pipeline_mode == "chunked" else 1
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
         self.sharded = {
             bname: ShardedTable(b.table, self.num_shards, axis, comm=comm,
-                                a2a_slack=a2a_slack)
+                                a2a_slack=a2a_slack, exchange_chunks=chunks)
             for bname, b in self.bundles.items()
         }
 
@@ -195,6 +204,41 @@ class ShardedTrainer(Trainer):
             reuse_rows=self._bundle_reuse_rows(b), stamp_meta=False,
         )
 
+    # Split-phase primitives (Trainer._route_all/_resolve_all/_finish_all
+    # drive these): the collective versions — route carries the id
+    # exchange, finish the embedding exchange.
+    def _route_one(self, b, ids, pad, train):
+        U = self._budget_for_lookup(b, ids, train)
+        return self.sharded[b.name].route(
+            ids, pad_value=pad, unique_size=U
+        )
+
+    def _resolve_one(self, b, state, route, salt, step, train):
+        return self.sharded[b.name].resolve(
+            state, route, step=step, train=train, salt=salt
+        )
+
+    def _finish_one(self, b, state, pending, train, keep_rows=True):
+        return self.sharded[b.name].finish(
+            state, pending, train=train, keep_rows=keep_rows
+        )
+
+    def _carry_specs(self):
+        """Prefix spec trees for a PipelineCarry's lookahead halves
+        (shard_map broadcasts a spec over a subtree): views/batch leaves
+        shard the leading local axis; stacked bundles carry their table
+        axis first. Used where a carry crosses the shard_map boundary —
+        the async stale-by-one stage (parallel/async_stage.py); the exact
+        pipelined scan keeps its carry inside one shard_map region."""
+        ax = self.axis
+        views_spec = P(ax)
+        res_spec = {
+            bname: P(None, ax) if b.stacked else P(ax)
+            for bname, b in self.bundles.items()
+        }
+        batch_spec = P(ax)
+        return views_spec, res_spec, batch_spec
+
     # --------------------------------------------- capacity management
 
     def _bundle_lead_dims(self, b):
@@ -208,7 +252,7 @@ class ShardedTrainer(Trainer):
         old = self.sharded[b.name]
         self.sharded[b.name] = ShardedTable(
             b.table, old.num_shards, old.axis, comm=old.comm,
-            a2a_slack=old.a2a_slack,
+            a2a_slack=old.a2a_slack, exchange_chunks=old.exchange_chunks,
         )
 
     def maintain(self, state, **kw):
@@ -319,7 +363,14 @@ class ShardedTrainer(Trainer):
         a2a/allgather exchange of every inner step stays inside the single
         compiled program, so K steps cost one host dispatch. Batch leaves
         are [K, B, ...] with the K axis unsharded and the batch axis split
-        over the mesh (`shard_batch(..., stacked=True)`)."""
+        over the mesh (`shard_batch(..., stacked=True)`).
+
+        pipeline_mode != "off" routes to the rotated scan
+        (`_sharded_steps_pipelined`): same semantics, bit-exact, with the
+        id exchange + owner probe of batch t+1 hoisted over batch t's
+        dense compute."""
+        if self.pipeline_mode != "off":
+            return self._sharded_steps_pipelined(state, batches, lr)
         state_spec, _ = self._specs_for(state, {})
         batch_spec = jax.tree.map(lambda _: P(None, self.axis), batches)
         out_metric_spec = {"loss": P(), "accuracy": P()}
@@ -336,6 +387,148 @@ class ShardedTrainer(Trainer):
                 return self._sharded_body(state, batch, lr)
 
             return jax.lax.scan(body, state, batches)
+
+        return run(state, batches, lr)
+
+    # -------------------------------------------- pipelined K-step scan
+
+    def _sharded_pipe_prologue(self, state: TrainState, batch0):
+        """Fill the pipeline inside shard_map: split-phase lookup of the
+        window's first batch (same program as the sequential lookup)."""
+        from deeprec_tpu.training.trainer import PipelineCarry
+
+        tables = {
+            bname: self._squeeze(bname, ts)
+            for bname, ts in state.tables.items()
+        }
+        routes = self._route_all(batch0, True)
+        tables, pending = self._resolve_all(tables, routes, state.step, True)
+        views, res = self._finish_all(tables, pending, batch0, True)
+        new_state = TrainState(
+            step=state.step,
+            tables={
+                bname: self._unsqueeze(bname, ts)
+                for bname, ts in tables.items()
+            },
+            dense=state.dense,
+            opt_state=state.opt_state,
+        )
+        return PipelineCarry(inner=new_state, batch=batch0, views=views,
+                             bundle_res=res)
+
+    def _sharded_pipe_step(self, carry, batch_next, lr):
+        """One pipelined sharded step on per-shard values (inside
+        shard_map) — `Trainer._pipe_step` with the collective split
+        phases and pmean'd dense grads/metrics:
+
+          1. route(t+1): id dedup + id a2a/allgather + owner dedup —
+             ids-only, issued before the dense compute so the async
+             collective hides behind the matmuls;
+          2. resolve(t+1): owner probe/insert + fused metadata + init —
+             keys/meta only, commutes bit-exactly with apply(t);
+          3. dense fwd/bwd on the carried lookup of batch t;
+          4. grad exchange + sparse apply of batch t;
+          5. finish(t+1): owner value gather + embedding exchange, AFTER
+             the apply — batch t+1 sees post-apply tables, zero staleness.
+
+        batch_next=None: window epilogue, only `.inner` of the returned
+        carry is meaningful."""
+        from deeprec_tpu.training.trainer import PipelineCarry
+
+        state = carry.inner
+        step = state.step
+        tables = {
+            bname: self._squeeze(bname, ts)
+            for bname, ts in state.tables.items()
+        }
+        if batch_next is not None:
+            with jax.named_scope("phase_route_next"):
+                routes = self._route_all(batch_next, True)
+                tables, pending = self._resolve_all(
+                    tables, routes, step + 1, True
+                )
+        views = carry.views
+        prev_batch = carry.batch
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+        def loss_fn(dense, embs):
+            inputs = self._build_inputs(embs, views, prev_batch)
+            out = self.model.apply(dense, inputs, train=True)
+            loss, out = self._loss_from_logits(out, prev_batch)
+            return loss, out
+
+        with jax.named_scope("phase_dense_fwd_bwd"):
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.dense, embs)
+        g_dense = jax.lax.pmean(g_dense, self.axis)
+        with jax.named_scope("phase_sparse_apply"):
+            tables = self._apply_all(tables, carry.bundle_res, g_embs, step, lr)
+        if batch_next is not None:
+            with jax.named_scope("phase_finish_exchange"):
+                views_n, res_n = self._finish_all(
+                    tables, pending, batch_next, True
+                )
+        else:
+            batch_next, views_n, res_n = prev_batch, views, carry.bundle_res
+        updates, opt_state = self.dense_opt.update(
+            g_dense, state.opt_state, state.dense
+        )
+        dense = optax.apply_updates(state.dense, updates)
+        mets = {"loss": jax.lax.pmean(loss, self.axis)}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = jax.lax.pmean(
+                M.accuracy(probs, prev_batch["label"]), self.axis
+            )
+        else:
+            mets["accuracy"] = jnp.zeros(())
+        new_state = TrainState(
+            step=step + 1,
+            tables={
+                bname: self._unsqueeze(bname, ts)
+                for bname, ts in tables.items()
+            },
+            dense=dense,
+            opt_state=opt_state,
+        )
+        return PipelineCarry(
+            inner=new_state, batch=batch_next, views=views_n,
+            bundle_res=res_n,
+        ), mets
+
+    def _sharded_steps_pipelined(self, state: TrainState, batches, lr):
+        """The rotated K-step scan: prologue lookup of batch 0, a scan
+        whose carry threads the one-batch lookahead (PipelineCarry — it
+        never crosses the shard_map boundary, so it needs no specs), and a
+        peeled epilogue for the last batch (which has nothing to
+        prefetch; peeling keeps the final table state bit-identical — a
+        masked dummy resolve would insert phantom keys)."""
+        state_spec, _ = self._specs_for(state, {})
+        batch_spec = jax.tree.map(lambda _: P(None, self.axis), batches)
+        out_metric_spec = {"loss": P(), "accuracy": P()}
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(state_spec, batch_spec, P()),
+            out_specs=(state_spec, out_metric_spec),
+            check_vma=False,
+        )
+        def run(state, batches, lr):
+            batch0 = jax.tree.map(lambda x: x[0], batches)
+            rest = jax.tree.map(lambda x: x[1:], batches)
+            carry = self._sharded_pipe_prologue(state, batch0)
+
+            def body(carry, batch_next):
+                return self._sharded_pipe_step(carry, batch_next, lr)
+
+            carry, mets = jax.lax.scan(body, carry, rest)
+            carry, tail = self._sharded_pipe_step(carry, None, lr)
+            mets = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b[None]]), mets, tail
+            )
+            return carry.inner, mets
 
         return run(state, batches, lr)
 

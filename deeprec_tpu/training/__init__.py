@@ -1,5 +1,6 @@
 from deeprec_tpu.training.trainer import (
     ModelInputs,
+    PipelineCarry,
     Trainer,
     TrainState,
     stack_batches,

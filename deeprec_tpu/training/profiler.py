@@ -29,6 +29,18 @@ def trace(logdir: str = "/tmp/deeprec_tpu_trace") -> Iterator[str]:
         jax.profiler.stop_trace()
 
 
+def phase_scope(name: str):
+    """`jax.named_scope("phase_<name>")` — the in-program half of phase
+    attribution (see PhaseProfiler): ops emitted under it group per phase
+    in device traces. The trainers wrap their step phases in it
+    (lookup / route_next / dense_fwd_bwd / sparse_apply /
+    finish_exchange), and the chunked exchange (`ShardedTable` with
+    exchange_chunks > 1) scopes each column-chunk collective as
+    `exchange_chunk<i>` so a trace shows the chunk pipeline instead of
+    one opaque collective."""
+    return jax.named_scope(f"phase_{name}")
+
+
 class PhaseProfiler:
     """Named-phase step breakdown (lookup / exchange / dense fwd-bwd /
     sparse apply / metadata ...).

@@ -48,6 +48,52 @@ class TrainState:
     opt_state: Any
 
 
+@struct.dataclass
+class PipelineCarry:
+    """TrainState plus a one-batch lookahead: the batch whose lookup has
+    already been issued, its per-feature views and per-bundle lookup
+    results. Two users share it:
+
+      * the EXACT pipelined K-step scan (`pipeline_mode != "off"`): the
+        carried lookup was finished AFTER the previous step's apply, so
+        consuming it is bit-identical to the sequential step;
+      * the stale-by-one async stage (parallel/async_stage.py, where it is
+        exported as `AsyncState`): the carried lookup was finished BEFORE
+        the previous apply — the documented one-step staleness.
+    """
+
+    inner: TrainState
+    batch: Dict[str, jnp.ndarray]  # the prefetched batch (ids/dense/labels)
+    views: Dict[str, Any]  # feature -> (embeddings, inverse, mask)
+    bundle_res: Dict[str, Any]  # bundle -> lookup result for the backward
+
+
+# `pipeline_mode`: how the K-step device loop schedules the embedding
+# exchange relative to dense compute (docs/perf.md round 11).
+#   "off"       — strictly sequential scan body (lookup -> dense -> apply).
+#   "lookahead" — the scan carries a one-batch lookahead: batch t+1's
+#                 routing (id dedup + id exchange) and owner resolve
+#                 (probe/insert/meta/init) are issued BEFORE batch t's
+#                 dense compute (no data dependency -> XLA's async
+#                 collectives hide them behind the matmuls); the value
+#                 gather + embedding exchange run after batch t's apply,
+#                 which keeps the pipeline exact — bit-identical to "off".
+#   "chunked"   — "lookahead" plus the value/grad exchanges split into
+#                 `pipeline_chunks` column chunks (ShardedTable
+#                 exchange_chunks): several smaller collectives whose wire
+#                 time pipelines against the neighbouring gather /
+#                 segment-sum compute. Also exact.
+PIPELINE_MODES = ("off", "lookahead", "chunked")
+
+
+def validate_pipeline_mode(mode: str, where: str) -> None:
+    if mode not in PIPELINE_MODES:
+        raise ValueError(
+            f"{where}: pipeline_mode must be one of {PIPELINE_MODES}, "
+            f"got {mode!r}"
+        )
+
+
 @dataclasses.dataclass
 class Bundle:
     """A set of features served by one (possibly stacked) table state.
@@ -143,11 +189,20 @@ class Trainer:
         remat: bool = False,
         stage: str = "auto",
         unique_budget=None,
+        pipeline_mode: str = "off",
+        pipeline_chunks: int = 4,
     ):
         self.model = model
         self.sparse_opt = sparse_opt
         self.dense_opt = dense_opt or optax.adam(1e-3)
         self.grad_averaging = grad_averaging
+        # In-step pipelining of the K-step device loop (train_steps): see
+        # PIPELINE_MODES. Single-device trainers gain the restructured
+        # scan (route/resolve hoisted over the dense compute); sharded
+        # trainers additionally overlap the collectives it contains.
+        validate_pipeline_mode(pipeline_mode, type(self).__name__)
+        self.pipeline_mode = pipeline_mode
+        self.pipeline_chunks = max(1, int(pipeline_chunks))
         # remat=True recomputes the dense forward in the backward pass
         # (jax.checkpoint): trades MXU FLOPs for HBM — the rematerialisation
         # lever for big towers / long sequences.
@@ -323,6 +378,17 @@ class Trainer:
             reuse_rows=self._bundle_reuse_rows(b), stamp_meta=False,
         )
 
+    def _stacked_ids(self, b: Bundle, batch) -> jnp.ndarray:
+        """[T, B, L] id stack of a grouped bundle (shape-checked)."""
+        shapes = {f.name: _prep_ids(batch[f.name]).shape for f in b.features}
+        if len(set(shapes.values())) > 1:
+            raise ValueError(
+                f"grouped features have mismatched id shapes {shapes}; "
+                "declare distinct SparseFeature.max_len values to keep "
+                "them in separate embedding groups"
+            )
+        return jnp.stack([_prep_ids(batch[f.name]) for f in b.features])
+
     def _lookup_all(self, tables, batch, step, train):
         """Run every bundle's lookup. Returns (tables, per-feature views,
         per-bundle stacked results for the backward pass)."""
@@ -330,14 +396,7 @@ class Trainer:
         bundle_res = {}  # bundle -> stacked result
         for bname, b in self.bundles.items():
             if b.stacked:
-                shapes = {f.name: _prep_ids(batch[f.name]).shape for f in b.features}
-                if len(set(shapes.values())) > 1:
-                    raise ValueError(
-                        f"grouped features have mismatched id shapes {shapes}; "
-                        "declare distinct SparseFeature.max_len values to keep "
-                        "them in separate embedding groups"
-                    )
-                ids = jnp.stack([_prep_ids(batch[f.name]) for f in b.features])
+                ids = self._stacked_ids(b, batch)
                 pad = b.features[0].pad_value
                 masks = ids != jnp.asarray(pad, ids.dtype)
 
@@ -362,6 +421,112 @@ class Trainer:
                     bundle_res.setdefault(bname, {})[f.name] = res
                     views[f.name] = (res.embeddings, res.inverse, mask)
         return tables, views, bundle_res
+
+    # ------------------------------------------------- split-phase lookup
+    #
+    # The three-phase decomposition of _lookup_all the pipelined scan (and
+    # the async stale-by-one stage) schedule around the dense compute:
+    #   route   — id dedup (+ the id exchange, sharded): ids only, no
+    #             table state, hoistable arbitrarily early;
+    #   resolve — probe/insert, fused metadata, init scatter, admission:
+    #             reads keys/meta, never the value rows an apply writes,
+    #             so it commutes bit-exactly with the previous apply;
+    #   finish  — value gather (+ the embedding exchange, sharded): reads
+    #             the CURRENT values, so running it after the previous
+    #             apply keeps the lookahead staleness-free.
+    # route → resolve → finish composes to exactly _lookup_all.
+    # ShardedTrainer overrides only the three *_one primitives.
+
+    def _route_one(self, b: Bundle, ids, pad, train):
+        U = self._budget_for_lookup(b, ids, train)
+        return b.table._route_ids(ids, pad, U)
+
+    def _resolve_one(self, b: Bundle, state, route, salt, step, train):
+        return b.table._resolve_routed(
+            state, route, step=step, train=train, salt=salt
+        )
+
+    def _finish_one(self, b: Bundle, state, pending, train, keep_rows=True):
+        return b.table._finish_resolved(state, pending, keep_rows=keep_rows)
+
+    def _route_all(self, batch, train=True):
+        """Phase 1 for every bundle: pure function of the id batch."""
+        routes = {}
+        for bname, b in self.bundles.items():
+            if b.stacked:
+                ids = self._stacked_ids(b, batch)
+                pad = b.features[0].pad_value
+
+                def one(i, b=b, pad=pad):
+                    return self._route_one(b, i, pad, train)
+
+                routes[bname] = jax.vmap(one)(ids)
+            else:
+                routes[bname] = {
+                    f.name: self._route_one(
+                        b, _prep_ids(batch[f.name]), f.pad_value, train
+                    )
+                    for f in b.features
+                }
+        return routes
+
+    def _resolve_all(self, tables, routes, step, train=True):
+        """Phase 2 for every bundle (same bundle/feature order as
+        _lookup_all, so shared-table inserts chain identically)."""
+        pending = {}
+        for bname, b in self.bundles.items():
+            if b.stacked:
+
+                def one(s, r, sa, b=b):
+                    return self._resolve_one(b, s, r, sa, step, train)
+
+                tables[bname], pend = jax.vmap(one)(
+                    tables[bname], routes[bname], b.salts
+                )
+                pending[bname] = pend
+            else:
+                for f in b.features:
+                    tables[bname], pend = self._resolve_one(
+                        b, tables[bname], routes[bname][f.name], None, step,
+                        train,
+                    )
+                    pending.setdefault(bname, {})[f.name] = pend
+        return tables, pending
+
+    def _finish_all(self, tables, pending, batch, train=True, keep_rows=True):
+        """Phase 3 for every bundle: gather (+ exchange) the value rows
+        against the CURRENT tables. Returns (views, bundle_res) shaped
+        exactly like _lookup_all's."""
+        views = {}
+        bundle_res = {}
+        for bname, b in self.bundles.items():
+            if b.stacked:
+                ids = self._stacked_ids(b, batch)
+                pad = b.features[0].pad_value
+                masks = ids != jnp.asarray(pad, ids.dtype)
+
+                def one(s, p, b=b):
+                    return self._finish_one(b, s, p, train, keep_rows)
+
+                res = jax.vmap(one)(tables[bname], pending[bname])
+                bundle_res[bname] = res
+                for k, f in enumerate(b.features):
+                    views[f.name] = (
+                        res.embeddings[k],
+                        res.inverse[k],
+                        masks[k],
+                    )
+            else:
+                for f in b.features:
+                    ids = _prep_ids(batch[f.name])
+                    mask = ids != jnp.asarray(f.pad_value, ids.dtype)
+                    res = self._finish_one(
+                        b, tables[bname], pending[bname][f.name], train,
+                        keep_rows,
+                    )
+                    bundle_res.setdefault(bname, {})[f.name] = res
+                    views[f.name] = (res.embeddings, res.inverse, mask)
+        return views, bundle_res
 
     def _build_inputs(self, embs, views, batch) -> ModelInputs:
         pooled, seq = {}, {}
@@ -495,10 +660,122 @@ class Trainer:
         frequency/admission and version stamping behave exactly as K
         sequential `train_step` calls (tests/test_train_steps.py pins the
         equivalence, exact on table ints)."""
+        if self.pipeline_mode != "off":
+            return self._steps_pipelined(state, batches, lr)
+
         def body(state, batch):
             return self._step_impl(state, batch, lr)
 
         return jax.lax.scan(body, state, batches)
+
+    # ------------------------------------------------- pipelined K-step scan
+
+    def _pipe_prologue(self, state: TrainState, batch0) -> PipelineCarry:
+        """Fill the pipeline: full split-phase lookup of the window's
+        first batch (identical program to the sequential lookup)."""
+        tables = dict(state.tables)
+        routes = self._route_all(batch0, True)
+        tables, pending = self._resolve_all(tables, routes, state.step, True)
+        views, res = self._finish_all(tables, pending, batch0, True)
+        return PipelineCarry(
+            inner=TrainState(step=state.step, tables=tables,
+                             dense=state.dense, opt_state=state.opt_state),
+            batch=batch0, views=views, bundle_res=res,
+        )
+
+    def _pipe_step(self, carry: PipelineCarry, batch_next, lr):
+        """One pipelined train step: dense fwd/bwd + sparse apply + dense
+        update for the CARRIED batch t, interleaved with the lookahead for
+        batch t+1 —
+
+          1. route+resolve(t+1) issued BEFORE the dense compute (no data
+             dependency on it: route reads only ids, resolve reads
+             keys/meta which the diet apply never writes) so XLA's async
+             collectives hide the id exchange + probe behind the matmuls;
+          2. dense fwd/bwd on the carried (finished) lookup of batch t;
+          3. sparse apply of batch t;
+          4. finish(t+1) — value gather + embedding exchange — AFTER the
+             apply, so batch t+1 sees post-apply tables: exact, no
+             staleness.
+
+        `batch_next=None` is the window epilogue (nothing to prefetch);
+        the returned carry's lookahead fields are then stale garbage and
+        only `.inner` is meaningful."""
+        state = carry.inner
+        step = state.step
+        tables = dict(state.tables)
+        if batch_next is not None:
+            with jax.named_scope("phase_route_next"):
+                routes = self._route_all(batch_next, True)
+                tables, pending = self._resolve_all(
+                    tables, routes, step + 1, True
+                )
+        views = carry.views
+        prev_batch = carry.batch
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+
+        def loss_fn(dense, embs):
+            inputs = self._build_inputs(embs, views, prev_batch)
+            apply = (
+                jax.checkpoint(self.model.apply, static_argnums=(2,))
+                if self.remat
+                else self.model.apply
+            )
+            out = apply(dense, inputs, True)
+            loss, out = self._loss_from_logits(out, prev_batch)
+            return loss, out
+
+        with jax.named_scope("phase_dense_fwd_bwd"):
+            (loss, out), (g_dense, g_embs) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.dense, embs)
+        with jax.named_scope("phase_sparse_apply"):
+            tables = self._apply_all(tables, carry.bundle_res, g_embs, step, lr)
+        if batch_next is not None:
+            with jax.named_scope("phase_finish_exchange"):
+                views_n, res_n = self._finish_all(
+                    tables, pending, batch_next, True
+                )
+        else:
+            batch_next, views_n, res_n = prev_batch, views, carry.bundle_res
+        updates, opt_state = self.dense_opt.update(
+            g_dense, state.opt_state, state.dense
+        )
+        dense = optax.apply_updates(state.dense, updates)
+        mets = {"loss": loss}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = M.accuracy(probs, prev_batch["label"])
+        else:
+            mets["accuracy"] = jnp.zeros(())
+        new_state = TrainState(
+            step=step + 1, tables=tables, dense=dense, opt_state=opt_state
+        )
+        return PipelineCarry(
+            inner=new_state, batch=batch_next, views=views_n,
+            bundle_res=res_n,
+        ), mets
+
+    def _steps_pipelined(self, state: TrainState, batches, lr):
+        """K-step device loop with the one-batch lookahead rotated through
+        the scan carry (pipeline_mode != "off"): prologue looks up batch
+        0, each scan iteration consumes the carried lookup and prefetches
+        the next batch's, the peeled epilogue consumes the last. Bit-
+        identical to the sequential scan — tests/test_pipeline_overlap.py
+        pins exactness on table ints, values and losses."""
+        batch0 = jax.tree.map(lambda x: x[0], batches)
+        rest = jax.tree.map(lambda x: x[1:], batches)
+        carry = self._pipe_prologue(state, batch0)
+
+        def body(carry, batch_next):
+            return self._pipe_step(carry, batch_next, lr)
+
+        carry, mets = jax.lax.scan(body, carry, rest)
+        carry, tail = self._pipe_step(carry, None, lr)
+        mets = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b[None]]), mets, tail
+        )
+        return carry.inner, mets
 
     def forward_views(self, state: TrainState, batch):
         """Readonly lookup pass (no inserts/counters): per-feature views

@@ -37,6 +37,13 @@ def main(argv=None):
     p.add_argument("--comm", default="a2a", choices=["a2a", "allgather"])
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="K inner steps per dispatch (lax.scan path)")
+    p.add_argument("--pipeline-mode", default="off",
+                   choices=["off", "lookahead", "chunked"],
+                   help="add a third arm: the EXACT pipelined K-step scan "
+                        "(ShardedTrainer pipeline_mode=...) next to sync "
+                        "and the stale-by-one async stage — the "
+                        "stale-vs-exact overlap comparison (needs "
+                        "--steps-per-dispatch > 1 to engage)")
     args = p.parse_args(argv)
     K = args.steps_per_dispatch
     if K < 1:
@@ -113,6 +120,20 @@ def main(argv=None):
         sync.train_step if K <= 1 else sync.train_steps, sync.init(0), "sync"
     )
 
+    dt_pipe = None
+    if args.pipeline_mode != "off":
+        # Exact in-step pipelining: same semantics as sync (bit-identical,
+        # tests/test_pipeline_overlap.py), overlap without the staleness
+        # the async arm pays. Only the K-scan path restructures, so K=1
+        # measures plain sync twice.
+        pipe = ShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
+                              mesh=mesh, comm=args.comm,
+                              pipeline_mode=args.pipeline_mode)
+        dt_pipe = timed(
+            pipe.train_step if K <= 1 else pipe.train_steps, pipe.init(0),
+            f"exact-{args.pipeline_mode}",
+        )
+
     asy = AsyncShardedTrainer(model, Adagrad(lr=0.05), optax.adam(1e-3),
                               mesh=mesh, comm=args.comm)
     ast = asy.bootstrap(asy.init(0), batches[0])
@@ -123,6 +144,12 @@ def main(argv=None):
     print(f"speedup: {dt_sync / dt_async:.3f}x "
           f"({'async wins' if dt_async < dt_sync else 'sync wins'}, "
           f"{n} devices, comm={args.comm}, steps_per_dispatch={K})")
+    if dt_pipe is not None:
+        print(f"exact overlap: {dt_sync / dt_pipe:.3f}x vs sync, "
+              f"{dt_async / dt_pipe:.3f}x vs stale-by-one "
+              f"(pipeline_mode={args.pipeline_mode}; >1.0 on the second "
+              f"means exact pipelining matches the async win without "
+              f"the staleness)")
 
 
 if __name__ == "__main__":

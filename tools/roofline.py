@@ -79,6 +79,74 @@ def assert_traffic(json_path: str) -> int:
     return rc
 
 
+def assert_overlap(json_path: str, tol: float) -> int:
+    """CI gate for the in-step pipelining grid (bench.py 'pipeline'
+    section): the pipelined K-scan arms must exist, must not regress past
+    `tol` relative to the sequential arm, the overlap model must be
+    internally consistent (the overlapped schedule can never model SLOWER
+    than the sequential sum), and the overlap efficiency
+    (modeled max(exchange, dense) step vs the measured pipelined step)
+    must be recorded. On CPU the efficiency is informational (no async
+    collectives to realize the overlap); the regression bound is the
+    enforced contract, and on TPU the printed efficiency is the number
+    the ROADMAP item asks to close."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    pipe = rec.get("pipeline")
+    if not pipe:
+        print(f"roofline: {json_path} has no 'pipeline' record "
+              "(run bench.py with --pipeline-mode grid)", file=sys.stderr)
+        return 1
+    modes = pipe.get("modes", {})
+    if "off" not in modes or not any(m != "off" for m in modes):
+        print("roofline: pipeline record needs an 'off' arm and at least "
+              f"one pipelined arm, got {sorted(modes)}", file=sys.stderr)
+        return 1
+    rc = 0
+    off_ms = modes["off"]["ms_per_step"]
+    modeled = pipe.get("modeled_ms", {})
+    eff = pipe.get("overlap_efficiency", {})
+    for mode, stats in modes.items():
+        if mode == "off":
+            continue
+        ms = stats["ms_per_step"]
+        if ms > off_ms * (1.0 + tol):
+            print(
+                f"roofline: pipeline_mode={mode} REGRESSES the K-scan step "
+                f"beyond tolerance: {ms:.3f} ms vs off {off_ms:.3f} ms "
+                f"(bound {1.0 + tol:.2f}x) — the lookahead restructure "
+                f"is costing more than the overlap hides",
+                file=sys.stderr,
+            )
+            rc = 1
+        if mode not in eff:
+            print(f"roofline: pipeline arm {mode} missing its "
+                  "overlap_efficiency entry", file=sys.stderr)
+            rc = 1
+        if mode in modeled and "off" in modeled and \
+                modeled[mode] > modeled["off"] + 1e-9:
+            print(
+                f"roofline: overlap model inconsistent — modeled "
+                f"{mode} {modeled[mode]} ms > modeled off "
+                f"{modeled['off']} ms", file=sys.stderr,
+            )
+            rc = 1
+    if rc == 0:
+        arms = ", ".join(
+            f"{m} {s['ms_per_step']:.2f}ms"
+            f" (eff {eff.get(m, float('nan')):.2f},"
+            f" modeled {modeled.get(m, '?')}ms)"
+            for m, s in modes.items() if m != "off"
+        )
+        print(
+            f"roofline: overlap gate ok — off {off_ms:.2f}ms vs {arms} "
+            f"(phase_ms {pipe.get('phase_ms')})"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -93,9 +161,22 @@ def main(argv=None):
                    help="don't run the step: validate the traffic model "
                         "against the op counts recorded in a bench.py JSON "
                         "(CI smoke gate; exits nonzero on drift)")
+    p.add_argument("--assert-overlap", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the in-step "
+                        "pipelining grid recorded in a bench.py JSON "
+                        "(pipelined arms present, no regression beyond "
+                        "--overlap-tol, overlap efficiency recorded; CI "
+                        "smoke gate, exits nonzero on violation)")
+    p.add_argument("--overlap-tol", type=float, default=0.5,
+                   help="allowed relative K-scan step-time regression of a "
+                        "pipelined arm vs 'off' (default 0.5 — generous "
+                        "because single-core CI has no overlap to win and "
+                        "real noise; TPU runs should pin it down)")
     args = p.parse_args(argv)
     if args.assert_traffic:
         sys.exit(assert_traffic(args.assert_traffic))
+    if args.assert_overlap:
+        sys.exit(assert_overlap(args.assert_overlap, args.overlap_tol))
 
     import jax
     import jax.numpy as jnp
