@@ -42,6 +42,9 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
 echo "== serving bench (CPU smoke: single + group dispatch, delta update mid-load, /v1/stats) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke
 
+echo "== freshness bench (CPU smoke: online loop, trainer SIGKILL + supervised restart, zero failed requests) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
     BENCH_PIPELINE=grid python bench.py \

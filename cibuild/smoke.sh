@@ -21,4 +21,6 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_elastic_live.py::test_coordinator_plan_epoch_and_acks \
   tests/test_attention.py::test_flash_matches_reference \
   tests/test_feature_demos.py::test_kafka_streaming_demo \
+  tests/test_ckpt_corruption.py::test_corruption_never_raises_into_serving_and_self_heals \
+  tests/test_online_loop.py::test_poll_thread_survives_raising_poll_and_recovers \
   "$@"

@@ -19,6 +19,7 @@ trailing line is left unconsumed until its newline arrives.
 from __future__ import annotations
 
 import os
+import random
 import socket
 import socketserver
 import threading
@@ -167,6 +168,11 @@ class TCPStreamReader:
     reconnects and process restarts — the consumer-group-offset semantics
     of the reference's KafkaDataset (kafka_dataset_op.cc), over a socket
     this environment can actually open.
+
+    Broker outages are survived, not raised (unless `stop_at_eof`):
+    reconnects use jittered exponential backoff from `reconnect_secs` up
+    to `reconnect_max_secs`, and `connect_attempts` / `reconnects` /
+    `consecutive_connect_failures` surface the churn to supervisors.
     """
 
     def __init__(
@@ -177,6 +183,7 @@ class TCPStreamReader:
         parser: Optional[Callable] = None,
         stop_at_eof: bool = False,
         reconnect_secs: float = 1.0,
+        reconnect_max_secs: float = 30.0,
         num_dense: int = 13,
         num_cat: int = 26,
     ):
@@ -185,8 +192,23 @@ class TCPStreamReader:
         self.B = batch_size
         self.parser = parser or criteo_line_parser(num_dense, num_cat)
         self.stop_at_eof = stop_at_eof
+        # Reconnect policy: jittered exponential backoff from
+        # `reconnect_secs` (the base, kept for back-compat) capped at
+        # `reconnect_max_secs` — a dead broker costs O(cap) polling, a
+        # flapping one isn't hammered by every consumer in lockstep.
         self.reconnect_secs = reconnect_secs
+        self.reconnect_max_secs = reconnect_max_secs
         self.offset = 0
+        # Attempt counters (surfaced by TrainLoop heartbeats and the
+        # freshness bench): consecutive_connect_failures resets on a
+        # successful connect; reconnects counts broker-initiated drops;
+        # connect_attempts counts every dial.
+        self.connect_attempts = 0
+        self.reconnects = 0
+        self.consecutive_connect_failures = 0
+        self._rng = random.Random(
+            (hash((host, port)) ^ os.getpid()) & 0xFFFFFFFF
+        )
 
     def save(self) -> dict:
         return {"host": self.host, "port": self.port, "offset": self.offset}
@@ -194,10 +216,25 @@ class TCPStreamReader:
     def restore(self, state: dict) -> None:
         self.offset = int(state["offset"])
 
+    def backoff_delay(self, attempt: int) -> float:
+        """Capped exponential reconnect delay BEFORE jitter: the k-th
+        consecutive failure waits base * 2^(k-1), never above
+        reconnect_max_secs. Pure — pinned by tests without sleeping."""
+        return min(
+            self.reconnect_max_secs,
+            self.reconnect_secs * (2 ** max(0, min(attempt - 1, 20))),
+        )
+
+    def _backoff_sleep(self) -> None:
+        d = self.backoff_delay(self.consecutive_connect_failures)
+        time.sleep(d * (0.5 + self._rng.random()))  # [0.5, 1.5)x jitter
+
     def _connect(self) -> socket.socket:
+        self.connect_attempts += 1
         s = socket.create_connection((self.host, self.port), timeout=30)
         s.settimeout(None)  # the 30s budget is for CONNECT only: a quiet
         s.sendall(f"OFFSET {self.offset}\n".encode())  # follow-mode broker
+        self.consecutive_connect_failures = 0
         return s  # must not look like an EOF after a lull
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -215,7 +252,8 @@ class TCPStreamReader:
                             # there: an empty iterator would masquerade as
                             # an empty stream
                             raise
-                        time.sleep(self.reconnect_secs)
+                        self.consecutive_connect_failures += 1
+                        self._backoff_sleep()
                         continue
                 try:
                     data = sock.recv(1 << 20)
@@ -232,7 +270,9 @@ class TCPStreamReader:
                     # a corrupt record out of the old partial line.
                     buf = b""
                     rows = []
-                    time.sleep(self.reconnect_secs)
+                    self.reconnects += 1
+                    self.consecutive_connect_failures += 1
+                    self._backoff_sleep()
                     continue
                 buf += data
                 nl = buf.rfind(b"\n")

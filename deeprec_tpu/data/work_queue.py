@@ -19,8 +19,9 @@ import fcntl
 import json
 import os
 import random
+import tempfile
 import threading
-from typing import Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 
 class WorkQueue:
@@ -51,6 +52,11 @@ class WorkQueue:
         self._cursor = 0
         self._lock = threading.Lock()
         self._coord = coordination_file
+        # Test seam: called with (file_object, serialized_json) INSTEAD of
+        # the final write inside the atomic commit — lets fault tests
+        # emulate a worker killed mid-write (write partial bytes, raise)
+        # and pin that concurrent takers never observe a torn file.
+        self.on_coord_write: Optional[Callable] = None
         if self._coord and not os.path.exists(self._coord):
             self._write_coord({"cursor": 0, "items": items})
 
@@ -164,14 +170,38 @@ class WorkQueue:
 
         return self._with_lock(read)
 
-    def _write_coord(self, state: dict) -> None:
-        def write():
-            tmp = self._coord + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(state, f)
-            os.replace(tmp, self._coord)
+    def _commit_coord(self, state: dict) -> None:
+        """Atomically replace the shared cursor file. MUST be the only
+        writer of `self._coord` (call under `_with_lock`).
 
-        self._with_lock(write)
+        A worker killed at ANY point in here leaves the previous coord
+        file intact: the new JSON lands in a uniquely named tempfile in
+        the same directory, is fsync'd, and only then renamed over the
+        target (rename is atomic on POSIX) — other workers either see the
+        old complete state or the new complete state, never a torn JSON
+        that would strand every taker on a parse error. Orphaned `.wq-*`
+        temps from killed writers are inert (never matched by readers)."""
+        dirname = os.path.dirname(self._coord) or "."
+        fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".wq-", suffix=".tmp")
+        try:
+            data = json.dumps(state)
+            with os.fdopen(fd, "w") as f:
+                if self.on_coord_write is not None:
+                    self.on_coord_write(f, data)  # fault-injection seam
+                else:
+                    f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._coord)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _write_coord(self, state: dict) -> None:
+        self._with_lock(lambda: self._commit_coord(state))
 
     def _take_coordinated(self) -> Optional[str]:
         def take():
@@ -181,10 +211,7 @@ class WorkQueue:
                 return None
             item = st["items"][st["cursor"]]
             st["cursor"] += 1
-            tmp = self._coord + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(st, f)
-            os.replace(tmp, self._coord)
+            self._commit_coord(st)
             return item
 
         return self._with_lock(take)

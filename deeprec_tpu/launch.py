@@ -136,6 +136,54 @@ def supervise_elastic(
     raise RuntimeError("elastic: max_generations exceeded")
 
 
+def supervise_worker(
+    script: str,
+    script_args,
+    heartbeat: Optional[str] = None,
+    lease_secs: float = 30.0,
+    max_restarts: int = 5,
+    env_extra: Optional[dict] = None,
+) -> int:
+    """Run ONE worker under liveness supervision (deeprec_tpu.online):
+    restart it on crash or wedged heartbeat lease with capped-backoff
+    budget, respawn EXIT_RESCALE exits for free. The worker sees
+    DEEPREC_HEARTBEAT_FILE and must stamp it per step (TrainLoop and the
+    `deeprec_tpu.online.loop` CLI pick the env var up automatically;
+    custom loops stamp a `Heartbeat` themselves); without a heartbeat
+    only death is detected, not wedging. Returns the final exit code (0 done,
+    1 budget exhausted). The continuous-training analog of
+    `supervise_elastic` — see docs/fault-tolerance.md."""
+    from deeprec_tpu.online.supervisor import ProcessSpec, Supervisor
+
+    def env():
+        # Fresh single-process jax.distributed layout per (re)spawn —
+        # the coordinator service dies with the worker, so a respawn
+        # must not try to rebind the old generation's port.
+        e = {
+            "DEEPREC_COORDINATOR": f"127.0.0.1:{_free_port()}",
+            "DEEPREC_NUM_PROCESSES": "1",
+            "DEEPREC_PROCESS_ID": "0",
+            **(env_extra or {}),
+        }
+        if heartbeat:
+            e["DEEPREC_HEARTBEAT_FILE"] = heartbeat
+        return e
+
+    spec = ProcessSpec(
+        name="worker",
+        argv=[sys.executable, "-m", "deeprec_tpu.launch", script]
+        + list(script_args),
+        heartbeat_path=heartbeat,
+        lease_secs=lease_secs if heartbeat else None,
+        max_restarts=max_restarts,
+        env=env,
+    )
+    sup = Supervisor([spec])
+    sup.run()  # foreground; returns when done or budget exhausted
+    st = sup.stats()["worker"]
+    return 0 if st["done"] else 1
+
+
 def _free_port() -> int:
     import socket
 
@@ -159,6 +207,17 @@ def main(argv=None):
         help="run as elastic SUPERVISOR: spawn --num_processes workers and "
         "respawn the set at the plan's target size on rescale exits",
     )
+    p.add_argument(
+        "--supervised", action="store_true",
+        help="run ONE worker under liveness supervision: restart on crash "
+        "or wedged heartbeat lease (see --heartbeat), capped-backoff "
+        "restart budget, EXIT_RESCALE respawns free",
+    )
+    p.add_argument("--heartbeat", default=None,
+                   help="heartbeat lease file for --supervised wedge "
+                   "detection (exported as DEEPREC_HEARTBEAT_FILE)")
+    p.add_argument("--lease_secs", type=float, default=30.0)
+    p.add_argument("--max_restarts", type=int, default=5)
     p.add_argument("script", help="training script to run after init")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -168,6 +227,13 @@ def main(argv=None):
             supervise_elastic(
                 args.script, args.script_args,
                 args.num_processes or 1, args.elastic_dir,
+            )
+        )
+    if args.supervised:
+        sys.exit(
+            supervise_worker(
+                args.script, args.script_args, heartbeat=args.heartbeat,
+                lease_secs=args.lease_secs, max_restarts=args.max_restarts,
             )
         )
 

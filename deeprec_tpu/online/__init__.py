@@ -1,0 +1,43 @@
+"""Continuous-training subsystem: trainer -> delta chain -> serving as one
+supervised pipeline (ROADMAP "close the online-learning loop").
+
+The DeepRec production story (SURVEY §5 failure detection + incremental
+replay, §3.4 ModelInstanceMgr) recomposed from this repo's parts:
+
+  * `online.loop.TrainLoop`   — consume a stream/WorkQueue, emit
+    `save_incremental_async` on a cadence, stamp heartbeats, honor the
+    elastic EXIT_RESCALE contract.
+  * `online.loop.ServeLoop`   — Predictor + ModelServer (+ optional HTTP
+    front) polling the delta chain under live load, with a poll thread
+    that survives any failure and heartbeats its health.
+  * `online.supervisor`       — lease-style heartbeat files, and a
+    Supervisor that restarts dead or wedged worker processes under an
+    exponential-backoff restart budget.
+  * `online.faults`           — deterministic fault injectors (kill at
+    step, torn checkpoint write, corrupt-delta bit flip, broker outage)
+    shared by the tests and `tools/bench_freshness.py`.
+
+See docs/fault-tolerance.md for the supervision model and the
+degraded-serving contract.
+"""
+_EXPORTS = {
+    "TrainLoop": "deeprec_tpu.online.loop",
+    "ServeLoop": "deeprec_tpu.online.loop",
+    "wait_for_full_checkpoint": "deeprec_tpu.online.loop",
+    "Heartbeat": "deeprec_tpu.online.supervisor",
+    "ProcessSpec": "deeprec_tpu.online.supervisor",
+    "Supervisor": "deeprec_tpu.online.supervisor",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    # Lazy re-exports: `python -m deeprec_tpu.online.loop` must not find
+    # the module pre-imported by its own package __init__ (runpy warns,
+    # and the double-import would run module code twice).
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,216 @@
+"""Deterministic fault injectors for the online-learning loop.
+
+Promoted out of tests/test_fault_recovery.py's inline subprocess
+machinery so the checkpoint-corruption matrix, the poll-survivability
+tests, and tools/bench_freshness.py all drive the SAME failure modes:
+
+  * `kill_self_at_step` / `env_kill_step` — SIGKILL the current process
+    the moment a given train step completes (a real kill -9, not a
+    polite exception), wired through `TrainLoop` via the
+    DEEPREC_FAULT_KILL_STEP env var for subprocess workers.
+  * `install_torn_write` — arm the CheckpointManager's `on_write` seam
+    (PR 4) to leave a half-written dir: real table file, no manifest —
+    exactly what a writer killed between two np.savez calls leaves.
+  * `corrupt_latest_delta` / `flip_bit` — flip one bit in a COMMITTED
+    checkpoint's payload, the post-commit corruption class (disk rot,
+    truncating copy) that manifests digests + quarantine exist for.
+  * `truncate_file` — tear a committed npz (partial copy / torn fsync).
+  * `BrokerOutage` — stop a FileStreamServer and later revive it on the
+    same port, the broker-disconnect class TCPStreamReader's backoff
+    reconnect handles.
+  * subprocess helpers (`spawn_worker`, `wait_for_line`, `sigkill`) for
+    tests that need a real process to murder.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Tuple
+
+KILL_STEP_ENV = "DEEPREC_FAULT_KILL_STEP"
+
+
+# ------------------------------------------------------------ kill at step
+
+
+def kill_self_at_step(kill_step: int) -> Callable[[int], None]:
+    """Hook for TrainLoop(on_step=...): SIGKILL this process right after
+    `kill_step` completes. SIGKILL, not sys.exit — the point is that no
+    finally-block, atexit, or writer drain gets to run."""
+
+    def hook(step: int) -> None:
+        if step >= kill_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    return hook
+
+
+def env_kill_step() -> Optional[Callable[[int], None]]:
+    """The subprocess form: DEEPREC_FAULT_KILL_STEP=N arms
+    kill_self_at_step(N) in a worker started by the supervisor/bench."""
+    v = os.environ.get(KILL_STEP_ENV)
+    if not v:
+        return None
+    return kill_self_at_step(int(v))
+
+
+# ---------------------------------------------------------- torn writes
+
+
+def install_torn_write(ck, junk_file: str = "table_junk_t0.npz") -> None:
+    """Arm `ck.on_write` to die mid-save ONCE: the dir exists and holds a
+    real (junk) table file, but no manifest — the state a SIGKILL between
+    npz writes leaves behind. Restore must treat the dir as absent."""
+    import numpy as np
+
+    def seam(path):
+        ck.on_write = None  # one-shot
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, junk_file), junk=np.zeros(3))
+        raise KeyboardInterrupt("injected torn write")
+
+    ck.on_write = seam
+
+
+# ------------------------------------------------------ bit flips / tears
+
+
+def flip_bit(path: str, offset: Optional[int] = None, bit: int = 4) -> int:
+    """Flip one bit of `path` in place; returns the byte offset flipped.
+    Default offset is mid-file — inside some array's payload, past the
+    zip headers, so the tear is in DATA (the manifests' digest/zip-CRC
+    checks must catch it; a header flip would fail earlier and cheaper)."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        raise ValueError(f"{path} is empty")
+    off = len(data) // 2 if offset is None else offset
+    data[off] ^= 1 << bit
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return off
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate a committed file to a fraction of its size (torn copy /
+    partial replication). Returns the new size."""
+    size = os.path.getsize(path)
+    new = max(1, int(size * keep_fraction))
+    with open(path, "rb+") as f:
+        f.truncate(new)
+    return new
+
+
+def corrupt_latest_delta(ckpt_dir: str, mode: str = "bitflip",
+                         kind: str = "incr") -> Optional[str]:
+    """Corrupt the newest COMMITTED `kind-*` dir's first table file
+    (bitflip | truncate). Returns the corrupted file's path, or None when
+    no committed dir of that kind exists yet. Only dirs with a manifest
+    count — corrupting an in-flight save would test the torn-write path,
+    not the post-commit one."""
+    import re
+
+    pat = re.compile(rf"^{kind}-(\d+)$")
+    steps = sorted(
+        int(m.group(1))
+        for d in os.listdir(ckpt_dir)
+        if (m := pat.match(d))
+        and os.path.exists(os.path.join(ckpt_dir, d, "manifest.json"))
+    )
+    if not steps:
+        return None
+    path = os.path.join(ckpt_dir, f"{kind}-{steps[-1]}")
+    tables = sorted(
+        f for f in os.listdir(path) if f.startswith("table_")
+    )
+    if not tables:
+        return None
+    target = os.path.join(path, tables[0])
+    if mode == "truncate":
+        truncate_file(target)
+    else:
+        flip_bit(target)
+    return target
+
+
+# --------------------------------------------------------- broker outage
+
+
+class BrokerOutage:
+    """Take a FileStreamServer down and bring it back on the SAME port —
+    the disconnect/reconnect cycle TCPStreamReader's jittered backoff is
+    specified against. The revived broker serves the same file, and the
+    reader's OFFSET header makes the resume exactly-once."""
+
+    def __init__(self, server):
+        self.server = server
+        self.port = server.port
+        self.path = server.path
+        self.follow = server.follow
+        self.poll_secs = server.poll_secs
+        self.down_at: Optional[float] = None
+        self.outages = 0
+
+    def down(self) -> None:
+        self.server.stop()
+        self.down_at = time.monotonic()
+        self.outages += 1
+
+    def up(self):
+        """Revive on the same port (allow_reuse_address makes the rebind
+        race-free against lingering TIME_WAIT sockets)."""
+        from deeprec_tpu.data.stream import FileStreamServer
+
+        self.server = FileStreamServer(
+            self.path, port=self.port, follow=self.follow,
+            poll_secs=self.poll_secs,
+        ).start()
+        self.down_at = None
+        return self.server
+
+
+# ------------------------------------------------- subprocess machinery
+
+
+def spawn_worker(argv: List[str], env: Optional[dict] = None,
+                 cwd: Optional[str] = None) -> subprocess.Popen:
+    """Start a worker with line-buffered captured stdout (stderr merged),
+    CPU-pinned jax defaults unless the caller overrides."""
+    e = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    if env:
+        e.update({k: str(v) for k, v in env.items()})
+    return subprocess.Popen(
+        argv, env=e, cwd=cwd, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1,
+    )
+
+
+def wait_for_line(proc: subprocess.Popen, pred: Callable[[str], bool],
+                  timeout: float = 240.0) -> Tuple[Optional[str], List[str]]:
+    """Read the worker's stdout until `pred(line)` matches (returns that
+    line) or the stream ends / times out (returns None). All consumed
+    lines ride along for assertion messages."""
+    lines: List[str] = []
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            return None, lines
+        line = line.rstrip("\n")
+        lines.append(line)
+        if pred(line):
+            return line, lines
+    return None, lines
+
+
+def sigkill(proc: subprocess.Popen, wait: float = 30.0) -> int:
+    """kill -9 and reap; returns the exit code (negative signal)."""
+    os.kill(proc.pid, signal.SIGKILL)
+    return proc.wait(timeout=wait)
+
+
+def python_argv(script_path: str) -> List[str]:
+    return [sys.executable, script_path]

@@ -1,0 +1,463 @@
+"""The online-learning loop: streaming train -> delta chain -> serve.
+
+`TrainLoop` is the trainer half: consume batches from any iterable (a
+TCPStreamReader following a broker, a FileTailReader, a WorkQueue
+dataset, a synthetic generator), run `Trainer.train_step`, and emit
+`save_incremental_async` on a cadence with periodic full re-anchors.
+Every step stamps a lease-style heartbeat (online/supervisor.py) and the
+loop honors the elastic EXIT_RESCALE contract: a posted scaling plan
+checkpoints, acks, and returns the rescale exit code for the supervisor
+to respawn at the new size. Save failures NEVER kill training — they are
+logged, surfaced through the heartbeat, and self-heal via the
+CheckpointManager's force-full escalation.
+
+`ServeLoop` is the serving half: a Predictor + ModelServer (+ optional
+HTTP front) whose poll thread survives any failure with capped jittered
+backoff, quarantines corrupt deltas (serving through from the last good
+snapshot), and stamps its health — staleness_seconds,
+consecutive_poll_failures, last_good_version — into a heartbeat the
+supervisor's wedge detection reads.
+
+Run a trainer worker as a process (what the supervisor and
+tools/bench_freshness.py spawn):
+
+    python -m deeprec_tpu.online.loop --ckpt DIR --steps 200 \
+        --source tcp://127.0.0.1:9000 --batch-size 256 --save-every 10 \
+        --heartbeat DIR/trainer.hb
+
+It prints the line protocol tests assert on: FRESH | RESUMED <step>,
+STEP <n> <loss>, SAVED <kind> <step>, DONE.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+from deeprec_tpu.online.supervisor import Heartbeat
+from deeprec_tpu.parallel.elastic import EXIT_RESCALE, ElasticCoordinator
+from deeprec_tpu.training.checkpoint import CheckpointManager
+
+_log = logging.getLogger(__name__)
+
+
+class TrainLoop:
+    """Supervised continuous training over a batch stream.
+
+    save cadence: every `save_every` steps; the first save and every
+    `full_every`-th after it are FULL (chain anchors), the rest are
+    incremental deltas — both on the async writer so the npz IO overlaps
+    training. `on_step(step)` is the fault-injection seam (kill-at-step
+    runs there, AFTER the step's save cadence fired, so a kill at a save
+    step tests the async writer dying with the save in flight)."""
+
+    def __init__(
+        self,
+        trainer,
+        ckpt: CheckpointManager,
+        batches: Iterable[Dict],
+        save_every: int = 50,
+        full_every: int = 10,
+        heartbeat: Optional[Heartbeat] = None,
+        coordinator: Optional[ElasticCoordinator] = None,
+        elastic_every: int = 10,
+        max_steps: Optional[int] = None,
+        on_step: Optional[Callable[[int], None]] = None,
+        log_every: int = 0,
+        reader=None,
+    ):
+        self.trainer = trainer
+        self.ckpt = ckpt
+        self.batches = batches
+        if heartbeat is None:
+            # Supervisor contract (launch.py supervise_worker): a spawned
+            # worker finds its lease file in DEEPREC_HEARTBEAT_FILE —
+            # without this fallback a supervised worker that didn't
+            # thread --heartbeat through would never stamp the lease and
+            # be killed as wedged while perfectly healthy.
+            hb_path = os.environ.get("DEEPREC_HEARTBEAT_FILE")
+            if hb_path:
+                heartbeat = Heartbeat(hb_path)
+        self.save_every = max(1, int(save_every))
+        self.full_every = max(1, int(full_every))
+        self.heartbeat = heartbeat
+        self.coordinator = coordinator
+        self.elastic_every = max(1, int(elastic_every))
+        self.max_steps = max_steps
+        self.on_step = on_step
+        self.log_every = log_every
+        self.reader = reader  # optional: stream health rides the heartbeat
+        self.saves = 0
+        self.save_failures = 0
+        self.last_save_step: Optional[int] = None
+        self.last_save_error: Optional[str] = None
+        # Whether the chain has (or will durably have — an async full may
+        # still be in flight) an anchor; checking latest_full() alone
+        # would race the background writer and over-anchor.
+        self._anchored = ckpt.latest_full() is not None
+
+    # ------------------------------------------------------------ helpers
+
+    def _print(self, line: str) -> None:
+        if self.log_every:
+            print(line, flush=True)
+
+    def _beat(self, step: int, status: str = "ok") -> None:
+        if self.heartbeat is None:
+            return
+        extra = {
+            "saves": self.saves,
+            "save_failures": self.save_failures,
+        }
+        if self.reader is not None:
+            extra["stream_connect_failures"] = getattr(
+                self.reader, "consecutive_connect_failures", 0
+            )
+            extra["stream_reconnects"] = getattr(self.reader, "reconnects", 0)
+        self.heartbeat.beat(step=step, status=status, **extra)
+
+    def restore_or_init(self):
+        """Resume from the (verified) chain, or start fresh — the worker
+        restart entry point.
+
+        FileNotFoundError means "fresh start" ONLY when no anchor exists
+        on disk: a concurrent serving process can quarantine-rename a
+        link between this process's chain verification and the np.load
+        that reads it, which also surfaces as FileNotFoundError. That
+        race retries (re-verification no longer lists the renamed dir);
+        if the chain is still unreadable after retries we raise — a
+        supervised restart beats silently training from step 0 over a
+        live chain."""
+        last_err = None
+        for _ in range(3):
+            try:
+                state = self.ckpt.restore()
+                self._print(f"RESUMED {int(state.step)}")
+                return state
+            except FileNotFoundError as e:
+                if self.ckpt.latest_full() is None:
+                    state = self.trainer.init(0)
+                    self._print("FRESH")
+                    return state
+                last_err = e
+                time.sleep(0.05)
+        raise last_err
+
+    def _save(self, state, step: int):
+        """One cadence save; failures degrade (log + heartbeat), never
+        raise into the train loop — the manager escalates the next save
+        to full on a lost delta, so the chain self-heals."""
+        # Full when the chain has no anchor yet (fresh dir, or everything
+        # quarantined), else every full_every-th save of THIS process —
+        # a restarted worker resumes on deltas, it doesn't re-anchor.
+        want_full = (
+            not self._anchored or (self.saves + 1) % self.full_every == 0
+        )
+        try:
+            if want_full:
+                state, path = self.ckpt.save_async(state)
+                self._anchored = True
+            else:
+                state, path = self.ckpt.save_incremental_async(state)
+            self.saves += 1
+            self.last_save_step = step
+            self.last_save_error = None
+            self._print(f"SAVED {os.path.basename(path).split('-')[0]} {step}")
+        except Exception as e:
+            self.save_failures += 1
+            self.last_save_error = str(e)
+            # A failed writer may have taken the would-be anchor with it;
+            # re-derive from disk so the next cadence re-anchors if needed.
+            self._anchored = self.ckpt.latest_full() is not None
+            _log.warning("save at step %d failed (training continues): %s",
+                         step, e)
+            self._print(f"SAVE_FAILED {step}")
+        return state
+
+    # ---------------------------------------------------------------- run
+
+    def run(self, state=None):
+        """Returns (final_state, exit_code): 0 done, EXIT_RESCALE when a
+        scaling plan was acked (caller exits with it; the supervisor
+        respawns the new generation)."""
+        import jax.numpy as jnp
+
+        if state is None:
+            state = self.restore_or_init()
+        self._beat(int(state.step), status="running")
+        for batch in self.batches:
+            if (self.max_steps is not None
+                    and int(state.step) >= self.max_steps):
+                break  # a resumed worker may already be at the target
+            state, mets = self.trainer.train_step(
+                state, {k: jnp.asarray(v) for k, v in batch.items()}
+            )
+            step = int(state.step)
+            if self.log_every and step % self.log_every == 0:
+                self._print(f"STEP {step} {float(mets['loss']):.5f}")
+            if step % self.save_every == 0:
+                state = self._save(state, step)
+            self._beat(
+                step,
+                status="ok" if self.last_save_error is None else "degraded",
+            )
+            if self.coordinator is not None and step % self.elastic_every == 0:
+                target = self.coordinator.should_scale()
+                if target is not None:
+                    # Elastic contract: durable checkpoint, ack, planned
+                    # exit — the supervisor respawns at the new size.
+                    try:
+                        self.ckpt.wait()
+                    except RuntimeError:
+                        pass  # lost async delta: the sync full below re-anchors
+                    state, _ = self.ckpt.save(state)
+                    self.coordinator.ack_rescale()
+                    self._print(f"RESCALE {step} -> {target}")
+                    return state, EXIT_RESCALE
+            if self.on_step is not None:
+                self.on_step(step)
+            if self.max_steps is not None and step >= self.max_steps:
+                break
+        # Drain the writer and flush rows dirtied since the last cadence
+        # save, so a clean exit leaves a chain as fresh as training got.
+        try:
+            self.ckpt.wait()
+            if self.last_save_step != int(state.step):
+                state = self._save(state, int(state.step))
+                self.ckpt.wait()
+        except Exception as e:
+            self.save_failures += 1
+            self.last_save_error = str(e)
+            _log.warning("final save failed: %s", e)
+        self._beat(int(state.step), status="done")
+        self._print("DONE")
+        return state, 0
+
+
+def wait_for_full_checkpoint(ckpt_dir: str, timeout: float = 120.0,
+                             poll_secs: float = 0.25) -> None:
+    """Block until some full checkpoint is committed under `ckpt_dir` —
+    serving can only boot from an anchor. Raises TimeoutError."""
+    import re
+
+    deadline = time.monotonic() + timeout
+    pat = re.compile(r"^full-(\d+)$")
+    while True:
+        try:
+            names = os.listdir(ckpt_dir)
+        except OSError:
+            names = []
+        for d in names:
+            if pat.match(d) and os.path.exists(
+                os.path.join(ckpt_dir, d, "manifest.json")
+            ):
+                return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no full checkpoint appeared under {ckpt_dir} "
+                f"within {timeout}s"
+            )
+        time.sleep(poll_secs)
+
+
+class ServeLoop:
+    """Serving half of the loop: poll the delta chain under live load.
+
+    Wraps Predictor + ModelServer (+ HttpServer when `http_port` is not
+    None; 0 picks a free port) with a poll thread that:
+      * NEVER dies — failures back off (capped, jittered) and retry;
+      * quarantines corrupt deltas via the manager and keeps serving the
+        last good snapshot (degraded-serving contract);
+      * stamps every round's health into `heartbeat` for the
+        supervisor's wedge detection (a wedged poller stops beating; a
+        failing one beats with status="degraded" — distinguishable).
+    `pause()`/`resume()` gate the polling for deterministic fault tests
+    (corrupt a delta BEFORE the poller can apply it)."""
+
+    def __init__(
+        self,
+        model,
+        ckpt_dir: str,
+        poll_secs: float = 0.5,
+        heartbeat: Optional[Heartbeat] = None,
+        http_port: Optional[int] = None,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        device=None,
+        stores: Optional[Dict] = None,
+        max_backoff_secs: float = 10.0,
+        wait_for_checkpoint_secs: float = 0.0,
+    ):
+        from deeprec_tpu.serving.http_server import HttpServer
+        from deeprec_tpu.serving.predictor import ModelServer, Predictor
+
+        if wait_for_checkpoint_secs > 0:
+            wait_for_full_checkpoint(ckpt_dir, wait_for_checkpoint_secs)
+        self.predictor = Predictor(model, ckpt_dir, stores=stores,
+                                   device=device)
+        self.server = ModelServer(self.predictor, max_batch=max_batch,
+                                  max_wait_ms=max_wait_ms)
+        self.http = None
+        if http_port is not None:
+            self.http = HttpServer(self.server, port=http_port).start()
+        self.heartbeat = heartbeat
+        self.poll_secs = poll_secs
+        self.max_backoff_secs = max_backoff_secs
+        self.poll_rounds = 0
+        self.update_failures = 0
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="serve-poll"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ polling
+
+    def _poll_loop(self) -> None:
+        # The shared survivability loop (predictor._run_poll_loop: never
+        # dies, capped jittered backoff); this class only adds the pause
+        # gate and the per-round heartbeat stamp.
+        from deeprec_tpu.serving.predictor import _run_poll_loop
+
+        _run_poll_loop(self, self._stop, self.poll_secs,
+                       max_backoff_secs=self.max_backoff_secs,
+                       pause=self._paused, on_round=self._on_round)
+
+    def _on_round(self, status: str) -> None:
+        self.poll_rounds += 1
+        if self.heartbeat is None:
+            return
+        h = self.predictor.health()
+        self.heartbeat.beat(
+            step=h["step"],
+            status=status if status != "ok" else h["status"],
+            model_version=h["model_version"],
+            staleness_seconds=h["staleness_seconds"],
+            consecutive_poll_failures=h["consecutive_poll_failures"],
+            quarantined=h["quarantined"],
+        )
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def poll_now(self) -> bool:
+        """Synchronous poll (test/bench convenience; same lock as the
+        background thread, so it composes)."""
+        return self.predictor.poll_updates()
+
+    # ------------------------------------------------------------ facade
+
+    def request_versioned(self, features, timeout: float = 30.0):
+        return self.server.request_versioned(features, timeout=timeout)
+
+    def warmup(self, example) -> int:
+        return self.server.warmup(example)
+
+    def health(self) -> Dict:
+        return self.predictor.health()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if self.http is not None:
+            self.http.stop()
+        self.server.close()
+
+
+# -------------------------------------------------------- worker entry
+
+
+def _build_reader(source: str, batch_size: int, num_dense: int,
+                  num_cat: int):
+    """'synthetic' | 'tcp://host:port' | 'tail:path' -> (iterable, reader
+    or None). The tcp reader is returned for offset checkpointing."""
+    if source.startswith("tcp://"):
+        from deeprec_tpu.data.stream import TCPStreamReader
+
+        host, port = source[len("tcp://"):].rsplit(":", 1)
+        r = TCPStreamReader(host, int(port), batch_size=batch_size,
+                            num_dense=num_dense, num_cat=num_cat,
+                            reconnect_secs=0.2)
+        return iter(r), r
+    if source.startswith("tail:"):
+        from deeprec_tpu.data.stream import FileTailReader
+
+        r = FileTailReader(source[len("tail:"):], batch_size=batch_size,
+                           num_dense=num_dense, num_cat=num_cat,
+                           poll_secs=0.1)
+        return iter(r), r
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=batch_size, num_cat=num_cat,
+                          num_dense=num_dense, vocab=500, seed=0)
+
+    def batches():
+        while True:
+            yield gen.batch()
+
+    return batches(), None
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description="online training worker")
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--source", default="synthetic",
+                   help="synthetic | tcp://host:port | tail:path")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--full-every", type=int, default=10)
+    p.add_argument("--heartbeat",
+                   default=os.environ.get("DEEPREC_HEARTBEAT_FILE"))
+    p.add_argument("--elastic-dir",
+                   default=os.environ.get("DEEPREC_ELASTIC_DIR"))
+    p.add_argument("--num-cat", type=int, default=2)
+    p.add_argument("--num-dense", type=int, default=2)
+    p.add_argument("--emb-dim", type=int, default=4)
+    p.add_argument("--capacity", type=int, default=1 << 12)
+    p.add_argument("--lr", type=float, default=0.2)
+    p.add_argument("--log-every", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import optax
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.online import faults
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    hb = Heartbeat(args.heartbeat) if args.heartbeat else None
+    if hb is not None:
+        hb.beat(status="booting")  # leases start before the first compile
+
+    model = WDL(emb_dim=args.emb_dim, capacity=args.capacity, hidden=(16,),
+                num_cat=args.num_cat, num_dense=args.num_dense)
+    tr = Trainer(model, Adagrad(lr=args.lr), optax.adam(5e-3))
+    batches, reader = _build_reader(args.source, args.batch_size,
+                                    args.num_dense, args.num_cat)
+    datasets = {"stream": reader} if reader is not None else None
+    ck = CheckpointManager(args.ckpt, tr, datasets=datasets)
+    coord = (
+        ElasticCoordinator(args.elastic_dir) if args.elastic_dir else None
+    )
+    loop = TrainLoop(
+        tr, ck, batches, save_every=args.save_every,
+        full_every=args.full_every, heartbeat=hb, coordinator=coord,
+        max_steps=args.steps, on_step=faults.env_kill_step(),
+        log_every=args.log_every, reader=reader,
+    )
+    _, code = loop.run()
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

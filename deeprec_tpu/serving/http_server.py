@@ -15,7 +15,10 @@ Protocol:
   GET  /v1/stats     -> per-stage latency histograms (queue/pad/device/
                         post/e2e), batch shape stats, model update counters
   POST /v1/reload    -> {"updated": bool}   (poll full/delta updates now)
-  GET  /healthz      -> 200 "ok"
+  GET  /healthz      -> 200 {"status": "ok", "staleness_seconds": ...,
+                        "consecutive_poll_failures": 0, ...} — 503 with the
+                        same body once the update poller is failing
+                        (predictions still serve the last good snapshot)
 
 Request bodies are capped (`max_body_bytes`, default 16 MiB): oversized
 or malformed payloads get a structured 400 JSON error, never a 500.
@@ -85,7 +88,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._send(200, "ok")
+            # Watchdog surface (supervisor wedge detection): liveness +
+            # model freshness. 200 while the poller is healthy, 503 once
+            # it is failing consecutively — load balancers and the
+            # online.supervisor treat non-200 as "degraded, watch it",
+            # while predictions themselves keep serving the last good
+            # snapshot either way.
+            try:
+                h = self.model_server.predictor.health()
+            except Exception as e:  # health must never 500 the server
+                return self._send(503, {"status": "error", "error": str(e)})
+            self._send(200 if h.get("status") == "ok" else 503, h)
         elif self.path == "/v1/model_info":
             self._send(200, self.model_server.predictor.model_info())
         elif self.path == "/v1/stats":

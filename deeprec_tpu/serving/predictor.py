@@ -219,6 +219,17 @@ class Predictor:
         self.stores = dict(stores or {})
         self.update_count = 0
         self.last_update_ms = 0.0
+        # Poll-health telemetry (exported via /v1/stats + /healthz, and
+        # stamped into ServeLoop heartbeats for supervisor wedge
+        # detection): consecutive_poll_failures counts poll_updates calls
+        # that raised since the last success; last_poll_ok_time is the
+        # last moment a poll round CONFIRMED the served model is as fresh
+        # as the checkpoint dir (staleness_seconds derives from it);
+        # last_good_version is the version that confirmation served.
+        self.consecutive_poll_failures = 0
+        self.last_good_version = 0
+        self.last_poll_ok_time = time.monotonic()
+        self.last_update_time = time.monotonic()
         # Test seam: called after the next state is fully built and
         # warmed, immediately before the snapshot swap — lets tests gate
         # the publish on an event (torn-read pinning) without wall-clock.
@@ -298,12 +309,11 @@ class Predictor:
                 }
 
     def _dirs(self) -> List[str]:
-        fulls = self._ck._list("full")
-        if not fulls:
-            return []
-        out = [f"full-{fulls[-1]}"]
-        out += [f"incr-{s}" for s in self._ck._list("incr") if s > fulls[-1]]
-        return out
+        """Basenames of the VERIFIED checkpoint chain. Corrupt or torn
+        links are quarantined by the manager as a side effect and never
+        returned — serving treats them as absent (degraded-serving
+        contract: keep answering from the last good model)."""
+        return self._ck.chain_dirs()
 
     def poll_updates(self) -> bool:
         """Apply anything new: a newer full checkpoint triggers a full
@@ -312,29 +322,82 @@ class Predictor:
         finished, warmed replacement swaps in. Returns True if the model
         changed. Safe to call concurrently (HTTP /v1/reload + background
         poller): the whole check-then-act runs under the updater lock, so
-        a stale delta can never replay over a newer full reload."""
+        a stale delta can never replay over a newer full reload.
+
+        Fault contract: only dirs that pass integrity verification are
+        considered (corrupt deltas are quarantined and skipped — the old
+        snapshot keeps serving); a verified delta whose replay still
+        fails is quarantined too and the chain truncates there. A raised
+        exception (IO errors listing the dir, OOM mid-warm) increments
+        `consecutive_poll_failures` for the watchdogs and re-raises — the
+        caller loop (`_run_poll_loop`) retries with capped backoff."""
         t0 = time.perf_counter()
-        with self._lock:
-            new = [d for d in self._dirs() if d not in self._applied]
-            if not new:
-                return False
-            if any(d.startswith("full-") for d in new):
-                self.reload()
-            else:
-                state = self._snap.state
-                applied = set(self._applied)
-                for d in sorted(new, key=lambda s: int(s.split("-")[1])):
+        try:
+            with self._lock:
+                changed = self._poll_locked(t0)
+        except BaseException:
+            self.consecutive_poll_failures += 1
+            raise
+        self.consecutive_poll_failures = 0
+        self.last_poll_ok_time = time.monotonic()
+        self.last_good_version = self._snap.version
+        return changed
+
+    def _poll_locked(self, t0: float) -> bool:
+        new = [d for d in self._dirs() if d not in self._applied]
+        if not new:
+            return False
+        if any(d.startswith("full-") for d in new):
+            self.reload()
+        else:
+            state = self._snap.state
+            applied = set(self._applied)
+            progressed = False
+            for d in sorted(new, key=lambda s: int(s.split("-")[1])):
+                path = os.path.join(self._ck.dir, d)
+                try:
                     state = self._ck.restore_into(
-                        state, os.path.join(self._ck.dir, d),
-                        chunk=self._restore_chunk,
+                        state, path, chunk=self._restore_chunk,
                     )
-                    applied.add(d)
-                if self._device is not None:
-                    state = jax.device_put(state, self._device)
-                self._publish(state, applied)
-            self.update_count += 1
-            self.last_update_ms = round((time.perf_counter() - t0) * 1e3, 3)
+                except Exception as e:
+                    # Passed verification yet failed to replay (e.g. rows
+                    # exceed this topology's capacity, FS error mid-read):
+                    # quarantine it and stop at the gap — what already
+                    # replayed this round still publishes below, and the
+                    # trainer's next save re-anchors the chain.
+                    self._ck.quarantine(path, f"delta replay failed: {e}")
+                    break
+                applied.add(d)
+                progressed = True
+            if not progressed:
+                return False
+            if self._device is not None:
+                state = jax.device_put(state, self._device)
+            self._publish(state, applied)
+        self.update_count += 1
+        self.last_update_time = time.monotonic()
+        self.last_update_ms = round((time.perf_counter() - t0) * 1e3, 3)
         return True
+
+    def health(self) -> Dict:
+        """Liveness/freshness summary for watchdogs — the `/healthz` body
+        and the ServeLoop heartbeat payload. `staleness_seconds` is the
+        age of the last successful poll round (the last time serving
+        CONFIRMED it is as fresh as the checkpoint dir), not the age of
+        the last model change — an idle trainer is not staleness."""
+        now = time.monotonic()
+        return {
+            "status": "ok" if self.consecutive_poll_failures == 0
+            else "degraded",
+            "model_version": self.version,
+            "step": self.step,
+            "staleness_seconds": round(now - self.last_poll_ok_time, 3),
+            "last_update_age_seconds": round(
+                now - self.last_update_time, 3),
+            "consecutive_poll_failures": self.consecutive_poll_failures,
+            "last_good_version": self.last_good_version,
+            "quarantined": self._ck.quarantine_count,
+        }
 
     # ------------------------------------------------------------- predict
 
@@ -513,24 +576,63 @@ class Predictor:
                 "model_version": snap.version}
 
 
-def _run_poll_loop(owner, stop: threading.Event, secs: float) -> None:
-    """Shared checkpoint-watch loop (ModelServer + ServerGroup): poll
-    `owner.predictor` for updates every `secs`, surfacing failures via a
-    consecutive-failure counter + log — a corrupt checkpoint must not
-    silently freeze the served model."""
-    while not stop.is_set():
-        time.sleep(secs)
+def _run_poll_loop(owner, stop: threading.Event, secs: float,
+                   max_backoff_secs: float = 30.0,
+                   pause: Optional[threading.Event] = None,
+                   on_round=None) -> None:
+    """Shared checkpoint-watch loop (ModelServer + ServerGroup + ServeLoop):
+    poll `owner.predictor` for updates every `secs`.
+
+    Survivability contract: this loop NEVER exits on an exception — a
+    poll that raises (corrupt checkpoint dir mid-scan, FS blip, OOM in a
+    warm pass) is counted, logged, and retried with capped exponential
+    backoff + jitter; the old snapshot keeps serving throughout, and the
+    failure is visible through `owner.update_failures` and the
+    predictor's `consecutive_poll_failures` / `staleness_seconds`
+    (/healthz), so watchdogs see a degraded poller instead of a silently
+    frozen model. Backoff resets to the base cadence on the first
+    success. `stop.wait` (not time.sleep) keeps shutdown prompt.
+
+    `pause` (when set) skips rounds without stopping the thread —
+    deterministic fault tests gate polling while they corrupt a delta.
+    `on_round(status)` runs after every non-paused round with "ok" or
+    "degraded" (ServeLoop stamps its heartbeat there); it must never
+    kill the poller, so exceptions from it are swallowed."""
+    import logging
+    import random
+
+    log = logging.getLogger(__name__)
+    rng = random.Random(id(owner) & 0xFFFFFFFF)
+    delay = secs
+    while not stop.wait(delay):
+        if pause is not None and pause.is_set():
+            delay = secs
+            continue
+        status = "ok"
         try:
             owner.predictor.poll_updates()
             owner.update_failures = 0
+            delay = secs
         except Exception as e:
-            owner.update_failures = getattr(owner, "update_failures", 0) + 1
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "model update poll failed (%d consecutive): %s",
-                owner.update_failures, e,
-            )
+            status = "degraded"
+            try:
+                n = getattr(owner, "update_failures", 0) + 1
+                owner.update_failures = n
+                # capped exponential backoff, jittered across [0.5, 1.5)x
+                # so N pollers hitting one bad FS don't retry in lockstep
+                delay = min(max_backoff_secs, secs * (2 ** min(n, 10)))
+                delay *= 0.5 + rng.random()
+                log.warning(
+                    "model update poll failed (%d consecutive, retry in "
+                    "%.1fs): %s", n, delay, e,
+                )
+            except Exception:  # accounting must never kill the poller
+                delay = max_backoff_secs
+        if on_round is not None:
+            try:
+                on_round(status)
+            except Exception:
+                pass  # accounting must never kill the poller
 
 
 class ModelServer:
@@ -764,6 +866,7 @@ class ModelServer:
             "updates": p.update_count,
             "last_update_ms": p.last_update_ms,
         }
+        out["health"] = p.health()
         return out
 
     def close(self):
@@ -801,6 +904,16 @@ class _GroupPredictor:
         info = self._members[0].model_info()
         info["replicas"] = len(self._members)
         return info
+
+    def health(self) -> Dict:
+        """Worst member's health — a wedged replica is the group's
+        status, not an average."""
+        healths = [m.health() for m in self._members]
+        worst = max(healths, key=lambda h: h["staleness_seconds"])
+        if any(h["status"] != "ok" for h in healths):
+            worst = next(h for h in healths if h["status"] != "ok")
+        worst["replicas"] = len(self._members)
+        return worst
 
 
 class ServerGroup:
@@ -880,6 +993,10 @@ class ServerGroup:
             "updates": sum(p.update_count for p in ps),
             "last_update_ms": max(p.last_update_ms for p in ps),
         }
+        # Group health: the worst member speaks for the group — the SAME
+        # selection /healthz uses (_GroupPredictor.health), so the two
+        # watchdog surfaces can never disagree about the group's status.
+        out["health"] = self.predictor.health()
         return out
 
     def close(self):

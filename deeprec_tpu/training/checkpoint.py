@@ -30,16 +30,27 @@ half on a background writer thread so the npz IO overlaps the next train
 dispatches — at most one save in flight, `wait()` drains it, and a killed
 writer leaves a manifest-less dir that `_list()` already ignores (the
 manifest stays the completeness marker).
+
+Checksummed chains (round 12): every npz array's digest is recorded in the
+manifest at write time, delta manifests carry a `base` link to the save
+they apply over, and `verify()`/`valid_chain()` replay the checks on the
+read side. A corrupt or torn link is QUARANTINED (dir renamed to
+`*.quarantined`) and consumers fall back to the longest valid chain
+prefix; a quarantined step newer than the latest full escalates the
+trainer's next save to full (`_effective_kind`), which re-anchors the
+chain — the self-healing loop docs/fault-tolerance.md specifies.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import re
 import shutil
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -49,6 +60,26 @@ import numpy as np
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
 from deeprec_tpu.training.trainer import TrainState, Trainer
 from deeprec_tpu.utils import hashing
+
+_log = logging.getLogger(__name__)
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A committed checkpoint dir failed integrity verification (missing
+    file, torn manifest, digest mismatch). Consumers treat the dir as
+    absent — quarantine + longest-valid-prefix fallback — rather than
+    letting this escape into serving."""
+
+
+def _array_digest(arr: np.ndarray) -> str:
+    """Per-array content digest recorded in the manifest at write time and
+    re-checked by `CheckpointManager.verify`. crc32 over the raw bytes plus
+    dtype/shape: fast enough to run inline with the npz write (GB/s), and
+    any payload bit-flip the zip layer misses still fails here."""
+    a = np.ascontiguousarray(arr)
+    crc = zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+    shape = "x".join(map(str, a.shape))
+    return f"crc32:{crc:08x}:{a.dtype.str}:{shape}"
 
 
 # ----------------------------------------------------------- table export
@@ -448,6 +479,13 @@ class CheckpointManager:
         self._writer_err: Optional[Tuple[BaseException, str]] = None
         self._force_full = False  # failed incr writer -> next save is full
         self.on_write = None
+        # Integrity state: dirs that already passed verify() (files are
+        # immutable once the manifest commits, so one pass is enough);
+        # quarantine_count / last_quarantined surface through serving
+        # health (Predictor.health, /healthz).
+        self._verified: set = set()
+        self.quarantine_count = 0
+        self.last_quarantined: Optional[str] = None
         # Stall/traffic accounting (bench.py, tools/bench_ckpt.py):
         # ckpt_stall_ms accumulates CALLER-side blocking time across saves;
         # last_save records {kind, path, async, stall_ms, transfer_bytes,
@@ -910,8 +948,16 @@ class CheckpointManager:
         self.wait()
 
     def _effective_kind(self, kind: str) -> str:
-        if kind == "incr" and getattr(self, "_force_full", False):
+        if kind != "incr":
+            return kind
+        if getattr(self, "_force_full", False):
             return "full"  # see wait(): a lost delta voids the incr chain
+        if self._chain_has_gap():
+            # A consumer quarantined a corrupt/torn link newer than the
+            # latest full: deltas past the gap can never replay, so the
+            # next save must re-anchor the chain (self-healing contract,
+            # same semantics as the failed-incr-writer escalation).
+            return "full"
         return kind
 
     # ------------------------------------------------------- save halves
@@ -948,6 +994,7 @@ class CheckpointManager:
         # drop any cached copy so a later restore() on this manager
         # validates against the new one.
         getattr(self, "_manifest_cache", {}).pop(path, None)
+        self._verified.discard(path)
         write = self._is_writer()
         parts = self._use_parts()
         positions = (
@@ -985,6 +1032,17 @@ class CheckpointManager:
             positions=positions, stats={"transfer_bytes": int(transfer)},
         )
 
+    @staticmethod
+    def _savez(digests: Dict[str, Dict[str, str]], path: str, fname: str,
+               arrays: Dict[str, np.ndarray]) -> None:
+        """np.savez + per-array digest recording: the digests land in the
+        manifest (written LAST), so any committed checkpoint carries the
+        checksums `verify()` replays. Digests are computed from the exact
+        arrays handed to np.savez — what's on disk must hash to this."""
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        np.savez(os.path.join(path, fname), **arrays)
+        digests[fname] = {k: _array_digest(v) for k, v in arrays.items()}
+
     def _write_plan(self, plan: _SavePlan) -> None:
         """Host half of a save: materialize, write npz files, commit the
         manifest LAST (completeness marker), GC. Runs on the caller (sync)
@@ -992,6 +1050,7 @@ class CheckpointManager:
         `_sync` below is a no-op there)."""
         path, kind, step = plan.path, plan.kind, plan.step
         write, parts = plan.write, plan.parts
+        digests: Dict[str, Dict[str, str]] = {}
         try:
             if write or parts or self.datasets:
                 os.makedirs(path, exist_ok=True)
@@ -1034,11 +1093,12 @@ class CheckpointManager:
                         else self._export_bundle_parts(plan.state, bname, False)
                     )
                     for tag, arrays in exported.items():
-                        np.savez(
-                            os.path.join(
-                                path, f"table_{bname}_{tag}.part{pid:05d}.npz"
-                            ),
-                            **arrays,
+                        # Digest the writer process's OWN part files; other
+                        # processes' parts are covered by the part-count
+                        # check in _iter_part_rows, not by checksums.
+                        self._savez(
+                            digests, path,
+                            f"table_{bname}_{tag}.part{pid:05d}.npz", arrays,
                         )
                 self._write_positions(path, plan.positions)
                 # The manifest is the completeness marker (_list() ignores
@@ -1058,9 +1118,9 @@ class CheckpointManager:
                     )
                     for tag, arrays in exported.items():
                         if write:
-                            np.savez(
-                                os.path.join(path, f"table_{bname}_{tag}.npz"),
-                                **arrays,
+                            self._savez(
+                                digests, path, f"table_{bname}_{tag}.npz",
+                                arrays,
                             )
             if not parts:
                 # parts mode wrote positions before its pre-manifest
@@ -1068,22 +1128,32 @@ class CheckpointManager:
                 self._write_positions(path, plan.positions)
                 self._sync(f"ckpt-{kind}-{step}-datasets")
             if write:
-                np.savez(os.path.join(path, "dense.npz"),
-                         **_tree_to_npz_dict(plan.dense))
-                np.savez(os.path.join(path, "opt.npz"),
-                         **_tree_to_npz_dict(plan.opt_state))
-                manifest = {"step": step, "kind": kind}
+                self._savez(digests, path, "dense.npz",
+                            _tree_to_npz_dict(plan.dense))
+                self._savez(digests, path, "opt.npz",
+                            _tree_to_npz_dict(plan.opt_state))
+                manifest = {"step": step, "kind": kind, "digests": digests}
                 if parts:
                     manifest["format"] = "parts"
                     manifest["parts"] = jax.process_count()
                     manifest["num_shards"] = self.trainer.num_shards
+                if kind == "incr":
+                    # Chain linkage: the step of the save this delta applies
+                    # over. Restore walks base-links from the full anchor —
+                    # a delta whose base is missing (quarantined or deleted
+                    # middle link) sits beyond a gap and must not replay.
+                    manifest["base"] = self._chain_tip(before=step)
                 if kind == "full":
                     manifest["bundles"] = {
                         bn: [f.name for f in b.features]
                         for bn, b in self.trainer.bundles.items()
                     }
-                with open(os.path.join(path, "manifest.json"), "w") as f:
+                # Atomic manifest commit: a crash mid-write must leave NO
+                # manifest (dir invisible), never a torn one.
+                mtmp = os.path.join(path, ".manifest.json.tmp")
+                with open(mtmp, "w") as f:
                     json.dump(manifest, f)
+                os.replace(mtmp, os.path.join(path, "manifest.json"))
                 # GC after BOTH kinds: full saves age out old fulls, and
                 # either kind sweeps incr dirs orphaned by an aged-out base.
                 self._gc()
@@ -1125,6 +1195,156 @@ class CheckpointManager:
         fulls = self._list("full")
         return fulls[-1] if fulls else None
 
+    # ------------------------------------------- chain integrity (verify)
+
+    def _chain_tip(self, before: Optional[int] = None) -> int:
+        """Step of the newest committed link the next delta applies over:
+        the latest full plus any newer deltas (-1 when the dir is empty).
+        `before` bounds the scan to steps < before (the save being written
+        must not see itself)."""
+        steps = self._list("full") + self._list("incr")
+        if before is not None:
+            steps = [s for s in steps if s < before]
+        return max(steps, default=-1)
+
+    def _verify_quiet(self, path: str) -> Optional[str]:
+        """Integrity-check one committed checkpoint dir against its
+        manifest digests. Returns None when intact, else a reason string.
+        Covers: torn/unparseable manifest, missing files, npz that fail to
+        read (truncation tears the zip), and per-array digest mismatches
+        (payload bit-flips). Dirs without digests (pre-checksum saves)
+        verify their files are at least readable. Results are memoized —
+        committed files are immutable, so each dir pays the read once."""
+        if path in self._verified:
+            return None
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except OSError as e:
+            return f"manifest unreadable: {e}"
+        except ValueError as e:
+            return f"manifest torn: {e}"
+        digests = manifest.get("digests")
+        if digests:
+            for fname, arrays in digests.items():
+                fpath = os.path.join(path, fname)
+                if not os.path.exists(fpath):
+                    return f"{fname}: missing from committed checkpoint"
+                try:
+                    with np.load(fpath) as z:
+                        names = set(z.files)
+                        for aname, want in arrays.items():
+                            if aname not in names:
+                                return f"{fname}:{aname}: array absent"
+                            got = _array_digest(z[aname])
+                            if got != want:
+                                return (f"{fname}:{aname}: digest mismatch "
+                                        f"({got} != recorded {want})")
+                except Exception as e:  # zip CRC / truncation / bad header
+                    return f"{fname}: unreadable ({type(e).__name__}: {e})"
+        self._verified.add(path)
+        return None
+
+    def verify(self, path: str) -> None:
+        """Raise CheckpointCorrupt if `path` fails integrity checks."""
+        err = self._verify_quiet(path)
+        if err is not None:
+            raise CheckpointCorrupt(f"checkpoint {path}: {err}")
+
+    def quarantine(self, path: str, reason: str) -> Optional[str]:
+        """Move a corrupt/torn dir out of the chain namespace (rename to
+        `*.quarantined[.N]`) so every consumer — this process and any
+        other sharing the FS — stops seeing it as a chain link. Returns
+        the new path, or None if a racing consumer quarantined it first.
+        The rename is the signal the TRAINER self-heals from: a
+        quarantined step newer than the latest full means the delta chain
+        has a gap, and `_effective_kind` escalates the next save to full."""
+        dst = path + ".quarantined"
+        i = 1
+        while os.path.exists(dst):
+            dst = f"{path}.quarantined.{i}"
+            i += 1
+        try:
+            os.rename(path, dst)
+        except OSError:
+            return None  # another consumer won the rename race
+        self.quarantine_count += 1
+        self.last_quarantined = dst
+        getattr(self, "_manifest_cache", {}).pop(path, None)
+        self._verified.discard(path)
+        _log.warning("checkpoint quarantined: %s -> %s (%s)",
+                     path, dst, reason)
+        return dst
+
+    def valid_chain(self) -> Tuple[List[str], int]:
+        """The longest verified full+delta chain, quarantining any corrupt
+        link it finds. Returns (dir paths in replay order, tip step).
+
+        Walk: newest intact full, then deltas in step order while (a) each
+        verifies and (b) its manifest `base` links to the previous step —
+        a corrupt delta is quarantined and truncates the chain there; a
+        base mismatch (missing middle link) truncates WITHOUT quarantining
+        the later, intact-but-unusable deltas. A corrupt full falls back
+        to the next-older full. Raises FileNotFoundError when no intact
+        full exists."""
+        excluded: set = set()
+        while True:
+            fulls = [s for s in self._list("full") if s not in excluded]
+            if not fulls:
+                raise FileNotFoundError(
+                    f"no intact full checkpoint under {self.dir}"
+                )
+            fs = fulls[-1]
+            fpath = os.path.join(self.dir, f"full-{fs}")
+            err = self._verify_quiet(fpath)
+            if err is not None:
+                self.quarantine(fpath, err)
+                excluded.add(fs)
+                continue
+            chain, prev = [fpath], fs
+            for s in self._list("incr"):
+                if s <= fs:
+                    continue
+                p = os.path.join(self.dir, f"incr-{s}")
+                err = self._verify_quiet(p)
+                if err is not None:
+                    self.quarantine(p, err)
+                    break  # later deltas sit beyond the gap
+                base = self._manifest(p).get("base")
+                if base is not None and base != prev:
+                    break  # missing middle link: stop, keep later dirs
+                chain.append(p)
+                prev = s
+            return chain, prev
+
+    def chain_dirs(self) -> List[str]:
+        """Basenames of the current valid chain (serving poll contract:
+        corrupt links are quarantined as a side effect, never returned).
+        Empty when no intact full exists yet."""
+        try:
+            chain, _ = self.valid_chain()
+        except FileNotFoundError:
+            return []
+        return [os.path.basename(p) for p in chain]
+
+    def _chain_has_gap(self) -> bool:
+        """True when a quarantined dir's step is newer than the latest
+        intact full — the delta chain is missing a link only a full
+        re-anchor can repair. Checked by `_effective_kind` on every save,
+        so a quarantine by ANY consumer of the shared FS (e.g. the serving
+        process) escalates this trainer's next save to full."""
+        fulls = self._list("full")
+        latest = fulls[-1] if fulls else -1
+        pat = re.compile(r"^(?:full|incr)-(\d+)\.quarantined")
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return False
+        return any(
+            (m := pat.match(d)) is not None and int(m.group(1)) > latest
+            for d in names
+        )
+
     def restore(self, template: Optional[TrainState] = None,
                 chunk: Optional[int] = None) -> TrainState:
         """Latest full checkpoint + all newer deltas, onto the trainer's
@@ -1139,16 +1359,13 @@ class CheckpointManager:
         ignored on the sharded streaming path, which already imports
         file-sized chunks and runs off the serving hot path."""
         self.wait()  # an in-flight async save must land (or fail) first
-        full_step = self.latest_full()
-        if full_step is None:
+        if not self._list("full"):
             raise FileNotFoundError(f"no full checkpoint under {self.dir}")
-        chain = [os.path.join(self.dir, f"full-{full_step}")] + [
-            os.path.join(self.dir, f"incr-{s}")
-            for s in self._list("incr")
-            if s > full_step
-        ]
-        with open(os.path.join(self.dir, self._latest_dir(), "manifest.json")) as f:
-            step = json.load(f)["step"]
+        # Verified chain: corrupt or torn links are quarantined and the
+        # restore falls back to the longest valid prefix — a bad delta
+        # (or even a bad full) degrades to an older consistent state, it
+        # never raises into the caller as a parse/shape error.
+        chain, step = self.valid_chain()
         self._restore_datasets(chain)
         if self._is_sharded() and (
             jax.process_count() > 1 or self._use_parts()
@@ -1436,11 +1653,6 @@ class CheckpointManager:
             ),
         )
 
-    def _latest_dir(self) -> str:
-        fulls = self._list("full")
-        incrs = [s for s in self._list("incr") if s > fulls[-1]]
-        return f"incr-{incrs[-1]}" if incrs else f"full-{fulls[-1]}"
-
     @staticmethod
     def _part_files(path: str, bname: str, tag: str) -> List[str]:
         import glob as _glob
@@ -1673,3 +1885,14 @@ class CheckpointManager:
                 shutil.rmtree(
                     os.path.join(self.dir, f"incr-{i}"), ignore_errors=True
                 )
+        # Quarantined dirs are kept for forensics while relevant, but age
+        # out with the chain they broke (same bound as orphaned incrs).
+        pat = re.compile(r"^(?:full|incr)-(\d+)\.quarantined")
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for d in names:
+            m = pat.match(d)
+            if m and int(m.group(1)) <= fulls[0]:
+                shutil.rmtree(os.path.join(self.dir, d), ignore_errors=True)

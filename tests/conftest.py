@@ -8,20 +8,20 @@ without TPU hardware.
 Speed: the default run excludes tests marked ``slow`` (multi-process
 launches, the largest compile grids) so `pytest -q` gives a quick green;
 ``DEEPREC_FULL_TESTS=1`` runs everything (any explicit ``-m`` expression
-also takes over, e.g. ``-m 'slow or not slow'``). The XLA compilation
-cache uses a FRESH per-run directory: reusing one across processes
-(the previous default) made every warm run segfault/abort
-deterministically in ``test_sharded_models::test_din_sharded_matches_local``
-— jax 0.4.37's CPU PJRT client crashes DESERIALIZING the cached
-8-device shard_map executable (compile path fine, reload path fatal;
-reproduced on pre-change code, so it is an upstream serialization bug,
-not a program bug). Within one pytest process the in-memory jit cache
-still dedups compiles, which is where almost all of the win was anyway.
+also takes over, e.g. ``-m 'slow or not slow'``). The XLA PERSISTENT
+compilation cache is DISABLED: jax 0.4.37's CPU PJRT client
+intermittently aborts/segfaults DESERIALIZING a cached executable
+(compile path fine, reload path fatal; upstream serialization bug,
+reproduced on pre-change code). A fresh per-run cache dir (the previous
+mitigation) only avoided the cross-run reloads — within one run a later
+test recompiling the same program from a fresh Trainer still hit the
+reload path and died ~1 in 4 runs of the checkpoint-corruption module.
+With no cross-run reuse the per-run cache bought nothing but that crash:
+the in-memory jit cache still dedups compiles inside each test module,
+which is where almost all of the win was anyway (measured +~20% on the
+heaviest recompiling modules, well inside the tier-1 budget).
 """
-import atexit
 import os
-import shutil
-import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -29,12 +29,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    _cache_dir = tempfile.mkdtemp(prefix="deeprec_jax_cache_")
-    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
-    # Serialized executables reach tens of MB per run — don't leak them
-    # into the tempdir across CI loops.
-    atexit.register(shutil.rmtree, _cache_dir, True)
+# Also exported to subprocess workers (supervisor/launch tests): a spawned
+# worker inheriting a shared cache dir would reload its predecessor's
+# executables — the same fatal path.
+os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,246 @@
+"""Checkpoint-corruption matrix: every way a committed chain link can rot
+— truncated npz, bit-flipped payload, missing manifest, missing middle
+link, corrupt full anchor — must restore the longest valid prefix
+BIT-EXACTLY, quarantine the bad dir where one exists, and never raise
+into serving (the Predictor serves through and the trainer's next save
+self-heals the chain).
+
+The write-side halves of these guarantees (manifest-last commit, digest
+recording) live in training/checkpoint.py; the injectors in
+online/faults.py are the same ones tools/bench_freshness.py drives."""
+import json
+import os
+import shutil
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.online import faults
+from deeprec_tpu.training.checkpoint import CheckpointCorrupt, CheckpointManager
+
+
+def _mk_trainer():
+    import optax
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    model = WDL(emb_dim=4, capacity=1 << 10, hidden=(16,), num_cat=2,
+                num_dense=2)
+    return Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3)), model
+
+
+def _tables_np(state):
+    out = {}
+    for bname, ts in state.tables.items():
+        for name in ("keys", "meta", "values"):
+            out[f"{bname}/{name}"] = np.asarray(getattr(ts, name))
+    return out
+
+
+def _assert_tables_equal(a, b):
+    ka, kb = _tables_np(a), _tables_np(b)
+    assert sorted(ka) == sorted(kb)
+    for k in ka:
+        np.testing.assert_array_equal(ka[k], kb[k])
+
+
+@pytest.fixture(scope="module")
+def chain(tmp_path_factory):
+    """One full + two deltas, with a bit-exact restore REFERENCE captured
+    after each link landed: refs[s] is what a fresh consumer restoring a
+    chain that ends at step s must reproduce. Tests copy the dir and
+    corrupt their copy."""
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+
+    base = str(tmp_path_factory.mktemp("chain") / "ck")
+    tr, _ = _mk_trainer()
+    gen = SyntheticCriteo(batch_size=96, num_cat=2, num_dense=2, vocab=300,
+                          seed=3)
+
+    def step(st):
+        return tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in gen.batch().items()})[0]
+
+    ck = CheckpointManager(base, tr)
+    st = tr.init(0)
+    refs = {}
+    for _ in range(2):
+        st = step(st)
+    st, _ = ck.save(st)                # full-2
+    refs[2] = CheckpointManager(base, _mk_trainer()[0]).restore()
+    st = step(st)
+    st, _ = ck.save_incremental(st)    # incr-3
+    refs[3] = CheckpointManager(base, _mk_trainer()[0]).restore()
+    st = step(st)
+    st, _ = ck.save_incremental(st)    # incr-4
+    refs[4] = CheckpointManager(base, _mk_trainer()[0]).restore()
+    return SimpleNamespace(dir=base, refs=refs, mk=_mk_trainer)
+
+
+def _copy(chain, tmp_path):
+    dst = str(tmp_path / "ck")
+    shutil.copytree(chain.dir, dst)
+    return dst
+
+
+def _table_file(path):
+    return os.path.join(
+        path, sorted(f for f in os.listdir(path) if f.startswith("table_"))[0]
+    )
+
+
+def test_manifest_records_digests_and_base(chain):
+    with open(os.path.join(chain.dir, "incr-4", "manifest.json")) as f:
+        m = json.load(f)
+    assert m["base"] == 3  # link to incr-3
+    assert any(f.startswith("table_") for f in m["digests"])
+    assert "dense.npz" in m["digests"]
+    for arrays in m["digests"].values():
+        for digest in arrays.values():
+            assert digest.startswith("crc32:")
+    with open(os.path.join(chain.dir, "incr-3", "manifest.json")) as f:
+        assert json.load(f)["base"] == 2  # link to full-2
+
+
+def test_verify_passes_intact_and_catches_tamper(chain, tmp_path):
+    d = _copy(chain, tmp_path)
+    ck = CheckpointManager(d, chain.mk()[0])
+    for link in ("full-2", "incr-3", "incr-4"):
+        ck.verify(os.path.join(d, link))
+    faults.flip_bit(_table_file(os.path.join(d, "incr-3")))
+    ck2 = CheckpointManager(d, chain.mk()[0])  # fresh: no memoized verdicts
+    with pytest.raises(CheckpointCorrupt):
+        ck2.verify(os.path.join(d, "incr-3"))
+
+
+def test_truncated_npz_restores_longest_prefix(chain, tmp_path):
+    d = _copy(chain, tmp_path)
+    faults.truncate_file(_table_file(os.path.join(d, "incr-4")))
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    assert int(restored.step) == 3
+    _assert_tables_equal(restored, chain.refs[3])
+    assert os.path.exists(os.path.join(d, "incr-4.quarantined"))
+    assert not os.path.exists(os.path.join(d, "incr-4"))
+
+
+def test_bitflip_middle_link_truncates_at_gap(chain, tmp_path):
+    """Corrupting incr-3 must (a) quarantine it, (b) also DROP the intact
+    incr-4 — its base link points at the missing step — and (c) restore
+    full-2 bit-exactly. incr-4 stays on disk un-quarantined (it is not
+    corrupt, just unreachable)."""
+    d = _copy(chain, tmp_path)
+    faults.flip_bit(_table_file(os.path.join(d, "incr-3")))
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    assert int(restored.step) == 2
+    _assert_tables_equal(restored, chain.refs[2])
+    assert os.path.exists(os.path.join(d, "incr-3.quarantined"))
+    assert os.path.exists(os.path.join(d, "incr-4"))
+
+
+def test_missing_manifest_is_invisible(chain, tmp_path):
+    d = _copy(chain, tmp_path)
+    os.remove(os.path.join(d, "incr-3", "manifest.json"))
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    # manifest-less dir never enters the chain; incr-4's base link then
+    # fails and truncates the chain at the full anchor
+    assert int(restored.step) == 2
+    _assert_tables_equal(restored, chain.refs[2])
+
+
+def test_missing_middle_link_truncates(chain, tmp_path):
+    d = _copy(chain, tmp_path)
+    shutil.rmtree(os.path.join(d, "incr-3"))
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    assert int(restored.step) == 2
+    _assert_tables_equal(restored, chain.refs[2])
+
+
+def test_torn_manifest_quarantines(chain, tmp_path):
+    d = _copy(chain, tmp_path)
+    with open(os.path.join(d, "incr-4", "manifest.json"), "w") as f:
+        f.write('{"step": 4, "kind": "in')  # torn mid-write
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    assert int(restored.step) == 3
+    _assert_tables_equal(restored, chain.refs[3])
+    assert os.path.exists(os.path.join(d, "incr-4.quarantined"))
+
+
+def test_corrupt_full_falls_back_to_older_full(chain, tmp_path):
+    """A rotten ANCHOR falls back to the previous full; deltas based past
+    the quarantined anchor are unreachable and dropped."""
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+
+    d = _copy(chain, tmp_path)
+    tr = chain.mk()[0]
+    ck = CheckpointManager(d, tr)
+    st = ck.restore()
+    gen = SyntheticCriteo(batch_size=96, num_cat=2, num_dense=2, vocab=300,
+                          seed=9)
+    st = tr.train_step(
+        st, {k: jnp.asarray(v) for k, v in gen.batch().items()})[0]
+    st, _ = ck.save(st)                # full-5
+    ref4 = chain.refs[4]
+    faults.flip_bit(_table_file(os.path.join(d, "full-5")))
+    restored = CheckpointManager(d, chain.mk()[0]).restore()
+    assert int(restored.step) == 4     # full-2 + incr-3 + incr-4
+    _assert_tables_equal(restored, ref4)
+    assert os.path.exists(os.path.join(d, "full-5.quarantined"))
+
+
+def test_corruption_never_raises_into_serving_and_self_heals(chain, tmp_path):
+    """The acceptance-pinned loop: a corrupt delta landing under a LIVE
+    Predictor is quarantined by the poll (old snapshot keeps serving,
+    health reports it, nothing raises); the trainer's next incremental
+    save escalates itself to FULL because the chain has a gap; the next
+    poll picks the new anchor up and freshness resumes."""
+    import jax.numpy as jnp
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.serving.predictor import Predictor
+
+    d = _copy(chain, tmp_path)
+    tr, model = chain.mk()
+    ck = CheckpointManager(d, tr)
+    st = ck.restore()
+    gen = SyntheticCriteo(batch_size=96, num_cat=2, num_dense=2, vocab=300,
+                          seed=5)
+
+    def step(st):
+        return tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in gen.batch().items()})[0]
+
+    p = Predictor(model, d)
+    assert p.step == 4
+    req = {k: v for k, v in gen.batch().items() if k != "label"}
+    before = p.predict(req)
+
+    # trainer lands a delta; corrupt it BEFORE the predictor polls
+    st = step(st)
+    st, delta = ck.save_incremental(st)        # incr-5
+    faults.flip_bit(_table_file(delta))
+
+    assert p.poll_updates() is False           # served through, no raise
+    assert p.step == 4                          # old snapshot intact
+    np.testing.assert_array_equal(np.asarray(before),
+                                  np.asarray(p.predict(req)))
+    h = p.health()
+    assert h["quarantined"] >= 1
+    assert h["status"] == "ok"                  # poll SUCCEEDED (degraded
+    assert os.path.exists(delta + ".quarantined")  # dir, healthy poller)
+
+    # trainer self-heals: the next "incremental" save sees the gap and
+    # escalates to a full anchor...
+    st = step(st)
+    st, path2 = ck.save_incremental(st)
+    assert os.path.basename(path2).startswith("full-")
+    # ...which the next poll applies: freshness resumes past the gap.
+    assert p.poll_updates() is True
+    assert p.step == int(st.step)
+    assert p.predict(req) is not None

@@ -471,3 +471,141 @@ def test_work_queue_file_coordinated(tmp_path):
     all_items = taken[0] + taken[1]
     assert sorted(all_items) == sorted(f"f{i}" for i in range(20))
     assert not (set(taken[0]) & set(taken[1]))
+
+
+def test_tcp_backoff_delay_is_exponential_and_capped():
+    """Reconnect policy: base * 2^(k-1) per consecutive failure, capped —
+    pinned on the pure delay function so no test ever sleeps for it."""
+    from deeprec_tpu.data import TCPStreamReader
+
+    r = TCPStreamReader("127.0.0.1", 1, reconnect_secs=0.5,
+                        reconnect_max_secs=8.0)
+    assert r.backoff_delay(1) == 0.5
+    assert r.backoff_delay(2) == 1.0
+    assert r.backoff_delay(3) == 2.0
+    assert r.backoff_delay(5) == 8.0   # capped
+    assert r.backoff_delay(50) == 8.0  # and no overflow past the cap
+
+
+def test_tcp_reader_counts_reconnect_attempts(tmp_path):
+    """A dead broker drives consecutive_connect_failures up (visible to
+    supervisors); a successful connect resets it and counts reconnects."""
+    import socket
+
+    from deeprec_tpu.data import FileStreamServer, TCPStreamReader
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    r = TCPStreamReader("127.0.0.1", port, batch_size=4,
+                        reconnect_secs=0.01, reconnect_max_secs=0.03)
+    t = threading.Thread(target=lambda: next(iter(r), None), daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while r.consecutive_connect_failures < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert r.consecutive_connect_failures >= 3
+    assert r.connect_attempts >= 3
+
+    # now a live broker: the counter must reset on the next connect
+    p = str(tmp_path / "log.tsv")
+    with open(p, "w") as f:
+        for i in range(8):
+            f.write(f"r{i}\n")
+    srv = FileStreamServer(p, port=port, follow=True).start()
+    try:
+        deadline = time.time() + 10
+        while r.consecutive_connect_failures != 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert r.consecutive_connect_failures == 0
+    finally:
+        srv.stop()
+
+
+def test_work_queue_torn_cursor_write_never_observed(tmp_path):
+    """A worker killed MID-WRITE of the shared cursor file must not
+    strand the other workers: the commit goes to a tempfile + rename, so
+    a torn attempt leaves the previous state fully intact and parseable.
+    The kill is injected via the on_coord_write seam (partial bytes, then
+    die), which is exactly what a SIGKILL between write() and rename()
+    leaves behind."""
+    import json as _json
+
+    coord = str(tmp_path / "wq.json")
+    items = [f"f{i}" for i in range(6)]
+    wq1 = WorkQueue(items, shuffle=False, coordination_file=coord)
+    assert wq1.take() == "f0"
+
+    def torn(f, data):
+        f.write(data[: len(data) // 3])  # partial JSON on disk...
+        raise KeyboardInterrupt("injected kill mid-write")
+
+    wq1.on_coord_write = torn
+    with pytest.raises(KeyboardInterrupt):
+        wq1.take()  # dies mid-commit of cursor 1 -> 2
+    # the shared file is the PREVIOUS complete state, not a torn one
+    with open(coord) as f:
+        st = _json.load(f)
+    assert st["cursor"] == 1
+
+    # a concurrent taker (fresh worker process analog) proceeds unharmed
+    wq2 = WorkQueue(items, shuffle=False, coordination_file=coord)
+    assert wq2.take() == "f1"
+    # and the dead worker's partial tempfile is never read as state
+    wq1.on_coord_write = None
+    assert wq1.take() == "f2"
+
+
+def test_work_queue_torn_writes_with_concurrent_takers(tmp_path):
+    """Hammer the coordinated queue from two threads while a third
+    repeatedly injects torn writes: every item is taken exactly once and
+    no taker ever hits a JSON parse error."""
+    coord = str(tmp_path / "wq.json")
+    items = [f"f{i}" for i in range(40)]
+    torn_count = [0]
+
+    def make_wq():
+        return WorkQueue(items, shuffle=False, coordination_file=coord)
+
+    wq_a, wq_b, wq_evil = make_wq(), make_wq(), make_wq()
+
+    def torn(f, data):
+        torn_count[0] += 1
+        f.write(data[:7])
+        raise KeyboardInterrupt("injected")
+
+    wq_evil.on_coord_write = torn
+    taken = [[], []]
+    stop = threading.Event()
+
+    def taker(i, wq):
+        while True:
+            item = wq.take()  # a parse error would raise out of here
+            if item is None:
+                return
+            taken[i].append(item)
+            time.sleep(0.001)
+
+    def saboteur():
+        while not stop.is_set():
+            try:
+                wq_evil.take()
+            except KeyboardInterrupt:
+                pass
+            time.sleep(0.002)
+
+    ts = [threading.Thread(target=taker, args=(0, wq_a)),
+          threading.Thread(target=taker, args=(1, wq_b))]
+    tsab = threading.Thread(target=saboteur, daemon=True)
+    for t in ts:
+        t.start()
+    tsab.start()
+    for t in ts:
+        t.join(timeout=60)
+    stop.set()
+    tsab.join(timeout=5)
+    assert torn_count[0] >= 1  # the fault actually fired
+    got = taken[0] + taken[1]
+    assert sorted(got) == sorted(items)
+    assert not (set(taken[0]) & set(taken[1]))

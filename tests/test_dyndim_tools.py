@@ -52,7 +52,7 @@ def test_shrink_ckpt_routes_by_name_not_shape(tmp_path):
         bloom=np.arange(n, dtype=np.int32),  # length == n by coincidence
         **{"slot:accum": np.full((n, 2), 0.1, np.float32)},
     )
-    before, after = mod.shrink_table(src, dst, min_freq=3, min_version=0)
+    before, after, _ = mod.shrink_table(src, dst, min_freq=3, min_version=0)
     assert (before, after) == (4, 3)
     d = dict(np.load(dst))
     assert d["keys"].shape[0] == 3

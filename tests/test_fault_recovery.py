@@ -5,17 +5,18 @@ The failure-detection/recovery story (SURVEY.md §5): the reference runs an
 external dead-PS detector + restart protocol; here recovery is
 checkpoint-shaped — full+incremental state restore plus WorkQueue consumer
 state, both validated against a real kill -9 (not a polite exception).
-"""
+The subprocess machinery lives in deeprec_tpu/online/faults.py (shared
+with tools/bench_freshness.py and the supervisor tests)."""
 import json
 import os
 import signal
-import subprocess
 import sys
 import textwrap
-import time
 
 import numpy as np
 import pytest
+
+from deeprec_tpu.online import faults
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
@@ -150,35 +151,28 @@ def test_sigkill_mid_training_resumes_and_completes(tmp_path):
     script = str(tmp_path / "worker.py")
     with open(script, "w") as f:
         f.write(WORKER.format(repo=REPO, ckpt=ckpt))
-    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    # run 1: kill -9 once it has saved a checkpoint AND run a few steps
+    # past it, so the kill genuinely loses progress
+    p = faults.spawn_worker([sys.executable, script])
+    saved = {"seen": False}
 
-    # run 1: kill -9 once it has saved at least one checkpoint
-    p = subprocess.Popen([sys.executable, script], env=env,
-                         stdout=subprocess.PIPE, text=True, bufsize=1)
-    saved = False
-    deadline = time.time() + 240
-    lines1 = []
-    while time.time() < deadline:
-        line = p.stdout.readline()
-        if not line:
-            break
-        lines1.append(line.strip())
+    def past_save(line: str) -> bool:
         if line.startswith("SAVED"):
-            saved = True
-        # let it run a few steps PAST the save so the kill loses progress
-        if saved and line.startswith("STEP") and int(line.split()[1]) >= 14:
-            os.kill(p.pid, signal.SIGKILL)
-            break
-    p.wait(timeout=30)
-    assert saved, lines1
-    assert p.returncode == -signal.SIGKILL
+            saved["seen"] = True
+        return (saved["seen"] and line.startswith("STEP")
+                and int(line.split()[1]) >= 14)
+
+    hit, lines1 = faults.wait_for_line(p, past_save, timeout=240)
+    assert hit is not None and saved["seen"], lines1
+    assert faults.sigkill(p) == -signal.SIGKILL
     assert not os.path.exists(os.path.join(ckpt, "final.json"))
 
     # run 2: must resume from the checkpoint (not step 0) and finish
-    out = subprocess.run([sys.executable, script], env=env,
-                         capture_output=True, text=True, timeout=240)
-    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
-    lines2 = out.stdout.splitlines()
+    p = faults.spawn_worker([sys.executable, script])
+    done, lines2 = faults.wait_for_line(
+        p, lambda l: l.startswith("DONE"), timeout=240)
+    assert p.wait(timeout=30) == 0, lines2[-20:]
+    assert done is not None, lines2[-20:]
     assert any(l.startswith("RESUMED") for l in lines2), lines2[:3]
     resumed_at = int([l for l in lines2 if l.startswith("RESUMED")][0].split()[1])
     assert resumed_at >= 10  # a saved step, not a fresh start

@@ -1,0 +1,443 @@
+"""Online-loop robustness: heartbeat leases, supervisor restart/wedge/
+budget/EXIT_RESCALE semantics, TrainLoop save cadence surviving writer
+faults, and the ServeLoop/poll-thread survivability contract.
+
+Supervisor tests use tiny NON-jax child processes (sleep/exit scripts) so
+restart choreography is pinned without paying interpreter+jax startup
+per generation; the full jax worker subprocess path is exercised by the
+slow-marked end-to-end test and tools/bench_freshness.py --smoke in CI."""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.online import faults
+from deeprec_tpu.online.supervisor import Heartbeat, ProcessSpec, Supervisor
+from deeprec_tpu.parallel.elastic import EXIT_RESCALE
+
+
+# ------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_roundtrip_and_age(tmp_path):
+    hb = Heartbeat(str(tmp_path / "w.hb"))
+    assert Heartbeat.read(hb.path) is None
+    assert Heartbeat.age(hb.path) is None
+    hb.beat(step=7, status="ok", custom=3)
+    got = Heartbeat.read(hb.path)
+    assert got["step"] == 7 and got["status"] == "ok" and got["custom"] == 3
+    assert got["pid"] == os.getpid()
+    assert Heartbeat.age(hb.path) < 5.0
+    # stamp is atomic: no partial tempfile left behind
+    assert [f for f in os.listdir(tmp_path)] == ["w.hb"]
+
+
+def test_heartbeat_write_failure_does_not_raise(tmp_path):
+    hb = Heartbeat(str(tmp_path / "sub" / "w.hb"))
+    os.rmdir(str(tmp_path / "sub"))
+    hb.beat(step=1)  # vanished dir: worker must not die for a heartbeat
+
+
+# ------------------------------------------------------------ supervisor
+
+
+def _wait(pred, timeout=30.0, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    return None
+
+
+def _spec(name, code, tmp_path, **kw):
+    kw.setdefault("lease_secs", None)
+    kw.setdefault("backoff_base_secs", 0.05)
+    kw.setdefault("backoff_max_secs", 0.2)
+    return ProcessSpec(
+        name=name, argv=[sys.executable, "-c", code],
+        stdout=str(tmp_path / f"{name}.log"), **kw,
+    )
+
+
+def test_supervisor_restarts_killed_worker(tmp_path):
+    sup = Supervisor(
+        [_spec("w", "import time; time.sleep(600)", tmp_path,
+               max_restarts=3)],
+        poll_secs=0.05, on_event=lambda m: None,
+    ).start()
+    try:
+        pid1 = _wait(lambda: sup.pid("w"))
+        assert sup.kill("w")
+        assert _wait(lambda: sup.stats()["w"]["restarts"] == 1)
+        pid2 = _wait(lambda: sup.pid("w"))
+        assert pid2 and pid2 != pid1
+        assert sup.stats()["w"]["gave_up"] is False
+    finally:
+        sup.stop()
+
+
+def test_supervisor_budget_exhausts_on_crash_loop(tmp_path):
+    sup = Supervisor(
+        [_spec("crash", "raise SystemExit(3)", tmp_path, max_restarts=2)],
+        poll_secs=0.05, on_event=lambda m: None,
+    ).start()
+    try:
+        assert _wait(lambda: sup.stats()["crash"]["gave_up"], timeout=30)
+        st = sup.stats()["crash"]
+        assert st["restarts"] == 2  # budget, then loud terminal state
+        assert st["last_exit"] == 3
+        assert st["alive"] is False
+    finally:
+        sup.stop()
+
+
+def test_supervisor_honors_exit_rescale(tmp_path):
+    """EXIT_RESCALE is a PLANNED exit: immediate respawn, no budget
+    charge, and the on_rescale hook may swap argv for the next
+    generation."""
+    flag = str(tmp_path / "gen2")
+    code = (
+        f"import os, sys\n"
+        f"if os.path.exists({flag!r}): raise SystemExit(0)\n"
+        f"open({flag!r}, 'w').close()\n"
+        f"raise SystemExit({EXIT_RESCALE})\n"
+    )
+    seen = []
+    spec = _spec("el", code, tmp_path, max_restarts=1,
+                 on_rescale=lambda s: seen.append(1) or None)
+    sup = Supervisor([spec], poll_secs=0.05, on_event=lambda m: None).start()
+    try:
+        assert _wait(lambda: sup.stats()["el"]["done"], timeout=30)
+        st = sup.stats()["el"]
+        assert st["rescales"] == 1 and seen == [1]
+        assert st["restarts"] == 0  # planned exits are free
+        assert st["consecutive_failures"] == 0
+    finally:
+        sup.stop()
+
+
+def test_supervisor_wedge_detection_kills_and_restarts(tmp_path):
+    """A live process whose lease goes stale is WEDGED: SIGKILL + restart
+    on budget. The child stamps one beat then hangs forever."""
+    hb = str(tmp_path / "w.hb")
+    code = (
+        "import json, os, sys, time\n"
+        f"p = {hb!r}\n"
+        "json.dump({'pid': os.getpid(), 'time': time.time(), 'step': 1,"
+        " 'status': 'ok'}, open(p + '.tmp', 'w'))\n"
+        "os.replace(p + '.tmp', p)\n"
+        "time.sleep(600)\n"
+    )
+    spec = _spec("wedge", code, tmp_path, heartbeat_path=hb,
+                 lease_secs=0.4, grace_secs=0.2, max_restarts=1)
+    sup = Supervisor([spec], poll_secs=0.05, on_event=lambda m: None).start()
+    try:
+        assert _wait(lambda: sup.stats()["wedge"]["wedge_kills"] >= 1,
+                     timeout=30)
+        st = sup.stats()["wedge"]
+        assert st["last_exit"] is not None
+    finally:
+        sup.stop()
+
+
+# ----------------------------------------------------- TrainLoop (jax)
+
+
+def _mk_trainer():
+    import optax
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    model = WDL(emb_dim=4, capacity=1 << 10, hidden=(16,), num_cat=2,
+                num_dense=2)
+    return Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3)), model
+
+
+def _batches(n_cat=2, n_dense=2, B=96, seed=0):
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=B, num_cat=n_cat, num_dense=n_dense,
+                          vocab=300, seed=seed)
+    while True:
+        yield gen.batch()
+
+
+def test_train_loop_cadence_and_heartbeat(tmp_path):
+    from deeprec_tpu.online.loop import TrainLoop
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    tr, _ = _mk_trainer()
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    hb = Heartbeat(str(tmp_path / "t.hb"))
+    loop = TrainLoop(tr, ck, _batches(), save_every=4, full_every=3,
+                     heartbeat=hb, max_steps=16)
+    state, code = loop.run()
+    assert code == 0
+    assert int(state.step) == 16
+    dirs = sorted(d for d in os.listdir(tmp_path / "ck") if "-" in d)
+    # anchor first, then deltas, full again every 3rd save
+    assert "full-4" in dirs and "incr-8" in dirs and "full-12" in dirs
+    beat = Heartbeat.read(hb.path)
+    assert beat["step"] == 16 and beat["status"] == "done"
+    assert beat["saves"] == loop.saves >= 4
+    # a fresh consumer restores the final state (writer fully drained)
+    restored = CheckpointManager(str(tmp_path / "ck"), _mk_trainer()[0]).restore()
+    assert int(restored.step) == 16
+
+
+def test_train_loop_survives_torn_writer_and_self_heals(tmp_path):
+    """An async writer dying mid-save must not kill training OR the
+    chain: the loop counts the failure, keeps stepping, and the manager's
+    force-full escalation re-anchors on the next cadence save."""
+    from deeprec_tpu.online.loop import TrainLoop
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    tr, _ = _mk_trainer()
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    loop = TrainLoop(tr, ck, _batches(), save_every=3, full_every=100,
+                     max_steps=15)
+
+    armed = {"at": 2}  # tear the writer on the 2nd save (first delta)
+
+    def on_step(step):
+        if loop.saves == armed["at"] - 1 and ck.on_write is None:
+            faults.install_torn_write(ck)
+
+    loop.on_step = on_step
+    state, code = loop.run()
+    assert code == 0 and int(state.step) == 15
+    assert loop.save_failures >= 1
+    # the torn dir is manifest-less (invisible); a later save re-anchored
+    names = os.listdir(tmp_path / "ck")
+    assert any(d.startswith("full-") and
+               os.path.exists(tmp_path / "ck" / d / "manifest.json")
+               for d in names)
+    restored = CheckpointManager(str(tmp_path / "ck"), _mk_trainer()[0]).restore()
+    assert int(restored.step) >= 6
+
+
+def test_train_loop_rescale_contract(tmp_path):
+    """A posted scaling plan makes the loop checkpoint, ack, and return
+    EXIT_RESCALE — the supervisor's respawn signal."""
+    from deeprec_tpu.online.loop import TrainLoop
+    from deeprec_tpu.parallel.elastic import ElasticCoordinator
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    tr, _ = _mk_trainer()
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    coord = ElasticCoordinator(str(tmp_path / "el"))
+    epoch = coord.request_scale(2)
+    loop = TrainLoop(tr, ck, _batches(), save_every=100, heartbeat=None,
+                     coordinator=coord, elastic_every=2, max_steps=50)
+    state, code = loop.run()
+    assert code == EXIT_RESCALE
+    assert int(state.step) <= 4  # exited at the first elastic poll, not 50
+    assert coord.acked(epoch, 1)
+    restored = CheckpointManager(str(tmp_path / "ck"), _mk_trainer()[0]).restore()
+    assert int(restored.step) == int(state.step)  # durable before ack
+
+
+# --------------------------------------------- poll-thread survivability
+
+
+def _build_serving_chain(tmp_path, steps=3):
+    import jax.numpy as jnp
+
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    tr, model = _mk_trainer()
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    st = tr.init(0)
+    gen = _batches(seed=4)
+    for _ in range(steps):
+        st = tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in next(gen).items()})[0]
+    st, _ = ck.save(st)
+    req = {k: v for k, v in next(gen).items() if k != "label"}
+    return tr, model, ck, st, req, gen
+
+
+def test_poll_thread_survives_raising_poll_and_recovers(tmp_path):
+    """THE pinned bug: a poll_updates that raises (e.g. the checkpoint
+    dir becomes unreadable mid-scan) must leave the background poll loop
+    RUNNING and the old snapshot serving; when the fault clears, polling
+    resumes and new deltas land. Before this round a single escaped
+    exception killed the daemon thread silently and the model went
+    permanently stale with no signal."""
+    import jax.numpy as jnp
+
+    from deeprec_tpu.serving.predictor import ModelServer, Predictor
+
+    tr, model, ck, st, req, gen = _build_serving_chain(tmp_path)
+    p = Predictor(model, str(tmp_path / "ck"))
+    server = ModelServer(p, max_batch=32, poll_updates_secs=0.05)
+    try:
+        before = np.asarray(server.request(req))
+
+        # wound the scan: every chain listing now raises
+        real_list = p._ck._list
+
+        def bad_list(kind):
+            raise RuntimeError("injected: ckpt dir unreadable mid-scan")
+
+        p._ck._list = bad_list
+        assert _wait(lambda: p.consecutive_poll_failures >= 2, timeout=30)
+        assert server._poller.is_alive()  # the daemon thread SURVIVED
+        assert getattr(server, "update_failures", 0) >= 1
+        assert p.health()["status"] == "degraded"
+        # old snapshot still serves, bit-identically
+        np.testing.assert_array_equal(before, np.asarray(server.request(req)))
+
+        # heal the fault; a new delta must flow again through the SAME
+        # poll thread (no restart involved)
+        p._ck._list = real_list
+        st2 = tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in next(gen).items()})[0]
+        st2, _ = ck.save_incremental(st2)
+        assert _wait(
+            lambda: p.consecutive_poll_failures == 0
+            and p.step == int(st2.step),
+            timeout=30,
+        )
+        assert p.health()["status"] == "ok"
+        assert server._poller.is_alive()
+    finally:
+        server.close()
+
+
+def test_serve_loop_heartbeats_health_and_pause(tmp_path):
+    from deeprec_tpu.online.loop import ServeLoop
+
+    tr, model, ck, st, req, gen = _build_serving_chain(tmp_path)
+    hb = str(tmp_path / "s.hb")
+    sl = ServeLoop(model, str(tmp_path / "ck"), poll_secs=0.05,
+                   heartbeat=Heartbeat(hb))
+    try:
+        out, ver = sl.request_versioned(req)
+        assert np.asarray(out).shape[0] == 96
+        beat = _wait(lambda: Heartbeat.read(hb), timeout=30)
+        assert beat["status"] == "ok"
+        assert "staleness_seconds" in beat and "quarantined" in beat
+
+        # pause gates the poller: a new delta stays un-applied until resume
+        import jax.numpy as jnp
+
+        sl.pause()
+        time.sleep(0.2)
+        v0 = sl.predictor.version
+        st2 = tr.train_step(
+            st, {k: jnp.asarray(v) for k, v in next(gen).items()})[0]
+        st2, _ = ck.save_incremental(st2)
+        time.sleep(0.3)
+        assert sl.predictor.version == v0
+        sl.resume()
+        assert _wait(lambda: sl.predictor.version > v0, timeout=30)
+        assert sl.health()["step"] == int(st2.step)
+    finally:
+        sl.close()
+
+
+# -------------------------------------------------- launcher integration
+
+
+def test_trainloop_picks_up_heartbeat_env(tmp_path, monkeypatch):
+    """The supervise_worker contract: a worker spawned with
+    DEEPREC_HEARTBEAT_FILE set stamps that lease even when no Heartbeat
+    was threaded through explicitly — otherwise the supervisor kills a
+    healthy worker as wedged."""
+    from deeprec_tpu.online.loop import TrainLoop
+
+    hb = str(tmp_path / "w.hb")
+    monkeypatch.setenv("DEEPREC_HEARTBEAT_FILE", hb)
+
+    class _Ck:
+        def latest_full(self):
+            return None
+
+    loop = TrainLoop(trainer=None, ckpt=_Ck(), batches=[])
+    assert loop.heartbeat is not None and loop.heartbeat.path == hb
+    loop._beat(3)
+    assert Heartbeat.read(hb)["step"] == 3
+    # An explicit Heartbeat still wins over the env var.
+    other = Heartbeat(str(tmp_path / "explicit.hb"))
+    assert TrainLoop(trainer=None, ckpt=_Ck(), batches=[],
+                     heartbeat=other).heartbeat is other
+
+
+def test_launch_supervise_worker_restarts_then_completes(tmp_path):
+    """`python -m deeprec_tpu.launch --supervised`: a worker that crashes
+    once is restarted and the clean second run ends the job with rc 0.
+    Non-jax script (flag-file state machine) so this stays in tier-1."""
+    from deeprec_tpu.launch import supervise_worker
+
+    flag = str(tmp_path / "ran")
+    script = str(tmp_path / "w.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os, sys\n"
+            f"flag = {flag!r}\n"
+            "if os.path.exists(flag): raise SystemExit(0)\n"
+            "open(flag, 'w').close()\n"
+            "raise SystemExit(7)\n"
+        )
+    rc = supervise_worker(script, [], heartbeat=None, max_restarts=3)
+    assert rc == 0
+    assert os.path.exists(flag)
+
+
+# --------------------------------------------------- worker end-to-end
+
+
+@pytest.mark.slow
+def test_worker_subprocess_kill_resume_via_supervisor(tmp_path):
+    """Full supervised generation cycle with the real jax worker: kill -9
+    mid-run (via the deterministic env injector), supervisor restarts,
+    worker RESUMEs from the chain and completes."""
+    ck = str(tmp_path / "ck")
+    hb = str(tmp_path / "t.hb")
+    argv = [sys.executable, "-m", "deeprec_tpu.online.loop", "--ckpt", ck,
+            "--steps", "24", "--save-every", "5", "--heartbeat", hb,
+            "--batch-size", "96"]
+    env = {"PYTHONPATH": os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "JAX_PLATFORMS": "cpu",
+        faults.KILL_STEP_ENV: "12"}
+    spec = ProcessSpec(
+        name="trainer", argv=argv, heartbeat_path=hb, lease_secs=60,
+        grace_secs=120, max_restarts=3, backoff_base_secs=0.2,
+        env=env, stdout=str(tmp_path / "trainer.log"),
+        # the restarted generation must NOT re-arm the kill
+        on_rescale=None,
+    )
+    # Drop the kill env for respawns by mutating argv factory instead:
+    spec.env = dict(env)
+    sup = Supervisor([spec], poll_secs=0.2, on_event=lambda m: None)
+    # first generation dies at step 12; scrub the injector before respawn
+    orig_spawn = sup._spawn
+
+    def spawn(s):
+        orig_spawn(s)
+        s.env.pop(faults.KILL_STEP_ENV, None)
+
+    sup._spawn = spawn
+    sup.start()
+    try:
+        assert _wait(lambda: sup.stats()["trainer"]["done"], timeout=300)
+        st = sup.stats()["trainer"]
+        assert st["restarts"] == 1
+        log = open(tmp_path / "trainer.log").read().splitlines()
+        assert any(l.startswith("RESUMED") for l in log)
+        assert log[-1] == "DONE"
+        from deeprec_tpu.training.checkpoint import CheckpointManager
+
+        restored = CheckpointManager(ck, _mk_trainer()[0]).restore()
+        assert int(restored.step) == 24
+    finally:
+        sup.stop()

@@ -201,11 +201,24 @@ def test_parts_multi_writer_simulation(tmp_path, trained8):
         np.savez(base + "00000.npz", **halves[0])
         np.savez(base + "00001.npz", **halves[1])
 
-    # A real 2-process save records parts=2; restore validates the count.
+    # A real 2-process save records parts=2 and process 0's manifest
+    # digests cover ITS OWN part files (the rewritten part00000); restore
+    # validates both. Recompute the digests the simulated writer would
+    # have recorded — stale ones would (correctly) quarantine the dir.
+    from deeprec_tpu.training.checkpoint import _array_digest
+
     mf_path = os.path.join(path, "manifest.json")
     with open(mf_path) as f:
         mf = json.load(f)
     mf["parts"] = 2
+    for fname in list(mf.get("digests", {})):
+        if ".part" not in fname:
+            continue
+        fpath = os.path.join(path, fname)
+        with np.load(fpath) as z:
+            mf["digests"][fname] = {
+                k: _array_digest(z[k]) for k in z.files
+            }
     with open(mf_path, "w") as f:
         json.dump(mf, f)
 

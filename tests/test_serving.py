@@ -186,7 +186,10 @@ def test_http_server_end_to_end(tmp_path):
                 assert e.code == 400
                 err = json.loads(e.read())
                 assert "error" in err
-        assert call("/healthz") == "ok"
+        hz = call("/healthz")
+        assert hz["status"] == "ok"
+        assert hz["consecutive_poll_failures"] == 0
+        assert "staleness_seconds" in hz
     finally:
         http.stop()
         server.close()

@@ -1,0 +1,523 @@
+"""Train-to-serve freshness bench: the online-learning loop, measured
+end to end and under injected faults.
+
+Topology (one host, the CI shape of the DeepRec online story):
+
+    appender ──> stream.txt ──> FileStreamServer (broker, TCP)
+                                      │
+                            TCPStreamReader (offset resume)
+                                      │
+            trainer SUBPROCESS (online.loop worker, supervised:
+            heartbeat lease + restart budget) ── save_incremental_async
+                                      │
+                              checkpoint chain (checksummed)
+                                      │
+            ServeLoop (Predictor + ModelServer, poll thread) <── load gen
+
+Headline metric: **freshness lag** — the time from an example landing in
+the stream file to the FIRST prediction served by a model state that has
+trained on it (ingest -> consume -> train -> delta save -> poll ->
+verify -> replay -> warm -> swap -> serve). Batches map to steps exactly
+(B lines = 1 step, offsets are exactly-once across restarts), so step s
+is "reflected" once a request is answered by a snapshot whose train step
+>= s.
+
+Fault phases (each measured under sustained request load, each required
+to finish with ZERO failed serving requests):
+
+  * trainer_sigkill    — kill -9 the trainer; the supervisor restarts it
+                         and it resumes from the chain + stream offsets.
+  * corrupt_delta      — bit-flip a committed, not-yet-applied delta;
+                         serving must quarantine it and serve through;
+                         the trainer's next save self-heals (full).
+  * broker_disconnect  — take the TCP broker down and revive it; the
+                         reader reconnects with jittered backoff.
+
+Run:  python tools/bench_freshness.py [--seconds 20] [--rps 25]
+      [--out FRESHNESS_BENCH.json]
+      --smoke : short steady window + one trainer kill, asserts recovery
+                and zero failed requests (CI: cibuild/run_tests.sh).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+# Model/schema shared by the trainer worker (online.loop main) and the
+# in-process ServeLoop — must stay in lockstep with the worker defaults.
+NUM_CAT, NUM_DENSE, EMB_DIM, CAPACITY = 2, 2, 4, 1 << 12
+
+
+def build_model():
+    from deeprec_tpu.models import WDL
+
+    return WDL(emb_dim=EMB_DIM, capacity=CAPACITY, hidden=(16,),
+               num_cat=NUM_CAT, num_dense=NUM_DENSE)
+
+
+class LineGen:
+    """Deterministic Criteo-shaped TSV lines (label, I*, C*) the stream
+    broker serves and criteo_line_parser consumes."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+
+    def lines(self, n: int):
+        out = []
+        for _ in range(n):
+            label = int(self.rng.random() < 0.4)
+            dense = [f"{self.rng.lognormal(0.0, 1.0):.3f}"
+                     for _ in range(NUM_DENSE)]
+            cats = [f"tok{int(self.rng.integers(0, 400))}"
+                    for _ in range(NUM_CAT)]
+            out.append("\t".join([str(label)] + dense + cats))
+        return out
+
+
+class Ingestor(threading.Thread):
+    """Append `batch` lines to the stream file `per_sec` times a second,
+    recording (total_lines, t_monotonic) after each durable append —
+    the ingest-time side of the freshness ledger."""
+
+    def __init__(self, path: str, batch: int, per_sec: float):
+        super().__init__(daemon=True, name="ingestor")
+        self.path = path
+        self.batch = batch
+        self.period = 1.0 / per_sec
+        self.gen = LineGen()
+        self.marks = []  # [(total_lines, t)]
+        self.total = 0
+        self._stop = threading.Event()
+
+    def run(self):
+        nxt = time.monotonic()
+        while not self._stop.is_set():
+            data = "\n".join(self.gen.lines(self.batch)) + "\n"
+            with open(self.path, "a") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            self.total += self.batch
+            self.marks.append((self.total, time.monotonic()))
+            nxt += self.period
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                self._stop.wait(delay)
+
+    def stop(self):
+        self._stop.set()
+
+    def ingest_time_of_step(self, step: int, batch_size: int):
+        """When the LAST line of train step `step` hit the file (None if
+        not yet ingested)."""
+        need = step * batch_size
+        for total, t in self.marks:
+            if total >= need:
+                return t
+        return None
+
+    def first_step_after(self, t: float, batch_size: int):
+        """The first train step whose data was FULLY ingested after `t`
+        (the step recovery is measured against)."""
+        for total, tm in self.marks:
+            if tm > t:
+                return total // batch_size + (1 if total % batch_size else 0)
+        return None
+
+
+class VersionSampler(threading.Thread):
+    """Map published model versions to train steps + first-seen time.
+    Publishes are >= poll_secs apart, so 20 ms sampling misses none."""
+
+    def __init__(self, predictor):
+        super().__init__(daemon=True, name="version-sampler")
+        self.predictor = predictor
+        self.seen = {}  # version -> (step, t_first_seen)
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(0.02):
+            v = self.predictor.version
+            if v not in self.seen:
+                self.seen[v] = (self.predictor.step, time.monotonic())
+
+    def stop(self):
+        self._stop.set()
+
+
+class LoadGen(threading.Thread):
+    """Sustained request load against the ModelServer: `rps` requests/s
+    across `clients` paced threads; every response's (t_done, version)
+    lands in the ledger, every exception in `failures`."""
+
+    def __init__(self, serve, features, rps: float, clients: int = 2):
+        super().__init__(daemon=True, name="loadgen")
+        self.serve = serve
+        self.features = features
+        self.rps = rps
+        self.clients = clients
+        self.records = []  # [(t_done, version)]
+        self.failures = []  # [(t, repr(err))]
+        self._stop = threading.Event()
+
+    def _client(self, idx: int):
+        period = self.clients / self.rps
+        nxt = time.monotonic() + idx * period / self.clients
+        while not self._stop.is_set():
+            delay = nxt - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            nxt += period
+            try:
+                _, version = self.serve.request_versioned(
+                    self.features, timeout=30,
+                )
+                self.records.append((time.monotonic(), version))
+            except Exception as e:
+                self.failures.append((time.monotonic(), repr(e)))
+
+    def run(self):
+        threads = [
+            threading.Thread(target=self._client, args=(i,), daemon=True)
+            for i in range(self.clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def stop(self):
+        self._stop.set()
+
+    def failures_between(self, t0: float, t1: float):
+        return [f for f in self.failures if t0 <= f[0] <= t1]
+
+    def requests_between(self, t0: float, t1: float):
+        return [r for r in self.records if t0 <= r[0] <= t1]
+
+
+def first_served_at_or_after(records, version_steps, step: int):
+    """Earliest completion time of a request answered by a snapshot whose
+    train step >= `step` (None if never)."""
+    best = None
+    for t_done, v in records:
+        info = version_steps.get(v)
+        if info is None:
+            continue
+        if info[0] >= step and (best is None or t_done < best):
+            best = t_done
+    return best
+
+
+def wait_until(pred, timeout: float, poll: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    return None
+
+
+def lag_stats(ingestor, loadgen, sampler, batch_size, t0, t1):
+    """Freshness lag for every step fully ingested inside [t0, t1] that
+    was eventually reflected in a served prediction."""
+    lags = []
+    steps = 0
+    for total, t_in in ingestor.marks:
+        if not (t0 <= t_in <= t1) or total % batch_size:
+            continue
+        s = total // batch_size
+        steps += 1
+        t_served = first_served_at_or_after(
+            loadgen.records, sampler.seen, s)
+        if t_served is not None and t_served >= t_in:
+            lags.append(t_served - t_in)
+    if not lags:
+        return {"steps_ingested": steps, "steps_reflected": 0}
+    lags.sort()
+    return {
+        "steps_ingested": steps,
+        "steps_reflected": len(lags),
+        "p50_s": round(lags[len(lags) // 2], 3),
+        "p95_s": round(lags[min(len(lags) - 1, int(len(lags) * 0.95))], 3),
+        "max_s": round(lags[-1], 3),
+    }
+
+
+def measure_recovery(t_fault, ingestor, loadgen, sampler, batch_size,
+                     timeout):
+    """Time from fault injection to the first prediction served from a
+    model that trained on data ingested AFTER the fault."""
+    s_f = wait_until(
+        lambda: ingestor.first_step_after(t_fault, batch_size), 30)
+    if s_f is None:
+        return None
+    t_served = wait_until(
+        lambda: first_served_at_or_after(
+            loadgen.records, sampler.seen, s_f),
+        timeout,
+    )
+    return None if t_served is None else round(t_served - t_fault, 3)
+
+
+def run(args):
+    import signal
+
+    from deeprec_tpu.data.stream import FileStreamServer, criteo_line_parser
+    from deeprec_tpu.online import faults
+    from deeprec_tpu.online.loop import ServeLoop
+    from deeprec_tpu.online.supervisor import Heartbeat, ProcessSpec, Supervisor
+
+    tmp = tempfile.mkdtemp(prefix="freshness_")
+    stream = os.path.join(tmp, "stream.txt")
+    ckpt = os.path.join(tmp, "ckpt")
+    open(stream, "w").close()
+    broker = FileStreamServer(stream, follow=True, poll_secs=0.02).start()
+
+    B = args.batch_size
+    ingest = Ingestor(stream, B, args.ingest_batches_per_sec)
+    ingest.start()
+
+    hb_path = os.path.join(tmp, "trainer.hb")
+    spec = ProcessSpec(
+        name="trainer",
+        argv=[sys.executable, "-m", "deeprec_tpu.online.loop",
+              "--ckpt", ckpt, "--source", f"tcp://127.0.0.1:{broker.port}",
+              "--batch-size", str(B), "--save-every", str(args.save_every),
+              # cadence fulls far apart: the corrupt-delta phase must
+              # observe the ESCALATED self-heal full, not a scheduled one
+              # racing past the corruption
+              "--full-every", "40", "--steps", "1000000000",
+              "--heartbeat", hb_path, "--log-every", "0"],
+        heartbeat_path=hb_path,
+        lease_secs=args.lease_secs,
+        grace_secs=120,
+        max_restarts=5,
+        backoff_base_secs=0.2,
+        env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+        stdout=os.path.join(tmp, "trainer.log"),
+    )
+    sup = Supervisor([spec], poll_secs=0.2,
+                     on_event=lambda m: print(f"# {m}", flush=True))
+    sup.start()
+
+    result = {"protocol": {
+        "batch_size": B, "save_every": args.save_every,
+        "ingest_batches_per_sec": args.ingest_batches_per_sec,
+        "rps": args.rps, "poll_secs": args.poll_secs,
+        "smoke": bool(args.smoke), "platform": "cpu",
+    }}
+    failed = []
+    serve = None
+    try:
+        serve = ServeLoop(
+            build_model(), ckpt, poll_secs=args.poll_secs,
+            heartbeat=Heartbeat(os.path.join(tmp, "serve.hb")),
+            http_port=0, max_batch=64,
+            wait_for_checkpoint_secs=300,
+        )
+        parser = criteo_line_parser(NUM_DENSE, NUM_CAT)
+        req = parser(LineGen(seed=7).lines(4))
+        req.pop("label")
+        serve.warmup(req)
+        sampler = VersionSampler(serve.predictor)
+        sampler.start()
+        load = LoadGen(serve, req, rps=args.rps)
+        load.start()
+
+        # ------------------------------------------------ steady state
+        t0 = time.monotonic()
+        time.sleep(args.seconds)
+        t1 = time.monotonic()
+        # lag needs the tail of the window to be SERVED before scoring it
+        time.sleep(min(10.0, args.seconds))
+        steady = lag_stats(ingest, load, sampler, B, t0, t1)
+        reqs = load.requests_between(t0, t1)
+        steady["requests"] = len(reqs)
+        steady["rps"] = round(len(reqs) / (t1 - t0), 1)
+        steady["failed_requests"] = len(load.failures_between(t0, t1))
+        result["steady"] = steady
+        if steady.get("steps_reflected", 0) == 0:
+            failed.append("steady: no steps reflected in predictions")
+        if steady["failed_requests"]:
+            failed.append("steady: failed requests")
+        result["faults"] = {}
+
+        # ------------------------------------------- 1. trainer SIGKILL
+        tf = time.monotonic()
+        restarts0 = sup.stats()["trainer"]["restarts"]
+        assert sup.kill("trainer", signal.SIGKILL)
+        rec = measure_recovery(tf, ingest, load, sampler, B,
+                               timeout=args.recovery_timeout)
+        te = time.monotonic()
+        phase = {
+            "recovery_s": rec,
+            "failed_requests": len(load.failures_between(tf, te)),
+            "supervisor_restarts":
+                sup.stats()["trainer"]["restarts"] - restarts0,
+        }
+        result["faults"]["trainer_sigkill"] = phase
+        if rec is None:
+            failed.append("trainer_sigkill: no recovery")
+        if phase["failed_requests"]:
+            failed.append("trainer_sigkill: failed requests")
+
+        if not args.smoke:
+            # -------------------------------------- 2. corrupt delta
+            serve.pause()
+            time.sleep(2 * args.poll_secs + 0.2)  # drain in-flight poll
+
+            def fresh_delta():
+                applied = set(serve.predictor._applied)
+                try:
+                    names = os.listdir(ckpt)
+                except OSError:
+                    return None
+                cands = [
+                    d for d in names
+                    if d.startswith("incr-") and "." not in d
+                    and d not in applied
+                    and os.path.exists(os.path.join(ckpt, d,
+                                                    "manifest.json"))
+                ]
+                return max(cands, key=lambda d: int(d.split("-")[1])) \
+                    if cands else None
+
+            delta = wait_until(fresh_delta, 60)
+            assert delta, "trainer produced no fresh delta to corrupt"
+            tf = time.monotonic()
+            q0 = serve.health()["quarantined"]
+            corrupted = faults.corrupt_latest_delta(ckpt, mode="bitflip")
+            serve.resume()
+            try:
+                serve.poll_now()  # synchronous detection: quarantine NOW
+            except Exception:
+                pass
+            saw_q = wait_until(
+                lambda: serve.health()["quarantined"] > q0, 60)
+            rec = measure_recovery(tf, ingest, load, sampler, B,
+                                   timeout=args.recovery_timeout)
+            te = time.monotonic()
+            healed = wait_until(
+                lambda: any(
+                    d.startswith("full-")
+                    and int(d.split("-")[1]) > int(delta.split("-")[1])
+                    for d in os.listdir(ckpt) if "." not in d
+                ),
+                30,
+            )
+            phase = {
+                "corrupted": corrupted and os.path.basename(
+                    os.path.dirname(corrupted)),
+                "quarantined": bool(saw_q),
+                "self_healed_full": bool(healed),
+                "recovery_s": rec,
+                "failed_requests": len(load.failures_between(tf, te)),
+            }
+            result["faults"]["corrupt_delta"] = phase
+            if not saw_q:
+                failed.append("corrupt_delta: no quarantine")
+            if rec is None:
+                failed.append("corrupt_delta: no recovery")
+            if phase["failed_requests"]:
+                failed.append("corrupt_delta: failed requests")
+
+            # ---------------------------------- 3. broker disconnect
+            outage = faults.BrokerOutage(broker)
+            hb0 = Heartbeat.read(hb_path) or {}
+            restarts_pre = sup.stats()["trainer"]["restarts"]
+            tf = time.monotonic()
+            outage.down()
+            time.sleep(args.outage_secs)
+            broker = outage.up()
+            rec = measure_recovery(tf, ingest, load, sampler, B,
+                                   timeout=args.recovery_timeout)
+            te = time.monotonic()
+            hb1 = Heartbeat.read(hb_path) or {}
+            phase = {
+                "outage_s": args.outage_secs,
+                "recovery_s": rec,
+                "failed_requests": len(load.failures_between(tf, te)),
+                "stream_reconnects_delta":
+                    hb1.get("stream_reconnects", 0)
+                    - hb0.get("stream_reconnects", 0),
+                "trainer_restarts_during":
+                    sup.stats()["trainer"]["restarts"] - restarts_pre,
+            }
+            result["faults"]["broker_disconnect"] = phase
+            if rec is None:
+                failed.append("broker_disconnect: no recovery")
+            if phase["failed_requests"]:
+                failed.append("broker_disconnect: failed requests")
+
+        load.stop()
+        sampler.stop()
+        result["serving_health"] = serve.health()
+        result["supervisor"] = sup.stats()["trainer"]
+        result["total_failed_requests"] = len(load.failures)
+        result["trainer_heartbeat"] = Heartbeat.read(hb_path)
+    finally:
+        ingest.stop()
+        sup.stop()
+        if serve is not None:
+            serve.close()
+        try:
+            broker.stop()
+        except Exception:
+            pass
+    result["ok"] = not failed
+    if failed:
+        result["failures"] = failed
+    return result, failed, tmp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seconds", type=float, default=20.0,
+                   help="steady-state measurement window")
+    p.add_argument("--rps", type=float, default=25.0)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--save-every", type=int, default=8)
+    p.add_argument("--ingest-batches-per-sec", type=float, default=4.0)
+    p.add_argument("--poll-secs", type=float, default=0.25)
+    p.add_argument("--lease-secs", type=float, default=30.0)
+    p.add_argument("--outage-secs", type=float, default=6.0)
+    p.add_argument("--recovery-timeout", type=float, default=180.0)
+    p.add_argument("--out", default=None,
+                   help="write the result JSON here (default: "
+                        "FRESHNESS_BENCH.json for full runs, none for "
+                        "--smoke)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI: short steady window + one trainer kill; "
+                        "asserts recovery and zero failed requests")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.seconds = min(args.seconds, 8.0)
+        args.rps = min(args.rps, 15.0)
+
+    result, failed, tmp = run(args)
+    print(json.dumps(result))
+    out = args.out or (None if args.smoke else
+                       os.path.join(REPO, "FRESHNESS_BENCH.json"))
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"# wrote {out}", file=sys.stderr)
+    if failed:
+        print(f"# FAILED: {failed}\n# artifacts: {tmp}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -40,7 +40,7 @@ def shrink_table(path: str, out_path: str, min_freq: int, min_version: int):
         # must pass through untouched.
         out[k] = v[keep] if is_per_row(k) else v
     np.savez(out_path, **out)
-    return n, int(keep.sum())
+    return n, int(keep.sum()), out
 
 
 def main(argv=None):
@@ -51,19 +51,35 @@ def main(argv=None):
     p.add_argument("--out", default="", help="output dir (default: <ckpt>-shrunk)")
     args = p.parse_args(argv)
 
+    from deeprec_tpu.training.checkpoint import _array_digest
+
     out_dir = args.out or args.ckpt.rstrip("/") + "-shrunk"
     os.makedirs(out_dir, exist_ok=True)
     total_before = total_after = 0
+    new_digests = {}
     for f in sorted(os.listdir(args.ckpt)):
         src = os.path.join(args.ckpt, f)
         dst = os.path.join(out_dir, f)
         if f.startswith("table_") and f.endswith(".npz"):
-            b, a = shrink_table(src, dst, args.min_freq, args.min_version)
+            b, a, arrays = shrink_table(src, dst, args.min_freq,
+                                        args.min_version)
+            new_digests[f] = {k: _array_digest(v) for k, v in arrays.items()}
             total_before += b
             total_after += a
             print(f"{f}: {b} -> {a} rows")
         else:
             shutil.copy(src, dst)
+    # Re-stamp the manifest digests for the rewritten table files — the
+    # copied originals describe pre-shrink bytes and chain verification
+    # would (correctly) quarantine the shrunk dir over them.
+    mf_path = os.path.join(out_dir, "manifest.json")
+    if os.path.exists(mf_path):
+        with open(mf_path) as fh:
+            mf = json.load(fh)
+        if "digests" in mf:
+            mf["digests"].update(new_digests)
+            with open(mf_path, "w") as fh:
+                json.dump(mf, fh)
     print(f"total: {total_before} -> {total_after} rows "
           f"({out_dir})")
 
