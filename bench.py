@@ -282,6 +282,195 @@ def _traffic_report(trainer, budget_mode, dedup_stats):
     }
 
 
+def _placement_workload():
+    """Skew-aware placement bench (round 12): measured per-shard
+    exchange-bytes imbalance, uniform hash vs the adopted ShardPlan, on a
+    skewed multi-table 8-shard workload.
+
+    Runs in its OWN subprocess (stdout = one JSON line) because it needs
+    the virtual 8-device CPU mesh — forcing 8 host devices in the main
+    bench process would change the headline single-device measurement.
+
+    Workload: 4 single-hot tables with heterogeneous dims (64/48/16/8 —
+    per-table row bytes are a placer input, ops/traffic.py
+    exchange_row_bytes) drawing per-table bounded-zipf ids from ONE shared
+    raw id space (`SyntheticCriteo(offset_ids=False)`): every table's head
+    is the same raw ids, so under `hash_shard` they hammer the same owner
+    shards — the correlated-head case the plan's owner-offset rotation +
+    hot-key re-routing flattens. Protocol: prefill window under uniform
+    routing (fills the freq/owner counters), measure imbalance_before +
+    uniform step time; `update_placement` adopts the plan (mode="uniform"
+    skips adoption — the comparison arm); measure imbalance_after + plan
+    step time on the SAME batch sequence. `tools/roofline.py
+    --assert-imbalance` gates the ratio and the step-time bound in CI."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.features import DenseFeature, SparseFeature
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+
+    mode = os.environ.get("BENCH_PLACEMENT", "grid")
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    N = 8
+    ZIPF = [2.6, 2.4, 2.2, 2.0]
+    DIMS = [64, 48, 16, 8]
+    T_TABLES = len(ZIPF)
+    B = 128
+    n_batches = 8 if smoke else 12
+    reps = 2 if smoke else 3
+
+    class SkewModel:
+        features = [
+            SparseFeature(
+                f"C{i+1}",
+                table=TableConfig(
+                    name=f"C{i+1}", dim=DIMS[i], capacity=1 << 13
+                ),
+            )
+            for i in range(T_TABLES)
+        ] + [DenseFeature("I1", 1), DenseFeature("I2", 1)]
+
+        def init(self, key):
+            return {
+                "w": jax.random.normal(key, (sum(DIMS) + 2,)) * 0.05
+            }
+
+        def apply(self, dense, inputs, train):
+            x = jnp.concatenate(
+                [inputs.pooled[f"C{i+1}"] for i in range(T_TABLES)]
+                + [inputs.dense["I1"], inputs.dense["I2"]],
+                -1,
+            )
+            return x @ dense["w"]
+
+    mesh = make_mesh(N)
+    gen = SyntheticCriteo(
+        batch_size=B, num_cat=T_TABLES, num_dense=2, vocab=200_000,
+        seed=7, zipf_a=ZIPF, offset_ids=False,
+    )
+    sb = [
+        shard_batch(mesh, {k: jnp.asarray(v) for k, v in gen.batch().items()})
+        for _ in range(n_batches)
+    ]
+    tr = ShardedTrainer(
+        SkewModel(), Adagrad(lr=0.1), mesh=mesh, placement="plan"
+    )
+    st = tr.init(0)
+
+    def per_shard_bytes(state):
+        per = np.zeros(N)
+        for _, d in tr.dedup_stats(state).items():
+            ps = d.get("per_shard")
+            if ps:
+                per += np.asarray(ps["exchange_bytes"])
+        return per
+
+    def window(state):
+        """One timed pass over the batch sequence (counters accumulate)."""
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            state, mets = tr.train_step(state, sb[i])
+        jax.block_until_ready(mets["loss"])
+        return state, (time.perf_counter() - t0) / n_batches * 1e3
+
+    def measure(state):
+        """Reset the owner counters, run `reps` timed windows; imbalance
+        comes off the counters the windows accumulated."""
+        state, _ = tr.update_budgets(state)
+        times = []
+        for _ in range(reps):
+            state, ms = window(state)
+            times.append(ms)
+        per = per_shard_bytes(state)
+        from deeprec_tpu.ops import traffic as T
+
+        return state, T.shard_imbalance(per), per, round(min(times), 3)
+
+    # Prefill: populate tables + freq counters (and compile) under the
+    # uniform default plan, then measure the uniform arm.
+    st, _ = window(st)
+    st, imb_before, per_before, ms_uniform = measure(st)
+
+    report = {
+        "mode": mode,
+        "device": jax.devices()[0].platform,
+        "num_shards": N,
+        "num_tables": T_TABLES,
+        "zipf": ZIPF,
+        "dims": DIMS,
+        "batch": B,
+        "imbalance_before": round(imb_before, 4),
+        "step_ms": {"uniform": ms_uniform},
+        "per_shard_exchange_bytes": {
+            "uniform": [round(float(x)) for x in per_before]
+        },
+    }
+    if mode != "uniform":
+        st, plan_rep = tr.update_placement(st)
+        adopted = [b for b, r in plan_rep.items() if r.get("adopted")]
+        st, imb_after, per_after, ms_plan = measure(st)
+        report.update({
+            "imbalance_after": round(imb_after, 4),
+            "imbalance_ratio": round(imb_before / max(imb_after, 1e-9), 3),
+            "adopted_bundles": adopted,
+            "moved_rows": sum(
+                r.get("moved", 0) for r in plan_rep.values()
+            ),
+            "hot_keys": (tr.last_placement or {}).get("hot_keys"),
+            "modeled": {
+                "imbalance_before":
+                    (tr.last_placement or {}).get("imbalance_current"),
+                "imbalance_after":
+                    (tr.last_placement or {}).get("imbalance_candidate"),
+            },
+        })
+        report["step_ms"]["plan"] = ms_plan
+        report["per_shard_exchange_bytes"]["plan"] = [
+            round(float(x)) for x in per_after
+        ]
+    print(json.dumps(report))
+
+
+def _run_placement_worker():
+    """Spawn _placement_workload on a forced 8-device CPU mesh; returns
+    its JSON section (or an error record — the bench JSON stays usable)."""
+    env = dict(os.environ)
+    env.pop("BENCH_WORKER", None)
+    env["BENCH_PLACEMENT_WORKER"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Force EXACTLY 8 virtual devices: an inherited count (a 1- or
+    # 4-device flag from some other arm's environment) would fail
+    # make_mesh(8) in the worker, so any existing token is replaced, not
+    # respected.
+    flags = [
+        t for t in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in t
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=1200, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "placement workload timed out"}
+    if r.returncode != 0:
+        return {"error": "placement workload rc=%d: %s" % (
+            r.returncode, _error_line(r.stderr or ""))}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": "placement workload produced no JSON"}
+
+
 def _ckpt_report():
     """Host-choreography stall accounting (round 9): what a checkpoint /
     multi-tier sync costs the TRAINING THREAD, sync vs async, plus the
@@ -610,6 +799,15 @@ def workload():
         if pipeline_arg != "off"
         else (None, None)
     )
+    # Skew-aware placement arm (round 12): measured per-shard exchange
+    # imbalance uniform-hash vs ShardPlan on the 8-shard skewed multi-table
+    # workload (own subprocess — needs the virtual mesh). Gated in CI by
+    # tools/roofline.py --assert-imbalance.
+    placement = (
+        _run_placement_worker()
+        if os.environ.get("BENCH_PLACEMENT", "off") != "off"
+        else None
+    )
     # --profile reuses the phase breakdown the pipeline report already
     # measured instead of running the (multi-second) protocol twice.
     phases = (
@@ -669,6 +867,11 @@ def workload():
                 # model and its efficiency vs measurement — gated by
                 # tools/roofline.py --assert-overlap in CI smoke.
                 **({"pipeline": pipeline} if pipeline else {}),
+                # Skew-aware placement (round 12): measured per-shard
+                # exchange-bytes imbalance before (uniform hash) and after
+                # (adopted ShardPlan) + step time per arm — gated by
+                # tools/roofline.py --assert-imbalance in CI smoke.
+                **({"placement": placement} if placement else {}),
                 **({"phases": phases} if phases else {}),
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
@@ -708,6 +911,17 @@ def main():
                         "(chunked only differs on sharded exchanges — see "
                         "tools/bench_async.py); a single mode measures "
                         "just off + that arm; 'off' skips the section")
+    p.add_argument("--placement", nargs="?", const="grid",
+                   default=os.environ.get("BENCH_PLACEMENT", "off"),
+                   choices=["off", "uniform", "plan", "grid"],
+                   help="skew-aware placement arm on the 8-shard skewed "
+                        "multi-table workload (own subprocess): 'grid' "
+                        "(bare --placement) measures uniform-hash AND the "
+                        "adopted ShardPlan (imbalance before/after + step "
+                        "time, JSON 'placement'); 'uniform' measures only "
+                        "the hash baseline; 'plan' is an alias of grid "
+                        "(the plan arm needs the uniform window first); "
+                        "'off' (default) skips the section")
     p.add_argument("--profile", action="store_true",
                    help="add a per-phase step breakdown (lookup / sparse "
                         "apply / dense+overhead, training/profiler.py) to "
@@ -727,6 +941,7 @@ def main():
     os.environ["BENCH_TIMED_STEPS"] = str(args.timed_steps)
     os.environ["BENCH_UNIQUE_BUDGET"] = str(args.unique_budget)
     os.environ["BENCH_PIPELINE"] = str(args.pipeline_mode)
+    os.environ["BENCH_PLACEMENT"] = str(args.placement)
     if args.profile:
         os.environ["BENCH_PROFILE"] = "1"
     if args.smoke:
@@ -765,7 +980,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_WORKER") == "1":
+    if os.environ.get("BENCH_PLACEMENT_WORKER") == "1":
+        _placement_workload()
+    elif os.environ.get("BENCH_WORKER") == "1":
         workload()
     else:
         main()

@@ -47,7 +47,7 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke
 
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
-    BENCH_PIPELINE=grid python bench.py \
+    BENCH_PIPELINE=grid python bench.py --placement --smoke \
     | tee /tmp/deeprec_bench_smoke.out
 tail -n 1 /tmp/deeprec_bench_smoke.out > /tmp/deeprec_bench_smoke.json
 
@@ -58,6 +58,10 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== in-step pipelining grid vs overlap model (regression fails the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-overlap /tmp/deeprec_bench_smoke.json
+
+echo "== skew-aware placement vs uniform hash (imbalance gate fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-imbalance /tmp/deeprec_bench_smoke.json
 
 echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \

@@ -29,7 +29,13 @@ def zipf_ids(rng: np.random.Generator, vocab: int, a: float, shape):
 
 class SyntheticCriteo:
     """Batches shaped like Criteo: I1-I13 floats [B,1], C1-C26 int ids [B],
-    label [B]."""
+    label [B].
+
+    `zipf_a` is either ONE exponent covering every categorical column
+    (legacy, bit-identical draw stream) or a per-table sequence of
+    `num_cat` exponents — real workloads have wide variance in per-table
+    skew/unique fractions (ROADMAP), and the placement bench needs tables
+    whose heads differ to show hot-key balancing."""
 
     def __init__(
         self,
@@ -37,15 +43,31 @@ class SyntheticCriteo:
         num_cat: int = 26,
         num_dense: int = 13,
         vocab: int = 100_000,
-        zipf_a: float = 1.2,
+        zipf_a=1.2,
         seed: int = 0,
         dtype=np.int32,
+        offset_ids: bool = True,
     ):
         self.B = batch_size
         self.num_cat = num_cat
         self.num_dense = num_dense
         self.vocab = vocab
         self.zipf_a = zipf_a
+        if np.ndim(zipf_a) != 0:
+            if len(zipf_a) != num_cat:
+                raise ValueError(
+                    f"per-table zipf_a needs {num_cat} exponents, "
+                    f"got {len(zipf_a)}"
+                )
+            self._zipf_per_table = np.asarray(zipf_a, np.float64)
+        else:
+            self._zipf_per_table = None
+        # offset_ids=False keeps every column in ONE raw id space (hashed
+        # shared-vocab features): each table's zipf head is the SAME raw
+        # ids, so under uniform hash_shard every table hammers the same
+        # owner shards — the correlated-head case the placement plan's
+        # owner-offset rotation exists for.
+        self.offset_ids = offset_ids
         self.rng = np.random.default_rng(seed)
         self.dtype = dtype
         # hidden ground-truth weights giving the label structure
@@ -56,8 +78,19 @@ class SyntheticCriteo:
     def _zipf_ids(self, shape):
         return zipf_ids(self.rng, self.vocab, self.zipf_a, shape)
 
+    def _cat_ids(self) -> np.ndarray:
+        """[num_cat, B] categorical draw: one shared-exponent call on the
+        legacy scalar path (stream-identical to before per-table knobs
+        existed), else one bounded-zipf draw per column at its own a."""
+        if self._zipf_per_table is None:
+            return self._zipf_ids((self.num_cat, self.B))
+        return np.stack([
+            zipf_ids(self.rng, self.vocab, float(a), (self.B,))
+            for a in self._zipf_per_table
+        ])
+
     def batch(self) -> Dict[str, np.ndarray]:
-        cats = self._zipf_ids((self.num_cat, self.B))
+        cats = self._cat_ids()
         dense = self.rng.lognormal(0.0, 1.0, size=(self.B, self.num_dense)).astype(
             np.float32
         )
@@ -72,7 +105,9 @@ class SyntheticCriteo:
             out[f"I{i+1}"] = dense[:, i : i + 1]
         for c in range(self.num_cat):
             # offset ids per-feature so tables see disjoint key spaces
-            out[f"C{c+1}"] = (cats[c] + c * self.vocab).astype(self.dtype)
+            # (offset_ids=False: shared raw space, correlated zipf heads)
+            off = c * self.vocab if self.offset_ids else 0
+            out[f"C{c+1}"] = (cats[c] + off).astype(self.dtype)
         return out
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

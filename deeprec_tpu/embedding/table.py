@@ -128,6 +128,21 @@ class TableState:
     dedup_ids: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((), jnp.int32)
     )
+    # Owner-side exchange-load telemetry (sharded train lookups only —
+    # ShardedTable.resolve; single-device tables never move these). Same
+    # transient int32-scalar contract as the dedup counters; reset by
+    # Trainer.update_budgets. Per mesh position (the leading shard axis of
+    # a sharded TrainState), these expose the exchange imbalance the
+    # placement plan (parallel/placement.py) flattens:
+    #   owner_arrivals — exchanged rows this shard owned/served (a key
+    #                    present on k source shards counts k)
+    #   owner_unique   — distinct keys those arrivals deduped to
+    owner_arrivals: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
+    owner_unique: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
 
     @property
     def capacity(self) -> int:

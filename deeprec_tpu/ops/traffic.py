@@ -29,6 +29,43 @@ from typing import Dict, Optional, Sequence, Tuple
 META_COLS = 3  # freq / version / dirty, int32 each (embedding/table.py)
 
 
+# ----------------------------------------------------------- imbalance model
+#
+# The wire terms below model the MEAN per-device exchange payload; under a
+# uniform hash and zipf traffic the max shard does a multiple of that, and
+# after the in-step pipelining PR the exchange straggler is exactly what
+# bounds step time. These two helpers are the shared vocabulary between the
+# placement cost model (parallel/placement.py), the live owner counters
+# (Trainer.dedup_stats per_shard) and the bench/CI gate
+# (`bench.py --placement`, `roofline.py --assert-imbalance`): everyone
+# reports load as exchange bytes and skew as max/mean of that.
+
+
+def exchange_row_bytes(
+    *, dim: int, wire_bytes: int = 4, key_bytes: int = 4
+) -> float:
+    """Wire bytes ONE exchanged row costs its owner shard per step:
+    embedding down + grad up at the wire dtype, plus the id + count int32
+    ride-along. This is the per-arrival weight of the placement cost
+    model and of the per-shard `exchange_bytes` telemetry."""
+    return float(2 * dim * wire_bytes + key_bytes + 4)
+
+
+def shard_imbalance(loads) -> float:
+    """max/mean of a per-shard load vector — 1.0 is perfectly balanced,
+    N is everything-on-one-shard. Defined as 1.0 for empty/zero loads
+    (nothing exchanged is not skewed)."""
+    import numpy as np
+
+    l = np.asarray(loads, dtype=np.float64)
+    if l.size == 0:
+        return 1.0
+    mean = float(l.mean())
+    if mean <= 0.0:
+        return 1.0
+    return float(l.max()) / mean
+
+
 # --------------------------------------------------------------- bytes model
 
 
@@ -45,6 +82,7 @@ def table_step_traffic(
     comm: Optional[str] = None,
     wire_bytes: int = 4,
     a2a_slack: float = 2.0,
+    imbalance: float = 1.0,
 ) -> Dict[str, float]:
     """Per-table per-step traffic of the embedding engine.
 
@@ -58,6 +96,12 @@ def table_step_traffic(
     payload of the `comm` exchange ("allgather" | "a2a") at `wire_bytes`
     per value/grad element (4 = fp32, 2 = bf16; ids/counts always ride
     int32).
+
+    `imbalance` is the max/mean per-shard owner-load skew
+    (`shard_imbalance`): wire_bytes stays the MEAN payload, and a
+    "wire_bytes_max_shard" entry models the straggler shard that actually
+    bounds the exchange (mean x imbalance) — the quantity the placement
+    plan flattens.
     """
     U, D, vb, kb = unique, dim, value_bytes, key_bytes
     slot_b = sum(w * 4 for w in slot_widths)
@@ -104,6 +148,7 @@ def table_step_traffic(
     return {
         "hbm_bytes": float(hbm),
         "wire_bytes": float(wire),
+        "wire_bytes_max_shard": float(wire) * max(1.0, float(imbalance)),
         "total_bytes": float(hbm + wire),
     }
 

@@ -1,0 +1,449 @@
+"""Skew-aware table placement for the sharded embedding exchange.
+
+Uniform `hash_shard(id) % N` routing makes one shard the straggler of every
+a2a/allgather under zipf traffic: the head of the distribution hammers its
+hash-home, and small tables all park their head rows on the same shards.
+DreamShard (PAPERS.md: "Generalizable Embedding Table Placement for
+Recommender Systems") treats placement as a first-class cost-model
+optimization and the RecShard line shows hot-key-aware partitioning is the
+lever for zipf traffic; this module is that idea for the compiled-collective
+exchange:
+
+  * **`ShardPlan`** — per (member) table: an owner-offset rotation
+    (`owner = (hash_shard(id) + offset) % N`, decorrelating tables that
+    share a raw id space) plus a device-resident `[H]` hot-key routing
+    table consulted BEFORE the hash (`plan_owner`): the top-H head keys
+    get explicit greedily-balanced owners instead of their hash-home.
+  * **Cost-model placer** (`build_plans`) — estimates each key's per-step
+    exchange arrivals from the live freq counters (`TableState.meta`), the
+    per-row wire bytes from `ops/traffic.py`, and greedily assigns offsets
+    (best-rotation per table, heaviest table first) and hot-key owners
+    (longest-processing-time to the least-loaded shard) to minimize the
+    max-shard exchange load.
+  * **Re-shard on plan change** (`reshard_members`) — rows whose owner
+    moves migrate host-side through the same probe/pack machinery as
+    rebuild/restore, bit-identically (placement changes WHERE a row lives,
+    never its values), applied at a step boundary with the old plan
+    serving until the swap (`ShardedTrainer.update_placement`).
+
+Correctness contract: any single-owner routing yields bit-identical
+training per key. Each source shard contributes at most one arrival per
+key (local dedup precedes the exchange), arrivals land source-major in
+both the allgather and a2a layouts, so a key's gradient contributions
+sum in source-shard order under EVERY plan — the per-key optimizer math
+cannot observe the placement. `tests/test_placement.py` pins this across
+comm modes, the K-step scan and the pipelined lookahead.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeprec_tpu.utils import hashing
+
+
+# ------------------------------------------------------------- device route
+
+
+def plan_owner(ids: jnp.ndarray, num_shards: int, leaves=None) -> jnp.ndarray:
+    """Owner shard of each id under a placement plan (device-side).
+
+    `leaves` is the plan's device-constant dict ({} / None = uniform hash,
+    compiling the identical program as before the plan subsystem existed):
+      offset     []   int32  owner rotation
+      hot_keys   [H]  key-dtype, sentinel-padded routing table
+      hot_owners [H]  int32 explicit owners for the hot keys
+
+    Consulted before `hash_shard`: hot keys take their table entry, every
+    other id its rotated hash-home. Must stay bit-identical to
+    `ShardPlan.owner_np` — checkpoint restore and plan migration route on
+    the host with the same function.
+    """
+    base = hashing.hash_shard(ids, num_shards)
+    if not leaves:
+        return base
+    owner = (base + jnp.asarray(leaves["offset"], jnp.int32)) % num_shards
+    hk = leaves["hot_keys"]
+    if hk.shape[-1]:
+        eq = ids[:, None] == hk.astype(ids.dtype)[None, :]
+        hot = jnp.any(eq, axis=1)
+        hix = jnp.argmax(eq, axis=1)
+        owner = jnp.where(
+            hot, leaves["hot_owners"][hix].astype(jnp.int32), owner
+        )
+    return owner.astype(jnp.int32)
+
+
+# --------------------------------------------------------------- plan types
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Routing plan of ONE (member) table over `num_shards` shards.
+
+    `hot_keys` must be unique real keys (never the sentinel); `sentinel`
+    pads the device-side routing table out to the bundle's common H. The
+    default plan (offset 0, no hot keys) routes exactly like the uniform
+    hash."""
+
+    num_shards: int
+    sentinel: int
+    offset: int = 0
+    hot_keys: Tuple[int, ...] = ()
+    hot_owners: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        assert len(self.hot_keys) == len(self.hot_owners)
+        assert len(set(self.hot_keys)) == len(self.hot_keys), (
+            "hot_keys must be unique (duplicate entries would make the "
+            "device argmax and the host searchsorted disagree)"
+        )
+
+    @property
+    def is_uniform(self) -> bool:
+        return self.offset == 0 and not self.hot_keys
+
+    def owner_np(self, keys) -> np.ndarray:
+        """Host mirror of `plan_owner` (bit-identical): used by the
+        checkpoint restore router and the migration path."""
+        keys = np.asarray(keys)
+        owner = (
+            (hashing.hash_shard_np(keys, self.num_shards) + self.offset)
+            % self.num_shards
+        ).astype(np.int32)
+        if self.hot_keys:
+            hk = np.asarray(self.hot_keys, dtype=keys.dtype)
+            ho = np.asarray(self.hot_owners, np.int32)
+            order = np.argsort(hk, kind="stable")
+            pos = np.clip(
+                np.searchsorted(hk[order], keys), 0, len(order) - 1
+            )
+            cand = order[pos]
+            hit = hk[cand] == keys
+            owner = np.where(hit, ho[cand], owner).astype(np.int32)
+        return owner
+
+    def leaves(self, key_dtype, pad_h: Optional[int] = None) -> Dict:
+        """Device constants for `plan_owner`, hot arrays sentinel-padded
+        to `pad_h` (stacked bundles need one common H across members)."""
+        H = len(self.hot_keys) if pad_h is None else pad_h
+        hk = np.full((H,), self.sentinel, dtype=key_dtype)
+        ho = np.zeros((H,), np.int32)
+        if self.hot_keys:
+            hk[: len(self.hot_keys)] = np.asarray(
+                self.hot_keys, dtype=key_dtype
+            )
+            ho[: len(self.hot_owners)] = np.asarray(
+                self.hot_owners, np.int32
+            )
+        return {
+            "offset": jnp.asarray(self.offset, jnp.int32),
+            "hot_keys": jnp.asarray(hk),
+            "hot_owners": jnp.asarray(ho),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class BundlePlan:
+    """Per-member ShardPlans of one bundle (len T for stacked bundles,
+    len 1 otherwise — shared-table bundles route every feature through
+    the single member plan)."""
+
+    plans: Tuple[ShardPlan, ...]
+
+    def member(self, m: Optional[int]) -> ShardPlan:
+        return self.plans[m or 0]
+
+    @property
+    def is_uniform(self) -> bool:
+        return all(p.is_uniform for p in self.plans)
+
+    def leaves(self, key_dtype, stacked: bool) -> Dict:
+        """vmap-ready device constants: stacked bundles get a leading [T]
+        member axis on every leaf (the lookup vmap maps over it), single
+        tables the bare per-member leaves."""
+        H = max((len(p.hot_keys) for p in self.plans), default=0)
+        per = [p.leaves(key_dtype, pad_h=H) for p in self.plans]
+        if not stacked:
+            return per[0]
+        return {
+            k: jnp.stack([leaf[k] for leaf in per]) for k in per[0]
+        }
+
+
+# -------------------------------------------------------------- cost model
+
+
+def modeled_loads(
+    num_shards: int,
+    members: Sequence["MemberTraffic"],
+    plans: Optional[Dict[Tuple[str, int], ShardPlan]] = None,
+) -> np.ndarray:
+    """Modeled per-shard exchange load (bytes/step) of a set of member
+    tables under `plans` (missing/None entries = uniform hash) — the
+    quantity `build_plans` minimizes the max of, and what
+    `update_placement` compares between the active and candidate plans."""
+    L = np.zeros((num_shards,), np.float64)
+    for m in members:
+        if len(m.keys) == 0:
+            continue
+        plan = (plans or {}).get((m.bundle, m.member))
+        owner = (
+            plan.owner_np(m.keys)
+            if plan is not None
+            else hashing.hash_shard_np(m.keys, num_shards)
+        )
+        L += np.bincount(
+            owner,
+            weights=m.weight.astype(np.float64) * m.row_bytes,
+            minlength=num_shards,
+        )
+    return L
+
+
+@dataclasses.dataclass
+class MemberTraffic:
+    """Placer input for one member table: its live keys, each key's
+    modeled exchange arrivals/step (min(freq/steps, N) — a key deduped on
+    every source shard arrives at most N times), and the wire bytes one
+    arrival row costs (`ops/traffic.py exchange_row_bytes`)."""
+
+    bundle: str
+    member: int
+    keys: np.ndarray  # [n] live keys
+    weight: np.ndarray  # [n] modeled arrivals per step
+    row_bytes: float
+    sentinel: int
+
+
+def build_plans(
+    num_shards: int,
+    members: Sequence[MemberTraffic],
+    *,
+    hot_budget: int = 64,
+    base_loads=None,
+) -> Tuple[Dict[Tuple[str, int], ShardPlan], Dict[str, object]]:
+    """Greedy cost-model placer: minimize the max-shard exchange load.
+
+    Two levers, applied heaviest-table-first against a running per-shard
+    load vector L:
+      1. **offset rotation** — each table's non-hot load lands at its
+         hash-home rotated by r; the r minimizing max(L + rot(load, r))
+         wins (this is what un-stacks tables sharing a raw id space,
+         whose heads otherwise all hash to the same shards);
+      2. **hot keys** — the top-`hot_budget` keys by modeled arrivals
+         (only those worth moving: weight > 1, i.e. present on more than
+         one source shard) are pulled out of the rotation and assigned
+         LPT: heaviest first, each to the currently least-loaded shard.
+
+    `base_loads` ([N], optional) is per-shard exchange load the placer
+    must pack AROUND but cannot move — tables whose plan is pinned
+    uniform (multi-tier storage keeps demoted rows in per-shard tier
+    stores that don't migrate, so their routing must not change).
+
+    Returns (plans keyed by (bundle, member), report) where the report
+    carries modeled per-shard loads and max/mean imbalance before (uniform
+    hash) and after (the plan) — `bench.py --placement` then measures the
+    same quantities from the live owner counters.
+    """
+    from deeprec_tpu.ops import traffic as T
+
+    N = num_shards
+    base = (
+        np.zeros((N,), np.float64) if base_loads is None
+        else np.asarray(base_loads, np.float64)
+    )
+    L = base.copy()
+    L_before = base.copy()
+    plans: Dict[Tuple[str, int], ShardPlan] = {}
+    hot_all: List[Tuple[float, int, Tuple[str, int]]] = []
+    hot_per: Dict[Tuple[str, int], List[Tuple[int, int]]] = {}
+    offsets: Dict[Tuple[str, int], int] = {}
+
+    order = sorted(
+        members,
+        key=lambda m: -float(np.sum(m.weight) * m.row_bytes),
+    )
+    for m in order:
+        ref = (m.bundle, m.member)
+        hot_per[ref] = []
+        n = len(m.keys)
+        if n == 0:
+            offsets[ref] = 0
+            continue
+        base = hashing.hash_shard_np(m.keys, N)
+        load = m.weight.astype(np.float64) * m.row_bytes
+        L_before += np.bincount(base, weights=load, minlength=N)
+        # Hot split: top-H by modeled arrivals, but only keys that arrive
+        # from MORE than one shard — a weight<=1 key is already as cheap
+        # as routing can make it, and spending routing-table slots on it
+        # crowds out real head keys (the H-exceeded fallback contract).
+        by_w = np.argsort(-m.weight, kind="stable")[: max(0, hot_budget)]
+        hot_ix = by_w[m.weight[by_w] > 1.0]
+        hot_mask = np.zeros((n,), bool)
+        hot_mask[hot_ix] = True
+        tail = np.bincount(
+            base[~hot_mask], weights=load[~hot_mask], minlength=N
+        )
+        best_r, best_cost = 0, float("inf")
+        for r in range(N):
+            cost = float(np.max(L + np.roll(tail, r)))
+            if cost < best_cost - 1e-9:
+                best_r, best_cost = r, cost
+        offsets[ref] = best_r
+        L += np.roll(tail, best_r)
+        for i in hot_ix:
+            hot_all.append((float(load[i]), int(m.keys[i]), ref))
+
+    # LPT over every table's hot keys against the shared load vector.
+    hot_all.sort(key=lambda t: (-t[0], t[1]))
+    for w, key, ref in hot_all:
+        s = int(np.argmin(L))
+        L[s] += w
+        hot_per[ref].append((key, s))
+
+    for m in members:
+        ref = (m.bundle, m.member)
+        pairs = hot_per.get(ref, [])
+        plans[ref] = ShardPlan(
+            num_shards=N,
+            sentinel=m.sentinel,
+            offset=offsets.get(ref, 0),
+            hot_keys=tuple(k for k, _ in pairs),
+            hot_owners=tuple(s for _, s in pairs),
+        )
+    report = {
+        "imbalance_before": round(T.shard_imbalance(L_before), 4),
+        "imbalance_after": round(T.shard_imbalance(L), 4),
+        "modeled_loads_before": [round(float(x), 1) for x in L_before],
+        "modeled_loads_after": [round(float(x), 1) for x in L],
+        "hot_keys": sum(len(v) for v in hot_per.values()),
+    }
+    return plans, report
+
+
+# ---------------------------------------------------------------- re-shard
+
+
+def reshard_members(
+    table,
+    shard_states,
+    owner_np,
+    slot_fills=None,
+) -> Tuple[Optional[List], int, str]:
+    """Move rows between the N per-shard states of ONE member table so
+    every live key resides on `owner_np(key)`'s shard.
+
+    Host-side, at maintain cadence — the same cadence as growth/eviction
+    rebuilds. Rows migrate verbatim (values, fused meta, optimizer slot
+    rows), so per-key training state is bit-identical before and after;
+    transient counters reset (the rebuild contract); CBF sketches are
+    rebuilt from the migrated freqs (the checkpoint re-shard fallback
+    semantic: admitted keys exact, sub-threshold-only keys restart).
+
+    Returns (new_states, moved, "") on success or (None, 0, reason) when
+    any key cannot be placed (a shard over local capacity, or probe
+    overflow) — the caller must then keep serving the OLD plan; nothing
+    is mutated on failure.
+    """
+    from deeprec_tpu.embedding import filters as _filters
+    from deeprec_tpu.embedding.table import (
+        TableState, empty_key, empty_meta, probe_jit,
+    )
+    from deeprec_tpu.ops.packed import pack_array, unpack_array
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    N = len(shard_states)
+    cfg = table.cfg
+    sent = empty_key(cfg)
+    C = int(shard_states[0].keys.shape[0])
+
+    all_keys, all_vals, all_meta, srcs = [], [], [], []
+    slot_rows: Dict[str, List[np.ndarray]] = {}
+    for s, st in enumerate(shard_states):
+        keys = np.asarray(st.keys)
+        occ = keys != sent
+        if not occ.any():
+            continue
+        all_keys.append(keys[occ])
+        srcs.append(np.full((int(occ.sum()),), s, np.int32))
+        all_vals.append(np.asarray(unpack_array(st.values, C))[occ])
+        all_meta.append(np.asarray(st.meta)[:, occ])
+        for k, v in st.slots.items():
+            if k.startswith(SCALAR_PREFIX):
+                continue
+            slot_rows.setdefault(k, []).append(
+                np.asarray(unpack_array(v, C))[occ]
+            )
+    if not all_keys:
+        return list(shard_states), 0, ""
+    keys_g = np.concatenate(all_keys)
+    srcs_g = np.concatenate(srcs)
+    vals_g = np.concatenate(all_vals)
+    meta_g = np.concatenate(all_meta, axis=1)
+    slots_g = {k: np.concatenate(v) for k, v in slot_rows.items()}
+    owners = np.asarray(owner_np(keys_g), np.int32)
+    moved = int(np.sum(owners != srcs_g))
+
+    fills = dict(slot_fills or ())
+    new_states: List[TableState] = []
+    for s in range(N):
+        sel = owners == s
+        ks = keys_g[sel]
+        if ks.size > C:
+            return None, 0, (
+                f"shard {s} would hold {ks.size} keys > local capacity {C}"
+            )
+        old = shard_states[s]
+        kdt = np.asarray(old.keys).dtype
+        uids = np.full((C,), sent, dtype=kdt)
+        uids[: ks.size] = ks
+        uids_j = jnp.asarray(uids)
+        new_keys, slot_ix, _, failed = probe_jit(
+            table, jnp.full((C,), sent, old.keys.dtype), uids_j,
+            uids_j != jnp.asarray(sent, old.keys.dtype),
+        )
+        if int(jnp.sum(failed)):
+            return None, 0, f"shard {s}: probe overflow at load {ks.size}/{C}"
+        six = jnp.asarray(np.asarray(slot_ix)[: ks.size])
+
+        def place(rows_np, fill, width):
+            arr = jnp.full(
+                (C, width), fill, dtype=jnp.asarray(rows_np).dtype
+            )
+            return arr.at[six].set(jnp.asarray(rows_np))
+
+        vals_new = pack_array(
+            place(vals_g[sel], 0, vals_g.shape[1]),
+            table.pack_width(vals_g.shape[1], C),
+        )
+        meta_new = empty_meta(C).at[:, six].set(jnp.asarray(meta_g[:, sel]))
+        slots_new = {}
+        for k, v in old.slots.items():
+            if k.startswith(SCALAR_PREFIX):
+                slots_new[k] = v
+                continue
+            rows = slots_g[k][sel]
+            slots_new[k] = pack_array(
+                place(rows, fills.get(k, 0), rows.shape[1]),
+                table.pack_width(rows.shape[1], C),
+            )
+        bloom = old.bloom
+        if bloom is not None and cfg.ev.cbf_filter is not None:
+            bloom, _ = _filters.cbf_add(
+                cfg.ev.cbf_filter, jnp.zeros_like(bloom),
+                jnp.asarray(uids[: ks.size]),
+                jnp.asarray(meta_g[0, sel], jnp.int32),
+            )
+        new_states.append(TableState(
+            keys=new_keys,
+            values=vals_new,
+            meta=meta_new,
+            slots=slots_new,
+            bloom=bloom,
+            insert_fails=jnp.zeros((), jnp.int32),
+        ))
+    return new_states, moved, ""

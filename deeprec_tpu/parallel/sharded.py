@@ -141,6 +141,10 @@ class ShardedTable:
         self.comm = comm
         self.a2a_slack = a2a_slack
         self.exchange_chunks = max(1, int(exchange_chunks))
+        # Max hot-key arrivals a placement plan routes to ONE destination
+        # bucket (see _a2a_budget); 0 = uniform hash, set by
+        # ShardedTrainer.update_placement at plan adoption.
+        self.plan_hot_headroom = 0
 
     # --------------------------------------------------------- split phases
 
@@ -150,14 +154,21 @@ class ShardedTable:
         *,
         pad_value: int = -1,
         unique_size: Optional[int] = None,
+        plan=None,
     ) -> ShardedRoute:
         """Routing phase: local dedup (`unique_size` engages the hash
         engine at that static budget), the id exchange, and the owner-side
         dedup. Depends only on `ids` — no table state — so it can be
-        issued arbitrarily early."""
+        issued arbitrarily early.
+
+        `plan` is an optional placement-plan leaf dict
+        (parallel/placement.py): owner-offset rotation + hot-key routing
+        table consulted before `hash_shard`, so zipf head keys spread
+        across the mesh instead of hammering their hash-home. None/{}
+        keeps the uniform hash (identical program)."""
         if self.comm == "a2a":
-            return self._route_a2a(ids, pad_value, unique_size)
-        return self._route_allgather(ids, pad_value, unique_size)
+            return self._route_a2a(ids, pad_value, unique_size, plan)
+        return self._route_allgather(ids, pad_value, unique_size, plan)
 
     def resolve(
         self,
@@ -182,6 +193,19 @@ class ShardedTable:
         state = self._count_dedup(
             state, route.counts, route.valid, route.loc_overflow, train
         )
+        if train:
+            # Owner-side load telemetry: how many exchanged rows THIS
+            # shard owns this step (arrivals — a hot key present on k
+            # source shards counts k) and how many distinct keys those
+            # dedup to. The per-mesh-position imbalance of these counters
+            # is what the placement plan flattens (dedup_stats per_shard,
+            # bench.py --placement).
+            state = state.replace(
+                owner_arrivals=state.owner_arrivals
+                + jnp.sum(route.owned).astype(jnp.int32),
+                owner_unique=state.owner_unique
+                + jnp.sum(route.o_valid).astype(jnp.int32),
+            )
         if train and route.a2a_overflow is not None:
             state = state.replace(
                 a2a_overflow=state.a2a_overflow + route.a2a_overflow
@@ -228,6 +252,7 @@ class ShardedTable:
         pad_value: int = -1,
         salt=None,
         unique_size: Optional[int] = None,
+        plan=None,
     ) -> Tuple[TableState, ShardedLookup]:
         """`unique_size` (static) engages the hash dedup engine at that
         budget BEFORE the exchange: the all_gather/all2all id payload, the
@@ -236,7 +261,9 @@ class ShardedTable:
 
         Composition of the split phases — route → resolve → finish; the
         pipelined trainers call the phases individually."""
-        route = self.route(ids, pad_value=pad_value, unique_size=unique_size)
+        route = self.route(
+            ids, pad_value=pad_value, unique_size=unique_size, plan=plan
+        )
         state, sl = self.resolve(
             state, route, step=step, train=train, salt=salt
         )
@@ -308,8 +335,10 @@ class ShardedTable:
 
     # -------------------------------------------------------- allgather path
 
-    def _route_allgather(self, ids, pad_value, unique_size) -> ShardedRoute:
+    def _route_allgather(self, ids, pad_value, unique_size,
+                         plan=None) -> ShardedRoute:
         from deeprec_tpu.ops import dedup
+        from deeprec_tpu.parallel import placement
 
         N = self.num_shards
         axis = self.axis
@@ -325,7 +354,9 @@ class ShardedTable:
         g_uids = jax.lax.all_gather(uids, axis, tiled=True)  # [G]
         g_counts = jax.lax.all_gather(counts, axis, tiled=True)  # [G]
         me = jax.lax.axis_index(axis)
-        owned = (hashing.hash_shard(g_uids, N) == me) & (g_uids != sentinel)
+        owned = (placement.plan_owner(g_uids, N, plan) == me) & (
+            g_uids != sentinel
+        )
         o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
             g_uids, g_counts, owned, sentinel, budgeted=unique_size is not None
         )
@@ -362,11 +393,23 @@ class ShardedTable:
     def _a2a_budget(self, U: int) -> int:
         import math
 
+        # slack·U/N models hash-uniform owner spread. A placement plan
+        # (parallel/placement.py) breaks that assumption by design: its
+        # hot-key table concentrates up to `plan_hot_headroom` EXPLICIT
+        # arrivals per (source, dest) bucket on top of the rotated tail —
+        # every source that sees a hot key sends it to the same planned
+        # owner. The headroom is a static trace-time constant the trainer
+        # sets at plan adoption (update_placement, before the jit
+        # rebuild), so balanced plans never buy their balance with
+        # overflow-degraded (default-served) hot ids.
         per_dest = math.ceil(U * self.a2a_slack / self.num_shards)
+        per_dest += int(self.plan_hot_headroom)
         return max(8, ((per_dest + 7) // 8) * 8)  # pad to VPU-friendly size
 
-    def _route_a2a(self, ids, pad_value, unique_size) -> ShardedRoute:
+    def _route_a2a(self, ids, pad_value, unique_size,
+                   plan=None) -> ShardedRoute:
         from deeprec_tpu.ops import dedup
+        from deeprec_tpu.parallel import placement
 
         N = self.num_shards
         axis = self.axis
@@ -383,7 +426,7 @@ class ShardedTable:
         # Bucket by owner with a per-destination budget.
         Bd = self._a2a_budget(U)
         owner = jnp.where(
-            valid, hashing.hash_shard(uids, N), jnp.int32(N)
+            valid, placement.plan_owner(uids, N, plan), jnp.int32(N)
         )  # invalid sort last
         sort_ix = jnp.argsort(owner, stable=True)
         sorted_owner = owner[sort_ix]

@@ -32,6 +32,8 @@ from deeprec_tpu.parallel.compat import shard_map
 from deeprec_tpu import features as fcol
 from deeprec_tpu.embedding.table import EmbeddingTable
 from deeprec_tpu.optim.apply import ensure_slots
+from deeprec_tpu.parallel import placement as placement_lib
+from deeprec_tpu.parallel.placement import BundlePlan
 from deeprec_tpu.parallel.sharded import ShardedTable
 from deeprec_tpu.training import metrics as M
 from deeprec_tpu.training.trainer import (
@@ -69,12 +71,29 @@ class ShardedTrainer(Trainer):
         unique_budget=None,
         pipeline_mode: str = "off",
         pipeline_chunks: int = 4,
+        placement: str = "uniform",
+        placement_hot_budget: int = 64,
     ):
         from deeprec_tpu.parallel.mesh import make_mesh
 
         self.mesh = mesh or make_mesh(axis=axis)
         self.axis = axis
         self.num_shards = self.mesh.devices.size
+        # Skew-aware table placement (parallel/placement.py): "uniform"
+        # keeps the legacy hash_shard routing; "plan" lets maintain() run
+        # update_placement() next to update_budgets — recomputing the
+        # owner-offset/hot-key plan from live freq counters and migrating
+        # moved rows at the step boundary. Plans always start uniform;
+        # update_placement(force=True) also works under "uniform" for
+        # one-shot manual placement.
+        if placement not in ("uniform", "plan"):
+            raise ValueError(
+                f"placement must be 'uniform' or 'plan', got {placement!r}"
+            )
+        self.placement = placement
+        self.placement_hot_budget = int(placement_hot_budget)
+        self._plans: Dict[str, "BundlePlan"] = {}
+        self.last_placement: Optional[Dict] = None
         super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat,
                          unique_budget=unique_budget,
                          pipeline_mode=pipeline_mode,
@@ -189,11 +208,11 @@ class ShardedTrainer(Trainer):
         # are bounded by the global table (they hash across all shards).
         return b.table.cfg.capacity * self.num_shards
 
-    def _lookup_one(self, b, state, ids, pad, salt, step, train):
+    def _lookup_one(self, b, state, ids, pad, salt, step, train, plan=None):
         U = self._budget_for_lookup(b, ids, train)
         return self.sharded[b.name].lookup_unique(
             state, ids, step=step, train=train, pad_value=pad, salt=salt,
-            unique_size=U,
+            unique_size=U, plan=plan,
         )
 
     def _apply_one(self, b, state, res, grad, step, lr):
@@ -207,10 +226,10 @@ class ShardedTrainer(Trainer):
     # Split-phase primitives (Trainer._route_all/_resolve_all/_finish_all
     # drive these): the collective versions — route carries the id
     # exchange, finish the embedding exchange.
-    def _route_one(self, b, ids, pad, train):
+    def _route_one(self, b, ids, pad, train, plan=None):
         U = self._budget_for_lookup(b, ids, train)
         return self.sharded[b.name].route(
-            ids, pad_value=pad, unique_size=U
+            ids, pad_value=pad, unique_size=U, plan=plan
         )
 
     def _resolve_one(self, b, state, route, salt, step, train):
@@ -239,6 +258,265 @@ class ShardedTrainer(Trainer):
         batch_spec = P(ax)
         return views_spec, res_spec, batch_spec
 
+    # --------------------------------------------------------- placement
+
+    def _bundle_plan_leaves(self, b):
+        """Active ShardPlan of this bundle as device constants for the
+        route paths (stacked bundles: leading [T] member axis, mapped by
+        the lookup vmap). Uniform plans return {} so the compiled program
+        is identical to the pre-placement one. Plan changes rebuild the
+        jit wrappers (update_placement) — the constants are baked into
+        the traced program, exactly like the resolved unique budgets."""
+        import numpy as np
+
+        bp = self._plans.get(b.name)
+        if bp is None or bp.is_uniform:
+            return {}
+        return bp.leaves(np.dtype(b.table.cfg.key_dtype), stacked=b.stacked)
+
+    def _per_shard_stats(self, b, member_ts):
+        """Owner-load breakdown per mesh position for dedup_stats: the
+        counters ShardedTable.resolve accumulates, converted to modeled
+        exchange bytes (ops/traffic.py) and their max/mean imbalance."""
+        import numpy as np
+
+        from deeprec_tpu.ops import traffic as T
+
+        oa = np.asarray(jax.device_get(member_ts.owner_arrivals))
+        ou = np.asarray(jax.device_get(member_ts.owner_unique))
+        if oa.ndim != 1:
+            return None
+        cfg = b.table.cfg
+        wire = 2 if cfg.exchange_dtype == "bfloat16" else 4
+        rb = T.exchange_row_bytes(dim=cfg.dim, wire_bytes=wire)
+        xb = [round(float(a) * rb, 1) for a in oa]
+        return {
+            "owner_unique": [int(x) for x in ou],
+            "owner_arrivals": [int(x) for x in oa],
+            "exchange_bytes": xb,
+            "imbalance": round(T.shard_imbalance(xb), 4),
+        }
+
+    def _member_traffics(self, state):
+        """Placer inputs: one MemberTraffic per member table, weights
+        modeled from the live freq counters (TableState.meta) — a key's
+        arrivals/step is at most its occurrence rate and at most N (each
+        source shard dedups before the exchange)."""
+        import numpy as np
+
+        from deeprec_tpu.embedding.table import empty_key
+        from deeprec_tpu.ops import traffic as T
+
+        N = self.num_shards
+        steps = max(1, int(state.step))
+        out = []
+        for bname, b in self.bundles.items():
+            cfg = b.table.cfg
+            sent = empty_key(cfg)
+            wire = 2 if cfg.exchange_dtype == "bfloat16" else 4
+            row_bytes = T.exchange_row_bytes(dim=cfg.dim, wire_bytes=wire)
+            ts = state.tables[bname]
+            keys_np = np.asarray(jax.device_get(ts.keys))  # [T?, N, C]
+            freq_np = np.asarray(jax.device_get(ts.freq))
+            for m in (range(len(b.features)) if b.stacked else [0]):
+                k = keys_np[m] if b.stacked else keys_np  # [N, C]
+                fq = freq_np[m] if b.stacked else freq_np
+                occ = k != sent
+                out.append(placement_lib.MemberTraffic(
+                    bundle=bname, member=m, keys=k[occ],
+                    weight=np.minimum(
+                        fq[occ].astype(np.float64) / steps, float(N)
+                    ),
+                    row_bytes=row_bytes, sentinel=sent,
+                ))
+        return out
+
+    def update_placement(self, state, *, hot_budget=None,
+                         min_gain: float = 1.05, force: bool = False):
+        """The cost-model placer, end to end: estimate per-shard exchange
+        load from the live freq/dedup counters + per-table dims
+        (ops/traffic.py), greedily build a candidate ShardPlan per member
+        (parallel/placement.py build_plans), and — when it models at
+        least `min_gain`x less max/mean imbalance than the ACTIVE plan
+        (or force=True) — migrate moved rows between shards and swap the
+        plan at this step boundary. The old plan serves until the swap;
+        migration moves rows verbatim (bit-identical per-key state) and
+        a migration that cannot place every key aborts, keeping the old
+        plan. Adoption rebuilds the jitted steps (plan constants resolve
+        at trace time, the update_budgets stale-executable contract).
+
+        Returns (state, report) with a per-bundle report; the global
+        model numbers land on `self.last_placement`."""
+        import numpy as np
+
+        from jax.sharding import NamedSharding
+
+        from deeprec_tpu.ops import traffic as T
+
+        hot_budget = (
+            self.placement_hot_budget if hot_budget is None else hot_budget
+        )
+        members_info = self._member_traffics(state)
+        current = {
+            (m.bundle, m.member): self._plans[m.bundle].member(m.member)
+            for m in members_info
+            if m.bundle in self._plans
+        }
+        # Multi-tier bundles keep uniform routing: their demoted rows live
+        # in per-(bundle, shard) tier stores the migration cannot move —
+        # re-routing a demoted key would strand its trained values/slots
+        # on the old shard's store and re-insert it from the initializer.
+        # Their (immovable) load still shapes the plan as a baseline the
+        # placer packs around.
+        pinned = {
+            bname for bname, b in self.bundles.items()
+            if b.table.cfg.ev.storage.storage_type.value in (
+                "hbm_dram", "hbm_dram_ssd"
+            )
+        }
+        plannable = [m for m in members_info if m.bundle not in pinned]
+        fixed = [m for m in members_info if m.bundle in pinned]
+        candidate, model_rep = placement_lib.build_plans(
+            self.num_shards, plannable, hot_budget=hot_budget,
+            base_loads=placement_lib.modeled_loads(self.num_shards, fixed),
+        )
+        imb_current = T.shard_imbalance(placement_lib.modeled_loads(
+            self.num_shards, members_info, current
+        ))
+        imb_candidate = T.shard_imbalance(placement_lib.modeled_loads(
+            self.num_shards, members_info, candidate
+        ))
+        self.last_placement = dict(
+            model_rep,
+            imbalance_current=round(imb_current, 4),
+            imbalance_candidate=round(imb_candidate, 4),
+        )
+        adopt = force or imb_candidate * min_gain <= imb_current
+        report = {}
+        if not adopt:
+            return state, {
+                bname: {"adopted": False, "imbalance_current": imb_current,
+                        "imbalance_candidate": imb_candidate}
+                for bname in self.bundles
+            }
+
+        tables = dict(state.tables)
+        changed_any = False
+        for bname, b in self.bundles.items():
+            if bname in pinned:
+                report[bname] = {"adopted": False, "skipped": "multi_tier"}
+                continue
+            mlist = list(range(len(b.features))) if b.stacked else [0]
+            bp_new = BundlePlan(tuple(candidate[(bname, m)] for m in mlist))
+            bp_old = self._plans.get(bname)
+            rep = {"adopted": False, "moved": 0,
+                   "offsets": [p.offset for p in bp_new.plans],
+                   "hot_keys": sum(len(p.hot_keys) for p in bp_new.plans)}
+            if bp_old == bp_new or (bp_old is None and bp_new.is_uniform):
+                rep["adopted"] = bp_old is not None or not bp_new.is_uniform
+                report[bname] = rep
+                continue
+            ts = state.tables[bname]
+            lead = self._bundle_lead_dims(b)
+            idxs = list(np.ndindex(*lead))
+            members = [jax.tree.map(lambda a, i=i: a[i], ts) for i in idxs]
+            fills = self._slot_fills(b)
+            N = self.num_shards
+            new_members, moved_total, fail = [], 0, ""
+            for m in mlist:
+                shard_states = members[m * N:(m + 1) * N]
+                res, moved, fail = placement_lib.reshard_members(
+                    b.table, shard_states, bp_new.member(m).owner_np,
+                    slot_fills=fills,
+                )
+                if res is None:
+                    break
+                # Local-dedup telemetry describes the SOURCE side — it is
+                # unaffected by where rows live, so the window's counters
+                # survive the migration (owner counters stay reset: they
+                # were measured under the old plan). insert_fails survives
+                # too: maintain()'s growth check reads it AFTER this swap
+                # in the same call, and a migration must not eat a pending
+                # grow signal.
+                res = [
+                    r.replace(dedup_unique=o.dedup_unique,
+                              dedup_ids=o.dedup_ids,
+                              dedup_overflow=o.dedup_overflow,
+                              insert_fails=o.insert_fails,
+                              a2a_overflow=o.a2a_overflow)
+                    for r, o in zip(res, shard_states)
+                ]
+                new_members.extend(res)
+                moved_total += moved
+            if len(new_members) != len(members):
+                rep["migrate_failed"] = fail or "reshard aborted"
+                report[bname] = rep
+                continue
+            tables[bname] = jax.device_put(
+                self._restack(new_members, lead),
+                NamedSharding(self.mesh, self._table_spec(bname)),
+            )
+            self._plans[bname] = bp_new
+            # a2a headroom: the plan concentrates up to this many explicit
+            # hot-key arrivals on one (source, dest) bucket — the budget
+            # model's uniform-spread assumption no longer covers them, so
+            # the per-destination budget grows by exactly that count
+            # (ShardedTable._a2a_budget; static, baked at the jit rebuild).
+            self.sharded[bname].plan_hot_headroom = max(
+                (
+                    int(np.bincount(
+                        np.asarray(p.hot_owners, np.int64),
+                        minlength=self.num_shards,
+                    ).max()) if p.hot_keys else 0
+                )
+                for p in bp_new.plans
+            )
+            rep.update(adopted=True, moved=moved_total)
+            report[bname] = rep
+            changed_any = True
+        if changed_any:
+            self._make_jits()
+        return (
+            TrainState(step=state.step, tables=tables, dense=state.dense,
+                       opt_state=state.opt_state),
+            report,
+        )
+
+    def restore_owner(self, bname: str, member, keys):
+        """Owner shard of `keys` under the ACTIVE plan — the checkpoint
+        restore router (training/checkpoint.py) calls this instead of the
+        bare hash so a checkpoint saved under plan A restores correctly
+        into a trainer running plan B."""
+        import numpy as np
+
+        from deeprec_tpu.utils.hashing import hash_shard_np
+
+        bp = self._plans.get(bname)
+        if bp is None:
+            return hash_shard_np(np.asarray(keys), self.num_shards)
+        return bp.member(member).owner_np(keys)
+
+    def routing_fingerprint(self, bname: str) -> str:
+        """Stable digest of this bundle's ACTIVE routing. Recorded in the
+        checkpoint manifest at save time and compared at restore: a
+        shard's saved CBF sketch describes the residents its save-time
+        routing put there, so the per-shard exact-sketch reuse is only
+        valid when save and restore route identically — rows themselves
+        re-route freely (restore_owner), only the sketches fall back to
+        the rebuild-from-rows path on a mismatch."""
+        bp = self._plans.get(bname)
+        if bp is None or bp.is_uniform:
+            return "uniform"
+        import hashlib
+
+        canon = "|".join(
+            f"{p.num_shards}:{p.offset}:"
+            f"{','.join(map(str, p.hot_keys))}:"
+            f"{','.join(map(str, p.hot_owners))}"
+            for p in bp.plans
+        )
+        return hashlib.sha1(canon.encode()).hexdigest()[:16]
+
     # --------------------------------------------- capacity management
 
     def _bundle_lead_dims(self, b):
@@ -248,12 +526,18 @@ class ShardedTrainer(Trainer):
 
     def _set_bundle_capacity(self, b, new_c):
         super()._set_bundle_capacity(b, new_c)
-        # Re-point the collective wrapper at the grown local table.
+        # Re-point the collective wrapper at the grown local table. The
+        # a2a hot-key headroom carries over: the adopted plan still
+        # concentrates its hot keys regardless of capacity, and dropping
+        # it here would re-expose the overflow-degraded hot ids the
+        # headroom exists to prevent (growth and adoption can land in the
+        # SAME maintain call).
         old = self.sharded[b.name]
         self.sharded[b.name] = ShardedTable(
             b.table, old.num_shards, old.axis, comm=old.comm,
             a2a_slack=old.a2a_slack, exchange_chunks=old.exchange_chunks,
         )
+        self.sharded[b.name].plan_hot_headroom = old.plan_hot_headroom
 
     def maintain(self, state, **kw):
         # max_capacity is the GLOBAL cap; the base loop compares against

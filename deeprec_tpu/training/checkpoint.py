@@ -417,6 +417,10 @@ class _SavePlan:
     opt_state: Any
     positions: Optional[Dict[str, dict]]
     stats: Dict[str, float]
+    # Per-bundle routing fingerprint at STAGE time (the async writer must
+    # not read the live trainer's plans — a maintain() can adopt a new
+    # plan while the write half runs). "uniform" = hash routing.
+    routing: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 # -------------------------------------------------------- checkpoint manager
@@ -1030,7 +1034,18 @@ class CheckpointManager:
             path=path, kind=kind, step=step, parts=parts, write=write,
             state=snap_state, incr=incr, dense=dense, opt_state=opt,
             positions=positions, stats={"transfer_bytes": int(transfer)},
+            routing={
+                bname: self._routing_fp(bname)
+                for bname in self.trainer.bundles
+            },
         )
+
+    def _routing_fp(self, bname: str) -> str:
+        """The trainer's active routing fingerprint for one bundle —
+        "uniform" for plan-less trainers (and every pre-placement
+        checkpoint, whose manifest has no routing record at all)."""
+        fn = getattr(self.trainer, "routing_fingerprint", None)
+        return fn(bname) if fn is not None else "uniform"
 
     @staticmethod
     def _savez(digests: Dict[str, Dict[str, str]], path: str, fname: str,
@@ -1132,7 +1147,8 @@ class CheckpointManager:
                             _tree_to_npz_dict(plan.dense))
                 self._savez(digests, path, "opt.npz",
                             _tree_to_npz_dict(plan.opt_state))
-                manifest = {"step": step, "kind": kind, "digests": digests}
+                manifest = {"step": step, "kind": kind, "digests": digests,
+                            "routing": plan.routing}
                 if parts:
                     manifest["format"] = "parts"
                     manifest["parts"] = jax.process_count()
@@ -1514,6 +1530,14 @@ class CheckpointManager:
             }
             cbf = b.table.cfg.ev.cbf_filter
             for path in chain:
+                # Exact per-shard sketch reuse needs save-time ROUTING to
+                # match, not just the shard count (see _import_local) —
+                # manifests without a routing record predate plans and
+                # routed uniformly.
+                sketch_exact_ok = (
+                    self._manifest(path).get("routing", {})
+                    .get(bname, "uniform") == self._routing_fp(bname)
+                )
                 for m in members:
                     tag = f"t{m}" if m is not None else "t"
                     live_chunks: List[np.ndarray] = []
@@ -1536,16 +1560,14 @@ class CheckpointManager:
                             if sids is None:  # legacy gathered file
                                 sids = np.arange(bp.shape[0])
                                 save_n = bp.shape[0]
-                            if save_n == N:
+                            if save_n == N and sketch_exact_ok:
                                 for i, sid in enumerate(np.asarray(sids)):
                                     if int(sid) in local:
                                         exact_sketch[int(sid)] = bp[i]
                         keys = rows["keys"]
                         if keys.shape[0] == 0:
                             continue
-                        owner = np.asarray(
-                            hashing.hash_shard(jnp.asarray(keys), N)
-                        )
+                        owner = self._restore_owner(bname, m, keys, N)
                         for s in owned:
                             sel = owner == s
                             if not sel.any():
@@ -1752,6 +1774,7 @@ class CheckpointManager:
         # delta alike (see import_rows), so no replay ever traces a new
         # XLA program while requests are in flight.
         bucket = os.path.basename(path).startswith("incr-")
+        mf_routing = self._manifest(path).get("routing", {})
         tables = dict(state.tables)
         for bname, b in self.trainer.bundles.items():
             ts = tables[bname]
@@ -1764,8 +1787,14 @@ class CheckpointManager:
                 if rows is not None:
                     rows.pop("partition_offset", None)
                     live = rows.pop("live_keys", None)
-                    sub = self._import_local(b.table, sub, rows,
-                                             bucket=bucket, chunk=chunk)
+                    sub = self._import_local(
+                        b.table, sub, rows, bucket=bucket, chunk=chunk,
+                        bname=bname, member=k,
+                        sketch_exact_ok=(
+                            mf_routing.get(bname, "uniform")
+                            == self._routing_fp(bname)
+                        ),
+                    )
                     if live is not None:
                         # delta semantics: anything absent from the delta's
                         # live set was evicted since the previous save
@@ -1813,17 +1842,39 @@ class CheckpointManager:
             b.table, sub, jnp.asarray(np.isin(keys, live)), fills
         )
 
+    def _restore_owner(self, bname, member, keys, N) -> np.ndarray:
+        """Owner shard of restored keys: the trainer's ACTIVE placement
+        plan when it carries one (ShardedTrainer.restore_owner), else the
+        uniform hash. Routing by the live plan — not the hash, not the plan
+        at save time — is what makes a checkpoint saved under plan A
+        restore correctly into a trainer running plan B: each row lands on
+        the shard where plan B's route will look it up."""
+        fn = getattr(self.trainer, "restore_owner", None)
+        if fn is not None and bname is not None:
+            return np.asarray(fn(bname, member, keys), np.int32)
+        return np.asarray(hashing.hash_shard(jnp.asarray(keys), N))
+
     def _import_local(self, table, sub: TableState, rows,
                       bucket: bool = False,
-                      chunk: Optional[int] = None) -> TableState:
-        """Import rows into a local (possibly shard-stacked) table state."""
+                      chunk: Optional[int] = None,
+                      bname=None, member=None,
+                      sketch_exact_ok: bool = True) -> TableState:
+        """Import rows into a local (possibly shard-stacked) table state.
+
+        `sketch_exact_ok` gates the per-shard exact CBF-sketch reuse: a
+        saved sketch describes the rows save-time ROUTING put on that
+        shard, so matching shard count alone is no longer enough — the
+        caller compares the manifest's routing fingerprint against the
+        restoring trainer's (a plan change falls back to rebuilding the
+        sketches from the rows each shard actually imports)."""
         if self._is_sharded():
             N = self.trainer.num_shards
-            owner = np.asarray(hashing.hash_shard(jnp.asarray(rows["keys"]), N))
+            owner = self._restore_owner(bname, member, rows["keys"], N)
             shards = []
             bloom_parts = rows.get("bloom_parts")
             same_topology = (
                 bloom_parts is not None and bloom_parts.shape[0] == N
+                and sketch_exact_ok
             )
             for s in range(N):
                 sel = owner == s

@@ -352,7 +352,16 @@ class Trainer:
             return None
         return self._resolve_budget(b, int(np.prod(ids.shape)))
 
-    def _lookup_one(self, b: Bundle, state, ids, pad, salt, step, train):
+    def _bundle_plan_leaves(self, b: Bundle):
+        """Per-bundle placement-plan device constants threaded through the
+        lookup/route vmaps (parallel/placement.py). The base trainer has
+        no placement — an empty dict means uniform hash routing and adds
+        no vmap leaves; ShardedTrainer overrides with the active plan's
+        arrays (leading [T] member axis for stacked bundles)."""
+        return {}
+
+    def _lookup_one(self, b: Bundle, state, ids, pad, salt, step, train,
+                    plan=None):
         U = self._budget_for_lookup(b, ids, train)
         return b.table._lookup_unique_impl(
             state, ids, step, train, pad, U, salt=salt
@@ -395,15 +404,19 @@ class Trainer:
         views = {}  # feature -> (embeddings [U,D], inverse, mask)
         bundle_res = {}  # bundle -> stacked result
         for bname, b in self.bundles.items():
+            plan = self._bundle_plan_leaves(b)
             if b.stacked:
                 ids = self._stacked_ids(b, batch)
                 pad = b.features[0].pad_value
                 masks = ids != jnp.asarray(pad, ids.dtype)
 
-                def one(s, i, sa, b=b, pad=pad):
-                    return self._lookup_one(b, s, i, pad, sa, step, train)
+                def one(s, i, sa, pl, b=b, pad=pad):
+                    return self._lookup_one(b, s, i, pad, sa, step, train,
+                                            plan=pl)
 
-                tables[bname], res = jax.vmap(one)(tables[bname], ids, b.salts)
+                tables[bname], res = jax.vmap(one)(
+                    tables[bname], ids, b.salts, plan
+                )
                 bundle_res[bname] = res
                 for k, f in enumerate(b.features):
                     views[f.name] = (
@@ -416,7 +429,8 @@ class Trainer:
                     ids = _prep_ids(batch[f.name])
                     mask = ids != jnp.asarray(f.pad_value, ids.dtype)
                     tables[bname], res = self._lookup_one(
-                        b, tables[bname], ids, f.pad_value, None, step, train
+                        b, tables[bname], ids, f.pad_value, None, step, train,
+                        plan=plan,
                     )
                     bundle_res.setdefault(bname, {})[f.name] = res
                     views[f.name] = (res.embeddings, res.inverse, mask)
@@ -437,7 +451,7 @@ class Trainer:
     # route → resolve → finish composes to exactly _lookup_all.
     # ShardedTrainer overrides only the three *_one primitives.
 
-    def _route_one(self, b: Bundle, ids, pad, train):
+    def _route_one(self, b: Bundle, ids, pad, train, plan=None):
         U = self._budget_for_lookup(b, ids, train)
         return b.table._route_ids(ids, pad, U)
 
@@ -453,18 +467,20 @@ class Trainer:
         """Phase 1 for every bundle: pure function of the id batch."""
         routes = {}
         for bname, b in self.bundles.items():
+            plan = self._bundle_plan_leaves(b)
             if b.stacked:
                 ids = self._stacked_ids(b, batch)
                 pad = b.features[0].pad_value
 
-                def one(i, b=b, pad=pad):
-                    return self._route_one(b, i, pad, train)
+                def one(i, pl, b=b, pad=pad):
+                    return self._route_one(b, i, pad, train, plan=pl)
 
-                routes[bname] = jax.vmap(one)(ids)
+                routes[bname] = jax.vmap(one)(ids, plan)
             else:
                 routes[bname] = {
                     f.name: self._route_one(
-                        b, _prep_ids(batch[f.name]), f.pad_value, train
+                        b, _prep_ids(batch[f.name]), f.pad_value, train,
+                        plan=plan,
                     )
                     for f in b.features
                 }
@@ -976,11 +992,24 @@ class Trainer:
             int(np.sum(np.asarray(jax.device_get(ts.dedup_overflow)))),
         )
 
+    def _per_shard_stats(self, b: Bundle, member_ts):
+        """Per-mesh-position owner-load breakdown of one member table, or
+        None when there is no shard axis (the base trainer). ShardedTrainer
+        overrides — the counters themselves accumulate in
+        ShardedTable.resolve."""
+        return None
+
     def dedup_stats(self, state: TrainState) -> Dict[str, Dict[str, float]]:
         """Per-TABLE dedup telemetry since the last counter reset:
         `unique_fraction` (budgeted uniques + overflow over id positions —
         the quantity the auto budget tracks) and `dedup_overflow`. Stacked
-        bundles report each member table under its own feature name."""
+        bundles report each member table under its own feature name.
+
+        Sharded trainers additionally report `per_shard` per table — the
+        owner-unique/arrival counts and modeled exchange bytes of every
+        mesh position plus their max/mean imbalance (ops/traffic.py) — so
+        exchange skew is observable from a live TrainState without
+        running a bench."""
         import numpy as np
 
         out: Dict[str, Dict[str, float]] = {}
@@ -997,6 +1026,9 @@ class Trainer:
                     ),
                     "dedup_overflow": ovf,
                 }
+                per_shard = self._per_shard_stats(b, member)
+                if per_shard is not None:
+                    out[fcol.resolve_table_name(f)]["per_shard"] = per_shard
                 if not b.stacked:
                     break  # shared-table bundles hold one merged counter
         return out
@@ -1039,11 +1071,15 @@ class Trainer:
                     self._auto_frac[bname] = new_frac
             if bname in self._auto_frac:
                 rep["unique_budget_fraction"] = self._auto_frac[bname]
-            # Reset via *0 so sharded leaves keep their placement.
+            # Reset via *0 so sharded leaves keep their placement. The
+            # owner-load telemetry shares the window semantics: stats read
+            # since-last-reset, bench windows bracket with update_budgets.
             tables[bname] = ts.replace(
                 dedup_unique=ts.dedup_unique * 0,
                 dedup_ids=ts.dedup_ids * 0,
                 dedup_overflow=ts.dedup_overflow * 0,
+                owner_arrivals=ts.owner_arrivals * 0,
+                owner_unique=ts.owner_unique * 0,
             )
             report[bname] = rep
         if changed:
@@ -1053,6 +1089,17 @@ class Trainer:
                        opt_state=state.opt_state),
             report,
         )
+
+    def update_placement(
+        self, state: TrainState, **kw
+    ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
+        """Recompute the skew-aware shard placement from live counters and
+        re-shard tables whose plan changed (parallel/placement.py). The
+        base trainer has no shard axis — placement is meaningless, so this
+        is a no-op; ShardedTrainer implements it and maintain() runs it
+        next to update_budgets when the trainer was built with
+        placement="plan"."""
+        return state, {}
 
     def maintain(
         self,
@@ -1092,7 +1139,12 @@ class Trainer:
         import numpy as np
 
         step = int(state.step) if step is None else int(step)
-        # Dedup telemetry first: fold counters into the auto-budget EMA,
+        # Placement BEFORE update_budgets: the placer wants the window's
+        # owner-load counters, which update_budgets resets.
+        placement_report = {}
+        if getattr(self, "placement", "uniform") == "plan":
+            state, placement_report = self.update_placement(state)
+        # Dedup telemetry: fold counters into the auto-budget EMA,
         # reset them, and carry the per-bundle stats into the report.
         state, dedup_report = self.update_budgets(state)
         total_bytes = (
@@ -1120,6 +1172,8 @@ class Trainer:
             fails = sum(fails_each)
             rep = {"occupancy": occ, "insert_fails": fails, "capacity": C}
             rep.update(dedup_report.get(bname, {}))
+            if bname in placement_report:
+                rep["placement"] = placement_report[bname]
             multi_tier = b.table.cfg.ev.storage.storage_type.value in (
                 "hbm_dram", "hbm_dram_ssd"
             )

@@ -60,6 +60,59 @@ def hash_shard(ids: jnp.ndarray, num_shards: int) -> jnp.ndarray:
     return (h % jnp.uint32(num_shards)).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Host-side numpy mirrors. The placement subsystem (parallel/placement.py)
+# and checkpoint re-shard routing compute key owners on the HOST at
+# maintain/restore cadence; they must agree bit-for-bit with the compiled
+# `hash_shard` above or a migrated key would be looked up on a shard where
+# it doesn't live (and silently serve its initializer).
+
+def fold64_np(ids):
+    import numpy as np
+
+    ids = np.asarray(ids)
+    if ids.dtype in (np.int64, np.uint64):
+        with np.errstate(over="ignore"):
+            lo = ids.astype(np.uint32)
+            hi = (ids >> 32).astype(np.uint32)
+            return lo ^ (hi * np.uint32(0x9E3779B9))
+    return ids.astype(np.uint32)
+
+
+def mix32_np(x):
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        x = np.asarray(x).astype(np.uint32)
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x85EBCA6B)
+        x = x ^ (x >> np.uint32(13))
+        x = x * np.uint32(0xC2B2AE35)
+        x = x ^ (x >> np.uint32(16))
+        return x
+
+
+def hash_shard_np(ids, num_shards: int):
+    """Host mirror of `hash_shard` (bit-identical by construction).
+
+    Mirrors the whole device path INCLUDING `jnp.asarray`'s 64->32 bit
+    truncation when x64 is disabled (the default): device keys are the
+    table's 32-bit key dtype, so 64-bit host ids must narrow the same way
+    they would on the way in."""
+    import numpy as np
+
+    import jax
+
+    ids = np.asarray(ids)
+    if not jax.config.jax_enable_x64:
+        if ids.dtype == np.int64:
+            ids = ids.astype(np.int32)
+        elif ids.dtype == np.uint64:
+            ids = ids.astype(np.uint32)
+    h = mix32_np(fold64_np(ids))
+    return (h % np.uint32(num_shards)).astype(np.int32)
+
+
 def stateless_uniform_from_ids(
     ids: jnp.ndarray, salt, dtype=jnp.float32
 ) -> jnp.ndarray:

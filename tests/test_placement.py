@@ -1,0 +1,499 @@
+"""Skew-aware table placement (parallel/placement.py): any ShardPlan must
+be INVISIBLE to the training math — placement changes WHERE a row lives,
+never its values. The parity suite pins per-step losses and the full
+per-key table contents (values, meta, optimizer slots) bit-exact between
+uniform hash routing and an adopted plan, across both comm modes, the
+K-step scan and the pipelined lookahead; plus the hot-key budget fallback
+(H exceeded -> hash owner, no drops), the re-shard failure contract
+(cannot-place aborts, old plan keeps serving) and the checkpoint
+round-trip across a plan change (save under plan A, restore under plan B,
+both directions)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+from deeprec_tpu.parallel import placement as P
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.utils import hashing
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8
+    return make_mesh(8)
+
+
+def model():
+    return WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=4,
+               num_dense=2)
+
+
+def skewed_batches(n, batch_size=256, seed=7):
+    """Shared raw id space + per-table zipf exponents: every table's head
+    is the same raw ids, the correlated-head case the plan flattens."""
+    gen = SyntheticCriteo(
+        batch_size=batch_size, num_cat=4, num_dense=2, vocab=3000,
+        seed=seed, zipf_a=[1.2, 1.5, 1.8, 2.1], offset_ids=False,
+    )
+    return [J(gen.batch()) for _ in range(n)]
+
+
+def build(mesh, placement="uniform", comm="allgather", pipeline_mode="off"):
+    return ShardedTrainer(
+        model(), Adagrad(lr=0.1), optax.sgd(0.01), mesh=mesh, comm=comm,
+        placement=placement, placement_hot_budget=16,
+        pipeline_mode=pipeline_mode,
+    )
+
+
+def table_maps(tr, state):
+    """(bundle, member, key) -> all per-row state, wherever the row lives.
+
+    The placement-invariant view of a TrainState: migrating a row between
+    shards must leave this map bit-identical."""
+    from deeprec_tpu.embedding.table import empty_key
+    from deeprec_tpu.ops.packed import unpack_array
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    out = {}
+    for bname, b in tr.bundles.items():
+        ts = state.tables[bname]
+        sent = empty_key(b.table.cfg)
+        keys = np.asarray(jax.device_get(ts.keys))
+        meta = np.asarray(jax.device_get(ts.meta))
+        C = keys.shape[-1]
+        vals = np.asarray(jax.device_get(ts.values))
+        slots = {
+            k: np.asarray(jax.device_get(v))
+            for k, v in ts.slots.items()
+            if not k.startswith(SCALAR_PREFIX)
+        }
+        lead = keys.shape[:-1]  # [T?, N]
+        for idx in np.ndindex(*lead):
+            m = idx[0] if len(idx) == 2 else 0
+            k_loc = keys[idx]
+            v_loc = unpack_array(vals[idx], C)  # numpy: zero-copy view
+            s_loc = [unpack_array(sl[idx], C) for sl in slots.values()]
+            occ = np.nonzero(k_loc != sent)[0]
+            for s in occ:
+                key = int(k_loc[s])
+                row = (
+                    v_loc[s].tobytes(),
+                    meta[idx][:, s].tobytes(),
+                    tuple(sl[s].tobytes() for sl in s_loc),
+                )
+                ref = (bname, m, key)
+                assert ref not in out, f"key {key} on two shards: {ref}"
+                out[ref] = row
+    return out
+
+
+def assert_same_rows(tr_a, s_a, tr_b, s_b):
+    ma, mb = table_maps(tr_a, s_a), table_maps(tr_b, s_b)
+    assert set(ma) == set(mb), (
+        f"live key sets differ: {len(set(ma) ^ set(mb))} keys"
+    )
+    diff = [k for k in ma if ma[k] != mb[k]]
+    assert not diff, f"{len(diff)} keys differ, e.g. {diff[:3]}"
+
+
+def adopt(tr, st):
+    st, rep = tr.update_placement(st, force=True)
+    assert any(r.get("adopted") for r in rep.values()), rep
+    assert not any(r.get("migrate_failed") for r in rep.values()), rep
+    plans = {n: p for n, p in tr._plans.items() if not p.is_uniform}
+    assert plans, "forced adoption produced only uniform plans"
+    return st, rep
+
+
+# ------------------------------------------------------------ route parity
+
+
+def _parity_run(mesh, comm):
+    batches = skewed_batches(8)
+    sb = [shard_batch(mesh, b) for b in batches]
+    tr_u = build(mesh, "uniform", comm)
+    tr_p = build(mesh, "plan", comm)
+    s_u, s_p = tr_u.init(0), tr_p.init(0)
+    for i in range(4):
+        s_u, m_u = tr_u.train_step(s_u, sb[i])
+        s_p, m_p = tr_p.train_step(s_p, sb[i])
+        assert float(m_u["loss"]) == float(m_p["loss"])
+    s_p, rep = adopt(tr_p, s_p)
+    assert sum(r.get("moved", 0) for r in rep.values()) > 0, (
+        "plan adoption moved nothing — the parity run is vacuous"
+    )
+    assert_same_rows(tr_u, s_u, tr_p, s_p)  # migration itself is invisible
+    for i in range(4, 8):
+        s_u, m_u = tr_u.train_step(s_u, sb[i])
+        s_p, m_p = tr_p.train_step(s_p, sb[i])
+        assert float(m_u["loss"]) == float(m_p["loss"]), (
+            f"step {i}: {float(m_u['loss'])} != {float(m_p['loss'])}"
+        )
+    assert_same_rows(tr_u, s_u, tr_p, s_p)
+    return tr_u, s_u, tr_p, s_p, batches
+
+
+def test_plan_parity_allgather(mesh):
+    """Bit-exact per-step losses and per-key rows across a forced plan
+    adoption mid-training, allgather exchange — including the K-step scan
+    after the swap."""
+    from deeprec_tpu.training import stack_batches
+
+    tr_u, s_u, tr_p, s_p, batches = _parity_run(mesh, "allgather")
+    stacked = shard_batch(mesh, stack_batches(batches[:3]), stacked=True)
+    s_u, m_u = tr_u.train_steps(s_u, stacked)
+    s_p, m_p = tr_p.train_steps(s_p, stacked)
+    np.testing.assert_array_equal(
+        np.asarray(m_u["loss"]), np.asarray(m_p["loss"])
+    )
+    assert_same_rows(tr_u, s_u, tr_p, s_p)
+
+
+def test_plan_parity_a2a(mesh):
+    """Same contract on the budgeted all2all exchange: the plan changes
+    the owner bucketing, not the math."""
+    _parity_run(mesh, "a2a")
+
+
+def test_plan_parity_lookahead_scan(mesh):
+    """pipeline_mode="lookahead": route(t+1) is issued a step early with
+    the plan constants baked into the scan — parity must survive the
+    hoisted routing."""
+    from deeprec_tpu.training import stack_batches
+
+    batches = skewed_batches(7)
+    sb = [shard_batch(mesh, b) for b in batches]
+    tr_u = build(mesh, "uniform", pipeline_mode="lookahead")
+    tr_p = build(mesh, "plan", pipeline_mode="lookahead")
+    s_u, s_p = tr_u.init(0), tr_p.init(0)
+    for i in range(4):
+        s_u, _ = tr_u.train_step(s_u, sb[i])
+        s_p, _ = tr_p.train_step(s_p, sb[i])
+    s_p, _ = adopt(tr_p, s_p)
+    stacked = shard_batch(mesh, stack_batches(batches[4:7]), stacked=True)
+    s_u, m_u = tr_u.train_steps(s_u, stacked)
+    s_p, m_p = tr_p.train_steps(s_p, stacked)
+    np.testing.assert_array_equal(
+        np.asarray(m_u["loss"]), np.asarray(m_p["loss"])
+    )
+    assert_same_rows(tr_u, s_u, tr_p, s_p)
+
+
+# ------------------------------------------------------- hot-key fallback
+
+
+def test_plan_owner_device_host_parity():
+    """`plan_owner` (device, consulted inside shard_map) and
+    `ShardPlan.owner_np` (host, used by restore + migration) must agree
+    bit-for-bit — a disagreement strands migrated rows."""
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 30, 4096).astype(np.int32)
+    plan = P.ShardPlan(
+        num_shards=8, sentinel=-1, offset=3,
+        hot_keys=tuple(int(k) for k in keys[:5]),
+        hot_owners=(0, 7, 3, 3, 1),
+    )
+    # device side consults the sentinel-PADDED routing table (bundles pad
+    # every member to a common H)
+    leaves = plan.leaves(np.int32, pad_h=12)
+    dev = np.asarray(P.plan_owner(jnp.asarray(keys), 8, leaves))
+    host = plan.owner_np(keys)
+    np.testing.assert_array_equal(dev, host)
+    # hot keys take their table entry...
+    np.testing.assert_array_equal(host[:5], [0, 7, 3, 3, 1])
+    # ...every other key its rotated hash-home (H exceeded -> fallback)
+    rest = keys[5:]
+    np.testing.assert_array_equal(
+        host[5:], (hashing.hash_shard_np(rest, 8) + 3) % 8
+    )
+
+
+def test_empty_plan_is_uniform_hash():
+    keys = np.arange(100, dtype=np.int32)
+    for leaves in (None, {}):
+        np.testing.assert_array_equal(
+            np.asarray(P.plan_owner(jnp.asarray(keys), 8, leaves)),
+            hashing.hash_shard_np(keys, 8),
+        )
+    assert P.ShardPlan(num_shards=8, sentinel=-1).is_uniform
+
+
+def test_build_plans_hot_budget_and_balance():
+    """The placer respects the hot budget (overflow falls back to the
+    rotation — no key is ever dropped from routing), only promotes keys
+    present on >1 source shard, and reduces modeled imbalance."""
+    rng = np.random.default_rng(1)
+    members = []
+    for t in range(4):
+        n = 400
+        keys = rng.choice(1 << 20, n, replace=False).astype(np.int32)
+        weight = np.ones(n)
+        weight[:20] = 8.0  # zipf head: on every source shard
+        members.append(P.MemberTraffic(
+            bundle=f"b{t}", member=0, keys=keys, weight=weight,
+            row_bytes=64.0, sentinel=-1,
+        ))
+    plans, report = P.build_plans(8, members, hot_budget=6)
+    for m in members:
+        p = plans[(m.bundle, 0)]
+        assert len(p.hot_keys) <= 6
+        # every hot key has weight > 1 (worth moving)
+        w = dict(zip(m.keys.tolist(), m.weight.tolist()))
+        assert all(w[k] > 1.0 for k in p.hot_keys)
+        # non-hot keys route by rotation — budget overflow = fallback
+        rest = np.array(
+            [k for k in m.keys if k not in set(p.hot_keys)], np.int32
+        )
+        np.testing.assert_array_equal(
+            p.owner_np(rest),
+            (hashing.hash_shard_np(rest, 8) + p.offset) % 8,
+        )
+    assert report["imbalance_after"] <= report["imbalance_before"]
+    # modeled_loads under the returned plans reproduces the report
+    after = P.modeled_loads(8, members, plans)
+    from deeprec_tpu.ops.traffic import shard_imbalance
+
+    assert round(shard_imbalance(after), 4) == report["imbalance_after"]
+
+
+def test_reshard_failure_leaves_state_untouched(mesh):
+    """A plan that cannot place every key (shard over local capacity)
+    must abort the migration — update_placement keeps the old plan and
+    the caller's state."""
+    from deeprec_tpu.embedding.table import empty_key
+
+    tr = ShardedTrainer(
+        WDL(emb_dim=8, capacity=1 << 9, hidden=(16,), num_cat=4,
+            num_dense=2),
+        Adagrad(lr=0.1), optax.sgd(0.01), mesh=mesh, placement="plan",
+    )
+    st = tr.init(0)
+    for b in skewed_batches(2, batch_size=256, seed=3):
+        st, _ = tr.train_step(st, shard_batch(mesh, b))
+    bname, b = next(iter(tr.bundles.items()))
+    ts = st.tables[bname]
+    lead = tr._bundle_lead_dims(b)
+    members = [
+        jax.tree.map(lambda a, i=i: a[i], ts) for i in np.ndindex(*lead)
+    ]
+    shard_states = members[: tr.num_shards]
+    sent = empty_key(b.table.cfg)
+    total = sum(
+        int(np.sum(np.asarray(s.keys) != sent)) for s in shard_states
+    )
+    assert total > int(shard_states[0].keys.shape[0]), "not enough rows"
+    res, moved, reason = P.reshard_members(
+        b.table, shard_states,
+        lambda keys: np.zeros(len(np.asarray(keys)), np.int32),  # all -> 0
+    )
+    assert res is None and moved == 0
+    assert "capacity" in reason or "overflow" in reason
+
+
+def test_multi_tier_bundle_is_never_replanned(mesh):
+    """hbm_dram tables keep demoted rows in per-shard tier stores the
+    migration cannot move — update_placement must pin them to uniform
+    routing (skipped: multi_tier), even under force."""
+    from deeprec_tpu import EmbeddingVariableOption, StorageOption
+
+    ev = EmbeddingVariableOption(
+        storage=StorageOption(storage_type="hbm_dram")
+    )
+    tr = ShardedTrainer(
+        WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=4,
+            num_dense=2, ev=ev),
+        Adagrad(lr=0.1), optax.sgd(0.01), mesh=mesh, placement="plan",
+    )
+    st = tr.init(0)
+    for b in skewed_batches(3, batch_size=128):
+        st, _ = tr.train_step(st, shard_batch(mesh, b))
+    st, rep = tr.update_placement(st, force=True)
+    assert all(r == {"adopted": False, "skipped": "multi_tier"}
+               for r in rep.values()), rep
+    assert not tr._plans
+    # and the routing fingerprint stays uniform for checkpoint purposes
+    assert all(tr.routing_fingerprint(bn) == "uniform" for bn in tr.bundles)
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_per_shard_dedup_stats(mesh):
+    """Exchange skew is observable from a live TrainState: per mesh
+    position owner-unique / arrivals / modeled exchange bytes + max/mean
+    imbalance, reset on the update_budgets window like the dedup
+    counters."""
+    batches = skewed_batches(3, batch_size=128)
+    tr = build(mesh)
+    st = tr.init(0)
+    for b in batches:
+        st, _ = tr.train_step(st, shard_batch(mesh, b))
+    stats = tr.dedup_stats(st)
+    assert stats, "no tables reported"
+    for t, d in stats.items():
+        ps = d["per_shard"]
+        assert len(ps["owner_unique"]) == 8
+        assert len(ps["exchange_bytes"]) == 8
+        assert sum(ps["owner_unique"]) > 0
+        assert sum(ps["owner_arrivals"]) >= sum(ps["owner_unique"])
+        assert ps["imbalance"] >= 1.0
+    # window reset: counters zero after update_budgets
+    st, _ = tr.update_budgets(st)
+    for t, d in tr.dedup_stats(st).items():
+        assert sum(d["per_shard"]["owner_arrivals"]) == 0
+    # the single-device trainer has no shard axis -> no per_shard key
+    tr1 = Trainer(model(), Adagrad(lr=0.1))
+    s1 = tr1.init(0)
+    s1, _ = tr1.train_step(s1, batches[0])
+    assert all("per_shard" not in d for d in tr1.dedup_stats(s1).values())
+
+
+# ------------------------------------------------------- checkpoint round
+
+
+def test_checkpoint_roundtrip_across_plan_change(mesh, tmp_path):
+    """Save under plan A, restore under plan B (and the reverse): rows
+    must land on the shard where the RESTORING trainer's active plan will
+    look them up, and training after the restore must match the saved
+    trainer bit-exactly."""
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    batches = skewed_batches(6)
+    sb = [shard_batch(mesh, b) for b in batches]
+
+    # trainer A: uniform plan, train, save
+    tr_a = build(mesh, "uniform")
+    s_a = tr_a.init(0)
+    for i in range(4):
+        s_a, _ = tr_a.train_step(s_a, sb[i])
+    ck_a = CheckpointManager(str(tmp_path / "ck"), tr_a)
+    s_a, _ = ck_a.save(s_a)
+
+    # trainer B: non-uniform plan adopted from its own counters
+    tr_b = build(mesh, "plan")
+    s_b = tr_b.init(0)
+    for i in range(4):
+        s_b, _ = tr_b.train_step(s_b, sb[i])
+    s_b, _ = adopt(tr_b, s_b)
+
+    # uniform-saved checkpoint restores into the plan-B topology
+    ck_b = CheckpointManager(str(tmp_path / "ck"), tr_b)
+    r_b = ck_b.restore()
+    assert_same_rows(tr_a, s_a, tr_b, r_b)
+    # ...and every restored key is where plan B routes it
+    from deeprec_tpu.embedding.table import empty_key
+
+    for ref, plan in tr_b._plans.items():
+        b = tr_b.bundles[ref]
+        ts = r_b.tables[ref]
+        sent = empty_key(b.table.cfg)
+        keys = np.asarray(jax.device_get(ts.keys))
+        lead = keys.shape[:-1]
+        for idx in np.ndindex(*lead):
+            m = idx[0] if len(idx) == 2 else 0
+            shard = idx[-1]
+            k_loc = keys[idx]
+            live = k_loc[k_loc != sent]
+            if live.size:
+                np.testing.assert_array_equal(
+                    plan.member(m).owner_np(live),
+                    np.full(live.size, shard),
+                )
+    # training resumes bit-exactly on both sides
+    for i in range(4, 6):
+        s_a, m_a = tr_a.train_step(s_a, sb[i])
+        r_b, m_b = tr_b.train_step(r_b, sb[i])
+        assert float(m_a["loss"]) == float(m_b["loss"])
+    assert_same_rows(tr_a, s_a, tr_b, r_b)
+
+    # reverse direction: save under plan B, restore under uniform C
+    ck_b2 = CheckpointManager(str(tmp_path / "ck_b"), tr_b)
+    r_b, _ = ck_b2.save(r_b)
+    tr_c = build(mesh, "uniform")
+    ck_c = CheckpointManager(str(tmp_path / "ck_b"), tr_c)
+    r_c = ck_c.restore()
+    assert_same_rows(tr_b, r_b, tr_c, r_c)
+    s_cont_b, m_b = tr_b.train_step(r_b, sb[0])
+    s_cont_c, m_c = tr_c.train_step(r_c, sb[0])
+    assert float(m_b["loss"]) == float(m_c["loss"])
+    assert_same_rows(tr_b, s_cont_b, tr_c, s_cont_c)
+
+
+def test_cbf_sketch_rebuilds_across_plan_change(mesh, tmp_path):
+    """A saved per-shard CBF sketch describes the rows save-time ROUTING
+    put on that shard. Restoring under a DIFFERENT plan must not reuse it
+    shard-for-shard (the manifest routing fingerprint gates it) — the
+    sketches rebuild from the rows each shard imports, so every ADMITTED
+    key's count stays exact on the shard that now owns it."""
+    from deeprec_tpu.config import CBFFilter, EmbeddingVariableOption
+    from deeprec_tpu.embedding import filters as F
+    from deeprec_tpu.embedding.table import empty_key
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    ev = EmbeddingVariableOption(
+        cbf_filter=CBFFilter(filter_freq=2, max_element_size=1 << 12)
+    )
+    batches = skewed_batches(4, batch_size=256)
+    sb = [shard_batch(mesh, b) for b in batches]
+
+    def mk(placement):
+        return ShardedTrainer(
+            WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=4,
+                num_dense=2, ev=ev),
+            Adagrad(lr=0.1), optax.sgd(0.01), mesh=mesh,
+            placement=placement, placement_hot_budget=16,
+        )
+
+    tr_a = mk("uniform")
+    s_a = tr_a.init(0)
+    for b in sb:
+        s_a, _ = tr_a.train_step(s_a, b)
+    ck_a = CheckpointManager(str(tmp_path / "cbf"), tr_a)
+    s_a, _ = ck_a.save(s_a)
+
+    tr_b = mk("plan")
+    s_b = tr_b.init(0)
+    for b in sb:
+        s_b, _ = tr_b.train_step(s_b, b)
+    s_b, _ = adopt(tr_b, s_b)
+    assert tr_b.routing_fingerprint(
+        next(iter(tr_b.bundles))
+    ) != "uniform"
+    r_b = CheckpointManager(str(tmp_path / "cbf"), tr_b).restore()
+
+    # every shard's sketch must cover each of ITS OWN admitted keys'
+    # full count (CBF estimates over-count on collisions, never under) —
+    # shard-for-shard reuse of the save-time sketches would query
+    # re-routed keys against another shard's counts and UNDER-count them
+    cbf = ev.cbf_filter
+    for bname, b in tr_b.bundles.items():
+        ts = r_b.tables[bname]
+        sent = empty_key(b.table.cfg)
+        keys = np.asarray(jax.device_get(ts.keys))
+        freq = np.asarray(jax.device_get(ts.freq))
+        bloom = np.asarray(jax.device_get(ts.bloom))
+        lead = keys.shape[:-1]
+        for idx in np.ndindex(*lead):
+            k_loc = keys[idx]
+            occ = k_loc != sent
+            if not occ.any():
+                continue
+            est = np.asarray(F.cbf_estimate(
+                cbf, jnp.asarray(bloom[idx]), jnp.asarray(k_loc[occ])
+            ))
+            under = est < freq[idx][occ]
+            assert not under.any(), (
+                f"{bname}{idx}: {int(under.sum())} admitted keys "
+                f"under-counted after cross-plan restore"
+            )

@@ -147,6 +147,66 @@ def assert_overlap(json_path: str, tol: float) -> int:
     return rc
 
 
+def assert_imbalance(json_path: str, factor: float, tol: float) -> int:
+    """CI gate for the skew-aware placement arm (bench.py 'placement'
+    section): on the skewed multi-table workload the adopted ShardPlan
+    must cut the measured per-shard exchange-bytes imbalance (max/mean,
+    ops/traffic.py shard_imbalance) by at least `factor` vs the uniform
+    hash, with the plan arm's step time no worse than the uniform arm's
+    beyond `tol` (re-routing hot keys and rotating owners must not buy
+    balance with a slower step). The same counters back
+    Trainer.dedup_stats()['per_shard'], so a violation here means live
+    telemetry regressed too."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    pl = rec.get("placement")
+    if not pl:
+        print(f"roofline: {json_path} has no 'placement' record "
+              "(run bench.py with --placement)", file=sys.stderr)
+        return 1
+    if pl.get("error"):
+        print(f"roofline: placement arm failed: {pl['error']}",
+              file=sys.stderr)
+        return 1
+    if "imbalance_after" not in pl:
+        print("roofline: placement record has no plan arm "
+              f"(mode={pl.get('mode')!r}) — run --placement grid",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    before, after = pl["imbalance_before"], pl["imbalance_after"]
+    if after * factor > before:
+        print(
+            f"roofline: placement gate FAILED — imbalance {before:.3f} -> "
+            f"{after:.3f} is under the required {factor:.1f}x reduction "
+            f"(the plan no longer flattens the skewed workload)",
+            file=sys.stderr,
+        )
+        rc = 1
+    ms = pl.get("step_ms", {})
+    if "uniform" in ms and "plan" in ms and \
+            ms["plan"] > ms["uniform"] * (1.0 + tol):
+        print(
+            f"roofline: placement gate FAILED — plan step "
+            f"{ms['plan']:.3f} ms vs uniform {ms['uniform']:.3f} ms "
+            f"(bound {1.0 + tol:.2f}x): the routing table / migration "
+            f"overhead outweighs the balance win",
+            file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: placement gate ok — imbalance {before:.3f} -> "
+            f"{after:.3f} ({before / max(after, 1e-9):.2f}x, bound "
+            f"{factor:.1f}x), step {ms.get('uniform')} -> {ms.get('plan')}"
+            f" ms, moved {pl.get('moved_rows')} rows, "
+            f"{pl.get('hot_keys')} hot keys"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -172,11 +232,27 @@ def main(argv=None):
                         "pipelined arm vs 'off' (default 0.5 — generous "
                         "because single-core CI has no overlap to win and "
                         "real noise; TPU runs should pin it down)")
+    p.add_argument("--assert-imbalance", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the skew-aware "
+                        "placement arm recorded in a bench.py JSON (the "
+                        "plan must cut measured per-shard exchange-bytes "
+                        "imbalance by --imbalance-factor with step time "
+                        "within --imbalance-tol of uniform; CI smoke gate)")
+    p.add_argument("--imbalance-factor", type=float, default=2.0,
+                   help="required max/mean imbalance reduction of the "
+                        "placed plan vs uniform hash (default 2.0)")
+    p.add_argument("--imbalance-tol", type=float, default=0.25,
+                   help="allowed relative plan-arm step-time regression vs "
+                        "the uniform arm (default 0.25 — the skew workload "
+                        "is tiny, single-core CI timing is noisy)")
     args = p.parse_args(argv)
     if args.assert_traffic:
         sys.exit(assert_traffic(args.assert_traffic))
     if args.assert_overlap:
         sys.exit(assert_overlap(args.assert_overlap, args.overlap_tol))
+    if args.assert_imbalance:
+        sys.exit(assert_imbalance(args.assert_imbalance,
+                                  args.imbalance_factor, args.imbalance_tol))
 
     import jax
     import jax.numpy as jnp
