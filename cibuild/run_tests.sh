@@ -13,6 +13,9 @@ cd "$(dirname "$0")/.."
 echo "== native build =="
 make -C deeprec_tpu/native
 
+echo "== static analysis (fast fail: retrace/host-sync/layout/thread-safety lints, docs/analysis.md) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python -m deeprec_tpu.analysis --check
+
 if [[ "${SMOKE:-0}" == "1" ]]; then
   echo "== tests (smoke tier) =="
   env PYTHONPATH= JAX_PLATFORMS=cpu \
@@ -62,6 +65,10 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== skew-aware placement vs uniform hash (imbalance gate fails the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-imbalance /tmp/deeprec_bench_smoke.json
+
+echo "== steady-state retrace gate (compiles inside timed windows fail the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-compiles /tmp/deeprec_bench_smoke.json
 
 echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \

@@ -23,4 +23,6 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_feature_demos.py::test_kafka_streaming_demo \
   tests/test_ckpt_corruption.py::test_corruption_never_raises_into_serving_and_self_heals \
   tests/test_online_loop.py::test_poll_thread_survives_raising_poll_and_recovers \
+  tests/test_analysis.py::test_repo_check_is_green \
+  tests/test_analysis.py::test_trace_guard_catches_reintroduced_per_call_jit_lambda \
   "$@"

@@ -1,0 +1,37 @@
+"""deeprec_tpu.analysis — static lints + runtime trace-guard.
+
+Two halves, one goal: the bug classes this repo's PRs kept rediscovering
+by hand-review (per-call jit retraces, host syncs on the step, lane-
+hostile layouts, unguarded cross-thread access) become executable gates.
+
+  * ``python -m deeprec_tpu.analysis --check``  — AST lint suite
+    (DRT001–DRT006, see lint.py / docs/analysis.md), wired into
+    cibuild/run_tests.sh before pytest.
+  * ``trace_guard(max_compiles=N)``             — runtime compile-budget
+    context manager over jax.monitoring counters.
+  * ``annotations``                             — @not_thread_safe /
+    @guarded_by vocabulary the DRT004 lint reads.
+
+The lint half is pure-AST: it never imports (or executes) the code it
+analyzes, so a syntax-valid tree lints even when its dependencies are
+broken. Note the CLI itself still pays the parent package's jax import
+(``python -m deeprec_tpu.analysis`` executes ``deeprec_tpu/__init__``
+first) — jax must be installed to run it, and the gate costs a jax
+import plus well under a second of actual linting.
+"""
+from deeprec_tpu.analysis.annotations import guarded_by, not_thread_safe
+from deeprec_tpu.analysis.trace_guard import (
+    TraceGuardViolation,
+    compile_count,
+    trace_count,
+    trace_guard,
+)
+
+__all__ = [
+    "guarded_by",
+    "not_thread_safe",
+    "trace_guard",
+    "TraceGuardViolation",
+    "compile_count",
+    "trace_count",
+]

@@ -1,0 +1,60 @@
+"""Thread-safety annotation vocabulary for the static analyzer.
+
+The runtime half is deliberately boring: decorators that stamp metadata
+attributes and return the object unchanged (zero import weight, zero
+call overhead). The value lives in `deeprec_tpu.analysis.lint`, which
+reads the decorators SYNTACTICALLY — annotate a class or method here and
+rule DRT004 starts flagging accesses to it from code launched via
+`threading.Thread` / executor `submit`: @not_thread_safe accesses always
+(only an explicit noqa naming the protocol clears them — a ``with``
+block proves nothing about who else touches the object), @guarded_by
+field writes unless inside ``with <lock>:`` (see docs/analysis.md).
+
+Vocabulary:
+
+``@not_thread_safe``
+    The object has no internal synchronization at all. Touching it from
+    a background thread is only correct under some EXTERNAL serialization
+    protocol (a drain barrier, a single-writer invariant); every such
+    access must carry a ``# noqa: DRT004`` naming that protocol. The
+    canonical instances are ``HostKV``/``DiskKV`` (the tier-IO worker
+    owns them between ``sync_async()`` and ``_settle()`` — the PR 4
+    review class) and ``CheckpointManager``'s write half (at most one
+    writer thread in flight, drained by ``wait()``).
+
+``@guarded_by("lockattr")``
+    The object's FIELDS are protected by ``self.<lockattr>``; its methods
+    take the lock internally and form the thread-safe API. The lint flags
+    direct field writes on instances from thread-launched code outside a
+    ``with <lockattr>:`` block — calling methods is always fine.
+    ``ServingStats`` is the canonical instance.
+"""
+from __future__ import annotations
+
+NOT_THREAD_SAFE_ATTR = "__deeprec_not_thread_safe__"
+GUARDED_BY_ATTR = "__deeprec_guarded_by__"
+
+
+def not_thread_safe(obj):
+    """Mark a class or function as having no internal synchronization."""
+    setattr(obj, NOT_THREAD_SAFE_ATTR, True)
+    return obj
+
+
+def guarded_by(lock_attr: str):
+    """Mark a class whose fields are guarded by ``self.<lock_attr>``."""
+
+    def mark(obj):
+        setattr(obj, GUARDED_BY_ATTR, lock_attr)
+        return obj
+
+    return mark
+
+
+def is_not_thread_safe(obj) -> bool:
+    return bool(getattr(obj, NOT_THREAD_SAFE_ATTR, False))
+
+
+def guard_lock_of(obj):
+    """The guarding lock attribute name, or None."""
+    return getattr(obj, GUARDED_BY_ATTR, None)

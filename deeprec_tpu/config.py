@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import math
-from typing import Optional, Tuple
+from typing import Optional
 
 
 class StorageType(enum.Enum):

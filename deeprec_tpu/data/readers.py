@@ -8,7 +8,6 @@ by the embedding engine, so readers and tables agree on id semantics.
 """
 from __future__ import annotations
 
-import os
 import zlib
 from typing import Dict, Iterator, List, Optional, Sequence
 

@@ -15,12 +15,11 @@ Parity targets:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
-from deeprec_tpu.config import TableConfig
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState
 from deeprec_tpu.utils import hashing
 

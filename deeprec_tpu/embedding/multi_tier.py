@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeprec_tpu.analysis.annotations import not_thread_safe
 from deeprec_tpu.config import StorageType
 from deeprec_tpu.embedding.table import (
     META_FREQ,
@@ -42,6 +43,7 @@ from deeprec_tpu.embedding.table import (
 from deeprec_tpu.native import HostKV
 
 
+@not_thread_safe
 class DiskKV:
     """Log-structured on-disk row store — the SSD tier
     (dram_ssd_storage.h / ssd_hash_kv.h analog). Rows append to a flat
@@ -688,7 +690,7 @@ class MultiTierTable:
                 self.on_io()  # test seam (ordering-based overlap tests)
             if demote_pkg is not None:
                 ext, n_out = demote_pkg
-                self.host.put(
+                self.host.put(  # noqa: DRT004 — worker owns the tier stores until _settle(); every other path drains first
                     np.asarray(ext["keys"])[:n_out].astype(np.int64),
                     np.asarray(ext["rows"])[:n_out],
                     np.asarray(ext["freqs"])[:n_out],
@@ -700,11 +702,11 @@ class MultiTierTable:
             dev_keys = keys_snap[occ].astype(np.int64)
             pending = None
             if len(dev_keys):
-                h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
+                h_vals, h_freq, h_ver, found = self.host.get(dev_keys)  # noqa: DRT004 — read-only promote scan under the same round-exclusive ownership
                 from_disk = np.zeros(len(dev_keys), bool)
                 if self.disk is not None and (~found).any():
                     miss = ~found
-                    d_vals, d_freq, d_ver, d_found = self.disk.get(
+                    d_vals, d_freq, d_ver, d_found = self.disk.get(  # noqa: DRT004 — disk second-chance read, round-exclusive ownership
                         dev_keys[miss]
                     )
                     if d_found.any():
@@ -730,14 +732,14 @@ class MultiTierTable:
                 and len(self.host) > self.host_capacity
             ):
                 n_spill = len(self.host) - self.host_capacity
-                ks, vs, fs, vers = self.host.export()
+                ks, vs, fs, vers = self.host.export()  # noqa: DRT004 — spill export, round-exclusive ownership
                 order = (
                     np.argsort(vers) if self.cache_strategy == "lru"
                     else np.argsort(fs)
                 )
                 out = order[:n_spill]
-                self.disk.put(ks[out], vs[out], fs[out], vers[out])
-                self.host.erase(ks[out])
+                self.disk.put(ks[out], vs[out], fs[out], vers[out])  # noqa: DRT004 — spill write, round-exclusive ownership
+                self.host.erase(ks[out])  # noqa: DRT004 — spill erase, round-exclusive ownership
                 self._spilled_bg = int(n_spill)
         except BaseException as e:
             self._worker_err = e

@@ -2,7 +2,7 @@
 categorical C1-C26 — reference modelzoo/wide_and_deep/train.py et al.)."""
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
 from deeprec_tpu.features import DenseFeature, SparseFeature

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from deeprec_tpu import nn
 from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
-from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.features import SparseFeature
 
 
 @dataclasses.dataclass

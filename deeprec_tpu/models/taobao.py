@@ -6,7 +6,7 @@ from __future__ import annotations
 from typing import List
 
 from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
-from deeprec_tpu.features import DenseFeature, SparseFeature
+from deeprec_tpu.features import SparseFeature
 
 
 def behavior_features(

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from deeprec_tpu import nn
-from deeprec_tpu.config import EmbeddingVariableOption, TableConfig
+from deeprec_tpu.config import EmbeddingVariableOption
 from deeprec_tpu.features import DenseFeature, SparseFeature
 from deeprec_tpu.models.criteo import CRITEO_CAT, CRITEO_DENSE, criteo_features
 

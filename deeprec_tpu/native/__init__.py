@@ -14,6 +14,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from deeprec_tpu.analysis.annotations import not_thread_safe
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libdeeprec_host.so")
 _lib = None
@@ -93,10 +95,16 @@ def _configure(lib):
         ]
 
 
+@not_thread_safe
 class HostKV:
     """int64 key -> (float32[dim] value, freq, version) host store.
 
     Native-backed when the .so is available; numpy-dict fallback otherwise.
+
+    NOT thread-safe (neither backend is): the multi-tier choreography
+    serializes every access behind MultiTierTable._settle() — background
+    rounds own the store exclusively while running. DRT004 (the static
+    analyzer) flags any new cross-thread access path.
     """
 
     def __init__(self, dim: int, initial_capacity: int = 1 << 16):

@@ -13,7 +13,7 @@ DeepFM's FM layer and DLRM's dot interaction.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

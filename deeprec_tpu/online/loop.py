@@ -30,7 +30,6 @@ STEP <n> <loss>, SAVED <kind> <step>, DONE.
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
 import threading
@@ -187,17 +186,23 @@ class TrainLoop:
 
         if state is None:
             state = self.restore_or_init()
-        self._beat(int(state.step), status="running")
+        # Host-side step mirror: train_step advances the device counter by
+        # exactly 1, so ONE sync here seeds a host int and the loop never
+        # blocks on the step scalar again. The previous per-iteration
+        # int(state.step) was a device sync on EVERY step (DRT002) — it
+        # made the host wait for each dispatch to finish before enqueueing
+        # the next, forfeiting the async-dispatch overlap.
+        step = int(state.step)
+        self._beat(step, status="running")
         for batch in self.batches:
-            if (self.max_steps is not None
-                    and int(state.step) >= self.max_steps):
+            if self.max_steps is not None and step >= self.max_steps:
                 break  # a resumed worker may already be at the target
             state, mets = self.trainer.train_step(
                 state, {k: jnp.asarray(v) for k, v in batch.items()}
             )
-            step = int(state.step)
+            step += 1
             if self.log_every and step % self.log_every == 0:
-                self._print(f"STEP {step} {float(mets['loss']):.5f}")
+                self._print(f"STEP {step} {float(mets['loss']):.5f}")  # noqa: DRT002 — log-cadence-gated sync, deliberate
             if step % self.save_every == 0:
                 state = self._save(state, step)
             self._beat(
@@ -225,14 +230,14 @@ class TrainLoop:
         # save, so a clean exit leaves a chain as fresh as training got.
         try:
             self.ckpt.wait()
-            if self.last_save_step != int(state.step):
-                state = self._save(state, int(state.step))
+            if self.last_save_step != step:
+                state = self._save(state, step)
                 self.ckpt.wait()
         except Exception as e:
             self.save_failures += 1
             self.last_save_error = str(e)
             _log.warning("final save failed: %s", e)
-        self._beat(int(state.step), status="done")
+        self._beat(step, status="done")
         self._print("DONE")
         return state, 0
 

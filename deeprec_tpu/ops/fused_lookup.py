@@ -33,7 +33,6 @@ where the in-kernel SR branches are also covered).
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -110,7 +109,7 @@ def _pad_updates(slot_ix, new_rows, block):
     return ixp, new_rows
 
 
-def _compiler_params(pltpu, **kw):
+def _compiler_params(pltpu_mod, **kw):
     """Mosaic compiler params across jax versions: TPUCompilerParams was
     renamed CompilerParams and grew fields over time (has_side_effects is
     absent in older jax — safe to drop there: these kernels' outputs are
@@ -118,7 +117,8 @@ def _compiler_params(pltpu, **kw):
     filtered rather than crashing the whole kernel path."""
     import dataclasses
 
-    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    cls = getattr(pltpu_mod, "CompilerParams", None) \
+        or pltpu_mod.TPUCompilerParams
     names = {f.name for f in dataclasses.fields(cls)}
     return cls(**{k: v for k, v in kw.items() if k in names})
 
@@ -537,7 +537,7 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
         bits_dim = D
     else:
         # f32 path never reads the bits: ship a 1-wide dummy, not U*D zeros.
-        bits = jnp.zeros((Up, 1), jnp.uint32)
+        bits = jnp.zeros((Up, 1), jnp.uint32)  # noqa: DRT003 — deliberate 1-wide dummy: f32 path never reads it, padding beats shipping U*D zeros
         bits_dim = 1
 
     def kernel(ix_ref, rows_ref, bits_ref, vin_ref, vout_ref, scratch, sems):

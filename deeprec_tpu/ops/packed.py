@@ -29,7 +29,6 @@ original unpacked path and callers never branch.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from deeprec_tpu.ops import fused_lookup as _fl

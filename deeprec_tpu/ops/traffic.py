@@ -24,7 +24,7 @@ stays reproducible after the "before" code is gone.
 from __future__ import annotations
 
 import re
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 META_COLS = 3  # freq / version / dirty, int32 each (embedding/table.py)
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-import jax
 import jax.numpy as jnp
 
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup

@@ -23,7 +23,7 @@ arrays, exempt from rebuild row-moves.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

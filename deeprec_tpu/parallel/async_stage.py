@@ -63,9 +63,9 @@ class AsyncShardedTrainer(ShardedTrainer):
 
     def _make_jits(self):
         super()._make_jits()
-        self._bootstrap_jit = jax.jit(self._bootstrap_impl)
-        self._async_step = jax.jit(self._async_impl, donate_argnums=0)
-        self._async_steps = jax.jit(self._async_steps_impl, donate_argnums=0)
+        self._bootstrap_jit = jax.jit(self._bootstrap_impl)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._async_step = jax.jit(self._async_impl, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._async_steps = jax.jit(self._async_steps_impl, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
 
     def _apply_one(self, b, state, res, grad, step, lr):
         # The stale-by-one apply consumes batch t-1's lookup result AFTER

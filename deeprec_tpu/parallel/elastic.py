@@ -81,10 +81,10 @@ class ElasticCoordinator:
     respawns only after every worker of the outgoing generation acked.
     """
 
-    def __init__(self, dir: str):
-        self.dir = dir
+    def __init__(self, directory: str):
+        self.dir = directory
         self._decided: Optional[Tuple[int, int]] = None  # (epoch, target)
-        os.makedirs(dir, exist_ok=True)
+        os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------- autoscaler
 

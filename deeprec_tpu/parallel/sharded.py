@@ -50,7 +50,6 @@ from deeprec_tpu.training.profiler import phase_scope
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup, empty_key
 from deeprec_tpu.optim import apply as optim_apply
 from deeprec_tpu.optim.sparse import SparseOptimizer
-from deeprec_tpu.utils import hashing
 
 
 @struct.dataclass

@@ -29,7 +29,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeprec_tpu.parallel.compat import shard_map
 
-from deeprec_tpu import features as fcol
 from deeprec_tpu.embedding.table import EmbeddingTable
 from deeprec_tpu.optim.apply import ensure_slots
 from deeprec_tpu.parallel import placement as placement_lib
@@ -37,12 +36,8 @@ from deeprec_tpu.parallel.placement import BundlePlan
 from deeprec_tpu.parallel.sharded import ShardedTable
 from deeprec_tpu.training import metrics as M
 from deeprec_tpu.training.trainer import (
-    Bundle,
-    ModelInputs,
     Trainer,
     TrainState,
-    _prep_ids,
-    build_bundles,
     stack_batches,
 )
 
@@ -115,10 +110,10 @@ class ShardedTrainer(Trainer):
     def _make_jits(self):
         # Called by Trainer.__init__ (before self.sharded exists — jit
         # wrapping is lazy) and by update_budgets on a budget change.
-        self._train_step = jax.jit(self._sharded_step, donate_argnums=0)
-        self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)
-        self._train_steps = jax.jit(self._sharded_steps, donate_argnums=0)
-        self._eval_step = jax.jit(self._sharded_eval)
+        self._train_step = jax.jit(self._sharded_step, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._train_step_accum = jax.jit(self._sharded_accum, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._train_steps = jax.jit(self._sharded_steps, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._eval_step = jax.jit(self._sharded_eval)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
 
     def _stage_put(self, batch):
         # auto-stage (Trainer.stage) places batches with mesh sharding so

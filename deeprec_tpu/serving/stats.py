@@ -24,11 +24,13 @@ import threading
 import time
 from typing import Dict
 
+from deeprec_tpu.analysis.annotations import guarded_by
 from deeprec_tpu.training.profiler import LatencyHistogram
 
 STAGES = ("queue", "pad", "device", "post", "e2e")
 
 
+@guarded_by("_lock")
 class ServingStats:
     """Thread-safe aggregate of the serving front's stage timers plus
     batch-shape and error counters."""

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeprec_tpu.analysis.annotations import not_thread_safe
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, empty_key
 from deeprec_tpu.training.trainer import TrainState, Trainer
 from deeprec_tpu.utils import hashing
@@ -921,7 +922,7 @@ class CheckpointManager:
             if self.on_write is not None:
                 self.on_write(plan.path)  # test seam (crash/overlap tests)
             t0 = time.perf_counter()
-            self._write_plan(plan)
+            self._write_plan(plan)  # noqa: DRT004 — single-writer invariant: _save_async drains the previous writer, readers wait() first
             record["write_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
             if plan.kind == "full":
                 self._force_full = False  # chain re-anchored durably
@@ -1058,11 +1059,16 @@ class CheckpointManager:
         np.savez(os.path.join(path, fname), **arrays)
         digests[fname] = {k: _array_digest(v) for k, v in arrays.items()}
 
+    @not_thread_safe
     def _write_plan(self, plan: _SavePlan) -> None:
         """Host half of a save: materialize, write npz files, commit the
         manifest LAST (completeness marker), GC. Runs on the caller (sync)
         or the writer thread (async — single-process only, so every
-        `_sync` below is a no-op there)."""
+        `_sync` below is a no-op there). @not_thread_safe: it mutates the
+        manager's bookkeeping (digest memo, GC state, the checkpoint dir
+        itself) with no lock — the single-writer invariant (at most one
+        writer thread in flight, `_save_async` drains the previous one and
+        every read path calls `wait()` first) is the serialization."""
         path, kind, step = plan.path, plan.kind, plan.step
         write, parts = plan.write, plan.parts
         digests: Dict[str, Dict[str, str]] = {}

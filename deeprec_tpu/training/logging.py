@@ -9,7 +9,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 
 class MetricsLogger:
@@ -20,10 +20,10 @@ class MetricsLogger:
         self._f = open(path, "a", buffering=1)
 
     def log(self, step: int, **scalars: Any) -> None:
-        rec = {"step": int(step), "time": time.time()}
+        rec = {"step": int(step), "time": time.time()}  # noqa: DRT002 — logging surface: deliberate scalar D2H at log cadence
         for k, v in scalars.items():
             try:
-                rec[k] = float(v)
+                rec[k] = float(v)  # noqa: DRT002 — logging surface, same contract as above
             except (TypeError, ValueError):
                 rec[k] = v
         self._f.write(json.dumps(rec) + "\n")

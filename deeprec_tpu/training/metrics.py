@@ -4,7 +4,6 @@ computable online without holding all predictions: histogram-based streaming
 AUC (the same approach tf.metrics.auc uses, with fixed thresholds bins)."""
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from flax import struct
 

@@ -13,7 +13,7 @@ import contextlib
 import os
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator
 
 import jax
 
@@ -77,7 +77,7 @@ class PhaseProfiler:
                 yield
             finally:
                 if block is not None:
-                    jax.block_until_ready(block)
+                    jax.block_until_ready(block)  # noqa: DRT002 — the profiler's purpose: phase attribution requires blocking
                 self._times.setdefault(name, []).append(
                     time.perf_counter() - t0
                 )

@@ -234,13 +234,13 @@ class Trainer:
         resolved budget (update_budgets moving an "auto" bucket) must
         rebuild these — an already-cached executable for the same input
         avals would silently keep its old unique sizes otherwise."""
-        self._train_step = jax.jit(self._step_impl, donate_argnums=0)
-        self._train_step_accum = jax.jit(self._accum_impl, donate_argnums=0)
+        self._train_step = jax.jit(self._step_impl, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._train_step_accum = jax.jit(self._accum_impl, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
         # K-step device loop: jit caches one executable per K (the stacked
         # batch's leading dim is part of the trace signature), so sweeping
         # or changing K recompiles once per value and then amortizes.
-        self._train_steps = jax.jit(self._steps_impl, donate_argnums=0)
-        self._eval_step = jax.jit(self._eval_impl)
+        self._train_steps = jax.jit(self._steps_impl, donate_argnums=0)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
+        self._eval_step = jax.jit(self._eval_impl)  # noqa: DRT001 — deliberate rebuild-on-budget/plan-change; one wrapper serves all steps
 
     # Back-compat/introspection: table object + state accessor per table name.
     @property
@@ -329,7 +329,7 @@ class Trainer:
         else:
             import math
 
-            budget = min(int(math.ceil(frac * n)), self._budget_capacity(b))
+            budget = min(int(math.ceil(frac * n)), self._budget_capacity(b))  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
         return dedup.resolve_size(budget, n)
 
     def _budget_capacity(self, b: Bundle) -> int:
@@ -350,7 +350,7 @@ class Trainer:
 
         if not train:
             return None
-        return self._resolve_budget(b, int(np.prod(ids.shape)))
+        return self._resolve_budget(b, int(np.prod(ids.shape)))  # noqa: DRT002 — np.prod of a static shape tuple, no device value
 
     def _bundle_plan_leaves(self, b: Bundle):
         """Per-bundle placement-plan device constants threaded through the

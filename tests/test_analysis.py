@@ -1,0 +1,509 @@
+"""deeprec_tpu.analysis: lint rules (fixture snippets: positive, negative,
+suppressed per rule), the checked-in baseline's integrity, the noqa/
+baseline gate mechanics, and the runtime trace-guard — including the
+acceptance pins:
+
+  * removing a known `# noqa` from repo source makes `--check` exit
+    nonzero (the gate actually guards the suppressed sites);
+  * trace_guard(max_compiles=0) passes on steady-state K-step training;
+  * trace_guard CATCHES a deliberately re-introduced per-call
+    ``jit(lambda ...)`` retrace — the PR 5 `_prune_to_live` class.
+"""
+import io
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeprec_tpu.analysis import (
+    TraceGuardViolation,
+    annotations,
+    compile_count,
+    trace_guard,
+)
+from deeprec_tpu.analysis import lint
+
+
+# ----------------------------------------------------------- lint harness
+
+
+def lint_files(tmp_path, files, rules=None):
+    """Write {relpath: source} under a temp root, lint it, return
+    (all findings, active findings) as rendered-rule lists."""
+    import os
+
+    targets = set()
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        targets.add(rel.split("/")[0] if "/" in rel else rel)
+    mods = lint.collect_modules(str(tmp_path), sorted(targets))
+    findings = lint.run_rules(mods, rules)
+    active, _ = lint.split_suppressed(mods, findings)
+    return findings, active
+
+
+def codes(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------ DRT001 rule
+
+
+def test_drt001_flags_per_call_jit_of_lambda_and_closure(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import jax
+
+        def hot(x):
+            f = jax.jit(lambda v: v + 1)     # fresh wrapper per call
+            def inner(v):
+                return v * 2
+            g = jax.jit(inner)               # nested closure per call
+            return f(x) + g(x)
+    """}, rules=["DRT001"])
+    assert codes(active) == ["DRT001", "DRT001"]
+
+
+def test_drt001_flags_per_call_jit_of_module_level_function(tmp_path):
+    """jit-ing a STABLE module function per call is the same hazard: each
+    jax.jit() call returns a new wrapper with its own empty cache."""
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import jax
+
+        def prune(state):
+            return state
+
+        def poll(state):
+            return jax.jit(prune)(state)     # fresh wrapper per poll
+    """}, rules=["DRT001"])
+    assert codes(active) == ["DRT001"]
+    assert "fresh wrapper" in active[0].message
+
+
+def test_drt001_negative_module_scope_decorator_and_init(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import jax
+        from functools import partial
+
+        top = jax.jit(lambda v: v + 1)       # module scope: compiles once
+
+        @jax.jit
+        def decorated(v):
+            return v * 2
+
+        @partial(jax.jit, static_argnums=0)
+        def decorated2(k, v):
+            return v * k
+
+        class T:
+            def __init__(self):
+                # idiomatic per-instance compile — allowed
+                self._step = jax.jit(self._impl)
+
+            def _impl(self, v):
+                return v
+    """}, rules=["DRT001"])
+    assert active == []
+
+
+def test_drt001_bound_method_rebuilder_flagged_and_suppressable(tmp_path):
+    files = {"pkg/m.py": """
+        import jax
+
+        class T:
+            def rebuild(self):
+                self._step = jax.jit(self._impl)
+
+            def _impl(self, v):
+                return v
+    """}
+    _, active = lint_files(tmp_path, files, rules=["DRT001"])
+    assert codes(active) == ["DRT001"]
+    files["pkg/m.py"] = files["pkg/m.py"].replace(
+        "self._step = jax.jit(self._impl)",
+        "self._step = jax.jit(self._impl)  # noqa: DRT001 — deliberate",
+    )
+    _, active = lint_files(tmp_path, files, rules=["DRT001"])
+    assert active == []
+
+
+# ------------------------------------------------------------ DRT002 rule
+
+
+HOT_PKG = {"pkg/m.py": """
+    import numpy as np
+
+    class T:
+        def train_step(self, state, batch):
+            return self._helper(state)
+
+        def _helper(self, state):
+            return float(state.loss.item())
+
+    def cold(state):
+        return np.asarray(state)             # unreachable from any root
+"""}
+
+
+def test_drt002_call_graph_reaches_helper_not_cold(tmp_path):
+    _, active = lint_files(tmp_path, HOT_PKG, rules=["DRT002"])
+    assert codes(active) == ["DRT002", "DRT002"]  # .item() and float()
+    assert all(f.scope == "T._helper" for f in active)
+    assert all("cold" not in f.scope for f in active)
+
+
+def test_drt002_scan_body_nested_def_is_reachable(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import numpy as np
+
+        def train_steps(state, batches):
+            def body(carry, b):
+                host = np.asarray(b)         # sync inside the scan body
+                return carry, host
+            return body(state, batches)
+    """}, rules=["DRT002"])
+    assert codes(active) == ["DRT002"]
+    assert "train_steps" in active[0].message
+
+
+def test_drt002_suppressed_site_is_inactive_but_reported(tmp_path):
+    all_f, active = lint_files(tmp_path, {"pkg/m.py": """
+        import numpy as np
+
+        def predict(batch):
+            return np.asarray(batch)  # noqa: DRT002 — result D2H
+    """}, rules=["DRT002"])
+    assert codes(all_f) == ["DRT002"] and active == []
+
+
+# ------------------------------------------------------------ DRT003 rule
+
+
+def test_drt003_small_trailing_dim_and_nonpow2_in_ops_only(tmp_path):
+    _, active = lint_files(tmp_path, {
+        "pkg/ops/k.py": """
+            import jax.numpy as jnp
+
+            def f(C):
+                bad_layout = jnp.zeros((C, 3))      # lane-hostile
+                good_layout = jnp.zeros((3, C))
+                bad_bucket = jnp.zeros((24,))       # non-pow2 static
+                good_bucket = jnp.zeros((32,))
+                return bad_layout, good_layout, bad_bucket, good_bucket
+        """,
+        # identical code OUTSIDE ops//embedding/ is not layout-lintable
+        "pkg/serving/k.py": """
+            import jax.numpy as jnp
+
+            def f(C):
+                return jnp.zeros((C, 3)), jnp.zeros((24,))
+        """,
+    }, rules=["DRT003"])
+    assert codes(active) == ["DRT003", "DRT003"]
+    assert all("ops/k.py" in f.path for f in active)
+
+
+def test_drt003_numpy_host_arrays_not_flagged(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/ops/k.py": """
+        import numpy as np
+
+        def f(C):
+            return np.zeros((C, 3)), np.zeros((24,))   # host memory: fine
+    """}, rules=["DRT003"])
+    assert active == []
+
+
+# ------------------------------------------------------------ DRT004 rule
+
+
+THREADED_PKG = {"pkg/m.py": """
+    import threading
+    from deeprec_tpu.analysis.annotations import guarded_by, not_thread_safe
+
+    @not_thread_safe
+    class Store:
+        def put(self, k, v):
+            pass
+
+    @guarded_by("_lock")
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+    class Owner:
+        def __init__(self):
+            self.store = Store()
+            self.stats = Stats()
+            self._t = threading.Thread(target=self._worker)
+
+        def _worker(self):
+            self.store.put(1, 2)             # NTS from a thread: flagged
+            self.stats.bump()                # guarded METHOD call: fine
+            self.stats.count = 5             # guarded FIELD write: flagged
+            with self.stats._lock:
+                self.stats.count = 6         # lock held: fine
+
+        def main_thread_path(self):
+            self.store.put(3, 4)             # not a thread entry: fine
+"""}
+
+
+def test_drt004_thread_entry_vs_main_and_lock_semantics(tmp_path):
+    _, active = lint_files(tmp_path, THREADED_PKG, rules=["DRT004"])
+    assert codes(active) == ["DRT004", "DRT004"]
+    assert all(f.scope == "Owner._worker" for f in active)
+    msgs = " / ".join(f.message for f in active)
+    assert "not_thread_safe" in msgs and "guarded_by" in msgs
+
+
+def test_drt004_nts_access_flagged_even_under_an_unrelated_lock(tmp_path):
+    """Holding SOME lock proves nothing about who else touches a
+    @not_thread_safe object — only an explicit noqa naming the
+    serialization protocol clears it."""
+    pkg = dict(THREADED_PKG)
+    pkg["pkg/m.py"] = pkg["pkg/m.py"].replace(
+        "self.store.put(1, 2)             # NTS from a thread: flagged",
+        "with self.stats._lock:\n"
+        "                self.store.put(1, 2)  # wrong lock: still flagged",
+    )
+    _, active = lint_files(tmp_path, pkg, rules=["DRT004"])
+    assert [f.rule for f in active
+            if "not_thread_safe" in f.message] == ["DRT004"]
+
+
+def test_drt004_annotated_method_call_from_writer_thread(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import threading
+        from deeprec_tpu.analysis.annotations import not_thread_safe
+
+        class CK:
+            def save_async(self):
+                t = threading.Thread(target=self._writer_main)
+                t.start()
+
+            def _writer_main(self):
+                self._write_plan()           # flagged
+
+            @not_thread_safe
+            def _write_plan(self):
+                pass
+
+            def save_sync(self):
+                self._write_plan()           # main thread: fine
+    """}, rules=["DRT004"])
+    assert codes(active) == ["DRT004"]
+    assert active[0].scope == "CK._writer_main"
+
+
+# ------------------------------------------------- DRT005 / DRT006 hygiene
+
+
+def test_drt005_unused_import_pos_neg_and_init_exempt(tmp_path):
+    _, active = lint_files(tmp_path, {
+        "pkg/m.py": """
+            import os
+            import json
+
+            def f():
+                return json.dumps({})
+        """,
+        "pkg/__init__.py": "from pkg.m import f\nimport os\n",  # re-export surface
+    }, rules=["DRT005"])
+    assert codes(active) == ["DRT005"]
+    assert "'os'" in active[0].message and "m.py" in active[0].path
+
+
+def test_drt006_param_shadowing(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        import json
+
+        def f(id, json, name):
+            return id, json, name
+    """}, rules=["DRT006"])
+    assert sorted(f.message for f in active) == [
+        "parameter 'id' shadows a builtin",
+        "parameter 'json' shadows a module import",
+    ]
+
+
+# ------------------------------------------- repo baseline + gate mechanics
+
+
+def test_repo_check_is_green():
+    """The shipped tree passes its own gate (the CI invariant)."""
+    buf = io.StringIO()
+    assert lint.check(out=buf) == 0, buf.getvalue()
+
+
+def test_baseline_parses_and_every_entry_is_current():
+    """Baseline integrity: each entry matches the fingerprint grammar AND
+    still corresponds to a real finding in the tree — a stale entry (the
+    finding was fixed but the baseline still lists it) must fail."""
+    import re
+
+    base = lint.load_baseline(lint.default_baseline_path())
+    assert base, "baseline should carry the pre-existing DRT002 sites"
+    gram = re.compile(r"^DRT\d{3}\|[^|]+\.py\|[^|]+\|.*$")
+    for entry in base:
+        assert gram.match(entry), f"malformed baseline entry: {entry}"
+    mods = lint.collect_modules(lint.repo_root(), lint.DEFAULT_TARGETS)
+    active, _ = lint.split_suppressed(mods, lint.run_rules(mods))
+    current = set(lint.fingerprints(active))
+    stale = set(base) - current
+    assert not stale, f"stale baseline entries: {sorted(stale)[:5]}"
+
+
+def test_removing_a_known_noqa_fails_the_check():
+    """Acceptance pin: the suppressed sites are live gates, not comments —
+    stripping one justification noqa from real repo source flips the CLI
+    to nonzero with the right finding."""
+    path = "deeprec_tpu/embedding/multi_tier.py"
+    src = open(lint.repo_root() + "/" + path, encoding="utf-8").read()
+    marker = ("  # noqa: DRT004 — worker owns the tier stores until "
+              "_settle(); every other path drains first")
+    assert marker in src, "known suppressed site moved — update this pin"
+    buf = io.StringIO()
+    rc = lint.check(source_overrides={path: src.replace(marker, "", 1)},
+                    out=buf)
+    assert rc != 0
+    assert "DRT004" in buf.getvalue()
+    assert "_worker_main" in buf.getvalue()
+
+
+def test_new_violation_fails_and_fix_baseline_would_accept(tmp_path):
+    """A brand-new hot-path sync in real repo source fails --check; the
+    failure names the file and rule."""
+    path = "deeprec_tpu/serving/predictor.py"
+    src = open(lint.repo_root() + "/" + path, encoding="utf-8").read()
+    anchor = "    def predict(self, batch: Dict[str, np.ndarray], " \
+             "group_users: bool = False):\n" \
+             '        """Probabilities for one batch (dict keyed per ' \
+             'task for MTL)."""\n'
+    assert anchor in src
+    bad = anchor + "        _ = np.asarray(batch)\n"
+    buf = io.StringIO()
+    rc = lint.check(source_overrides={path: src.replace(anchor, bad, 1)},
+                    out=buf)
+    assert rc != 0
+    out = buf.getvalue()
+    assert "NEW finding" in out and "DRT002" in out and "predictor.py" in out
+
+
+def test_stale_baseline_entry_fails_check(tmp_path):
+    """An entry for a finding that no longer exists must fail (the
+    baseline can never rot silently)."""
+    stale_baseline = tmp_path / "baseline.txt"
+    base = lint.load_baseline(lint.default_baseline_path())
+    stale_baseline.write_text(
+        "\n".join(base + ["DRT002|deeprec_tpu/gone.py|f|x = y.item()"])
+        + "\n"
+    )
+    buf = io.StringIO()
+    rc = lint.check(baseline_path=str(stale_baseline), out=buf)
+    assert rc != 0
+    assert "STALE" in buf.getvalue()
+
+
+def test_annotations_runtime_metadata():
+    @annotations.not_thread_safe
+    class A:
+        pass
+
+    @annotations.guarded_by("_lock")
+    class B:
+        pass
+
+    assert annotations.is_not_thread_safe(A)
+    assert not annotations.is_not_thread_safe(B)
+    assert annotations.guard_lock_of(B) == "_lock"
+    assert annotations.guard_lock_of(A) is None
+
+
+# ----------------------------------------------------------- trace guard
+
+
+def tiny_trainer():
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    model = WDL(emb_dim=4, capacity=512, hidden=(8,), num_cat=3,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1))
+    gen = SyntheticCriteo(batch_size=32, num_cat=3, num_dense=2, vocab=300,
+                          seed=7)
+    batches = [
+        {k: jnp.asarray(v) for k, v in gen.batch().items()} for _ in range(4)
+    ]
+    return tr, batches
+
+
+def test_trace_guard_steady_state_k_step_training_is_compile_free():
+    """Acceptance pin: after the warmup dispatch, K-step training
+    compiles NOTHING — the whole multi-step loop is cache-hit dispatch."""
+    from deeprec_tpu.training import stack_batches
+
+    tr, batches = tiny_trainer()
+    state = tr.init(0)
+    stacked = [stack_batches(batches[:2]), stack_batches(batches[2:])]
+    for s in stacked:  # warmup: compiles the K path once
+        state, mets = tr.train_steps(state, s)
+    jax.block_until_ready(mets["loss"])
+    with trace_guard(max_compiles=0, note="steady-state K-step") as g:
+        for _ in range(2):
+            for s in stacked:
+                state, mets = tr.train_steps(state, s)
+        jax.block_until_ready(mets["loss"])
+    assert g.compiles == 0
+
+
+def test_trace_guard_catches_reintroduced_per_call_jit_lambda():
+    """Acceptance pin: the PR 5 retrace class — a jit wrapper rebuilt per
+    call (here the literal `jit(lambda ...)`) — is CAUGHT, with the
+    compile count surfaced on the exception."""
+    x = jnp.ones((8,))
+    jax.block_until_ready(jax.jit(lambda v: v * 2)(x))  # unrelated warm
+    with pytest.raises(TraceGuardViolation) as ei:
+        with trace_guard(max_compiles=0, note="retrace regression"):
+            for _ in range(3):
+                # the buggy shape: a fresh callable every iteration, so
+                # the jit cache can never hit — exactly what the eager
+                # _prune_to_live closure did on every delta replay
+                jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+    assert ei.value.compiles >= 3
+    assert ei.value.max_compiles == 0
+    assert "retrace regression" in str(ei.value)
+
+
+def test_trace_guard_budget_and_measure_only_modes():
+    x = jnp.ones((16,))
+
+    def fresh_program(i):
+        # one REAL compile per distinct static shape
+        return jax.jit(lambda v: v[: i + 1] * 3)(x)
+
+    with trace_guard(max_compiles=2) as g:
+        jax.block_until_ready(fresh_program(3))
+    assert g.compiles <= 2
+    # measure-only: never raises no matter how many compiles land
+    with trace_guard(max_compiles=None) as g:
+        jax.block_until_ready(fresh_program(5))
+        jax.block_until_ready(fresh_program(7))
+    assert g.compiles >= 1
+    assert compile_count() >= g.compiles
+
+
+def test_trace_guard_does_not_mask_body_exceptions():
+    with pytest.raises(ValueError, match="body failed"):
+        with trace_guard(max_compiles=0):
+            jax.jit(lambda v: v * 9)(jnp.ones((4,)))  # would violate
+            raise ValueError("body failed")

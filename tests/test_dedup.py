@@ -343,6 +343,38 @@ def test_auto_budget_engages_compiled_step_and_eval_stays_exact():
     assert len(np.unique(inv)) == 64
 
 
+def test_update_budgets_rebuild_recompiles_then_runs_steady():
+    """The PR 2 stale-executable contract, pinned as a compile budget
+    (analysis/trace_guard.py): steady-state training after warmup
+    compiles NOTHING; update_budgets engaging a new budget bucket
+    REBUILDS the jitted step (the next dispatch really compiles — a
+    stale executable would be a silent cache hit at the old U); and the
+    rebuilt step is itself steady afterwards."""
+    from deeprec_tpu.analysis import trace_guard
+
+    batches = _batches()
+    tr = Trainer(_model(), Adagrad(lr=0.1), unique_budget="auto")
+    s = tr.init(0)
+    s, m = tr.train_step(s, batches[0])  # warmup: compiles the U=N step
+    jax.block_until_ready(m["loss"])
+    with trace_guard(max_compiles=0, note="pre-budget steady state"):
+        for b in batches:
+            s, m = tr.train_step(s, b)
+        jax.block_until_ready(m["loss"])
+    s, _ = tr.update_budgets(s)  # budget bucket engages -> jits rebuilt
+    with trace_guard(max_compiles=None) as g:
+        s, m = tr.train_step(s, batches[0])
+        jax.block_until_ready(m["loss"])
+    assert g.compiles > 0, (
+        "update_budgets engaged a budget but the next dispatch compiled "
+        "nothing — the stale pre-budget executable is still serving"
+    )
+    with trace_guard(max_compiles=0, note="post-budget steady state"):
+        for b in batches:
+            s, m = tr.train_step(s, b)
+        jax.block_until_ready(m["loss"])
+
+
 def test_maintain_reports_dedup_and_resets():
     batches = _batches()
     tr = Trainer(_model(), Adagrad(lr=0.1), unique_budget="auto")

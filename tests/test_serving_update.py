@@ -561,3 +561,33 @@ def test_adaptive_wait_policy(tmp_path):
         assert tau == pytest.approx(0.010) and rows == 4.0
     finally:
         server.close()
+
+
+# ------------------------------------------------------------ trace guard
+
+
+def test_poll_updates_no_evict_delta_replay_is_trace_free(tmp_path):
+    """The PR 5 _prune_to_live incident, pinned forever as a hard compile
+    budget (analysis/trace_guard.py): replaying a no-evict delta through
+    poll_updates — next to hypothetical live traffic — must be pure
+    cache-hit dispatch. warm_replay() precompiled the chunked-import and
+    prune programs at Predictor init; anything compiling inside this
+    region is a GIL-held XLA trace on the serving update path, the exact
+    class that produced 45–115 ms request stalls per delta."""
+    from deeprec_tpu.analysis import trace_guard
+
+    model, tr, st, ck, batches = make_trained(tmp_path)
+    p = Predictor(model, str(tmp_path))
+    req = strip_labels(batches[0])
+    p.predict(req)  # warm the predict path for the shape being served
+    # Prime one replay round: one-time host->device transfer machinery
+    # and the warm pass against the shape above land here, not in the
+    # guarded round.
+    st = advance_delta(tr, st, ck, batches)
+    assert p.poll_updates() is True
+    st = advance_delta(tr, st, ck, batches)
+    with trace_guard(max_compiles=0, note="no-evict delta replay") as g:
+        assert p.poll_updates() is True
+        p.predict(req)  # serving from the swapped state: still cache-hit
+    assert g.compiles == 0
+    assert p.version >= 2

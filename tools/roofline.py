@@ -207,6 +207,47 @@ def assert_imbalance(json_path: str, factor: float, tol: float) -> int:
     return rc
 
 
+def assert_compiles(json_path: str, budget: int) -> int:
+    """CI gate for the steady-state retrace contract (bench.py
+    'trace_guard' section, analysis/trace_guard.py): after each arm's
+    warmup window, the timed measurement loops must compile ZERO new XLA
+    programs. A nonzero count means something inside the measured step
+    re-traces per call (a fresh jit wrapper, an unstable cache key, an
+    unwarmed shape) — the DRT001/PR 5 class — and every throughput
+    number in the file was measured through compile stalls."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    tg = rec.get("trace_guard")
+    if not tg:
+        print(f"roofline: {json_path} has no 'trace_guard' record "
+              "(bench.py too old?)", file=sys.stderr)
+        return 1
+    total = tg.get("steady_state_compiles")
+    if total is None:
+        print("roofline: trace_guard record has no steady_state_compiles",
+              file=sys.stderr)
+        return 1
+    if total > budget:
+        bad = {a: n for a, n in tg.get("per_arm", {}).items() if n}
+        print(
+            f"roofline: steady-state compile gate FAILED — {total} XLA "
+            f"compile(s) inside timed windows (budget {budget}): {bad} — "
+            "something in the measured step retraces per call; run the "
+            "static analyzer (python -m deeprec_tpu.analysis --check) "
+            "and check for fresh jit wrappers on the hot path",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"roofline: steady-state compile gate ok — 0 compiles across "
+        f"{len(tg.get('per_arm', {}))} timed arm(s) "
+        f"(budget {budget})"
+    )
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -232,6 +273,15 @@ def main(argv=None):
                         "pipelined arm vs 'off' (default 0.5 — generous "
                         "because single-core CI has no overlap to win and "
                         "real noise; TPU runs should pin it down)")
+    p.add_argument("--assert-compiles", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the steady-state "
+                        "compile counts recorded in a bench.py JSON "
+                        "(trace_guard section; every timed arm must have "
+                        "compiled nothing after its warmup — CI smoke "
+                        "gate, exits nonzero on drift)")
+    p.add_argument("--compiles-budget", type=int, default=0,
+                   help="allowed total steady-state compiles across arms "
+                        "(default 0 — the contract is exactly zero)")
     p.add_argument("--assert-imbalance", metavar="BENCH_JSON", default=None,
                    help="don't run the step: validate the skew-aware "
                         "placement arm recorded in a bench.py JSON (the "
@@ -250,6 +300,9 @@ def main(argv=None):
         sys.exit(assert_traffic(args.assert_traffic))
     if args.assert_overlap:
         sys.exit(assert_overlap(args.assert_overlap, args.overlap_tol))
+    if args.assert_compiles:
+        sys.exit(assert_compiles(args.assert_compiles,
+                                 args.compiles_budget))
     if args.assert_imbalance:
         sys.exit(assert_imbalance(args.assert_imbalance,
                                   args.imbalance_factor, args.imbalance_tol))

@@ -12,7 +12,6 @@ Usage: python tools/shrink_ckpt.py <ckpt_dir>/full-<N> --min_freq 5 [--out DIR]
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import shutil
