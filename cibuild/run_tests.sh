@@ -42,8 +42,13 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --traffic --smoke
 echo "== checkpoint choreography microbench (CPU smoke: sync + async paths) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
 
-echo "== serving bench (CPU smoke: single + group dispatch, delta update mid-load, /v1/stats) =="
-env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke
+echo "== serving bench (CPU smoke: group dispatch + 2-process socket tier + int8 residency + grouped two-tower, delta updates mid-load, /v1/stats) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke \
+    --out /tmp/deeprec_serving_smoke.json
+
+echo "== serving scale-out / quantized residency / grouped gates (drift fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-serving /tmp/deeprec_serving_smoke.json
 
 echo "== freshness bench (CPU smoke: online loop, trainer SIGKILL + supervised restart, zero failed requests) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke

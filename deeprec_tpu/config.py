@@ -264,7 +264,14 @@ class TableConfig:
     dim: int
     capacity: int = 1 << 16
     key_dtype: str = "int32"  # int32 | int64 (int64 requires jax x64)
-    value_dtype: str = "float32"  # float32 | bfloat16
+    # Residency dtype of the value rows. float32/bfloat16 are full
+    # train+serve dtypes (bf16 writes stochastic-round). "int8" is a
+    # SERVING-ONLY residency (train fp32, serve quantized): rows store as
+    # int8 with a per-row fp32 scale (TableState.qscale), dequantized in
+    # the lookup gather; checkpoint restore quantizes on import
+    # (import_rows). Train-mode lookups on an int8 table raise — the
+    # Predictor(quantize="int8") path is how this gets engaged.
+    value_dtype: str = "float32"  # float32 | bfloat16 | int8 (serve-only)
     combiner: str = "mean"  # mean | sum | sqrtn
     max_probes: int = 64
     # Hot-path kernel choice: "xla" = plain gather/scatter ops, "pallas" =
@@ -318,6 +325,11 @@ class TableConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.packed not in ("auto", "on", "off"):
             raise ValueError(f"unknown packed mode {self.packed!r}")
+        if self.value_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"table {self.name}: value_dtype must be 'float32', "
+                f"'bfloat16' or 'int8', got {self.value_dtype!r}"
+            )
         if self.exchange_dtype not in ("bfloat16", "float32"):
             raise ValueError(
                 f"table {self.name}: exchange_dtype must be 'bfloat16' or "

@@ -78,6 +78,25 @@ def empty_meta(capacity: int) -> jnp.ndarray:
     )
 
 
+# int8 residency quantization range: symmetric, -127..127 (the -128 code is
+# unused so negation is exact and the scale maps max|row| onto the top code).
+QMAX = 127.0
+
+
+def quantize_rows_int8(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row symmetric int8 quantization for the serving residency:
+    returns (q, scale) with rows ≈ q * scale[:, None]. `q` is
+    integer-valued float32 in [-127, 127] (scatter_rows_any casts to the
+    table's int8 on the way in — exact for integer values), `scale` [U]
+    float32 = max|row| / 127, 0 for all-zero rows (which decode to 0)."""
+    rows = jnp.asarray(rows, jnp.float32)
+    amax = jnp.max(jnp.abs(rows), axis=-1)
+    scale = amax / QMAX
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(rows * inv[..., None]), -QMAX, QMAX)
+    return q, scale
+
+
 @struct.dataclass
 class TableState:
     """Device-resident state of one table (a pytree; donate it through jit).
@@ -143,6 +162,14 @@ class TableState:
     owner_unique: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((), jnp.int32)
     )
+    # [C] float32 per-row dequantization scale — present ONLY on int8
+    # serving-residency tables (cfg.value_dtype == "int8"): a stored row
+    # decodes as values[i].astype(f32) * qscale[i]. None everywhere else
+    # (None is an empty pytree node, so fp32/bf16 tables are structurally
+    # unchanged). Written by the checkpoint import (quantize-on-import)
+    # and read by the lookup gathers; rebuild relocates it like any other
+    # per-row array.
+    qscale: Optional[jnp.ndarray] = None
 
     @property
     def capacity(self) -> int:
@@ -219,6 +246,20 @@ class EmbeddingTable:
 
     def __init__(self, cfg: TableConfig):
         self.cfg = cfg
+
+    @property
+    def quantized(self) -> bool:
+        """int8 serving residency: rows store int8 + per-row fp32 scale
+        (TableState.qscale) and every lookup gather dequantizes. Serving
+        only — train-mode lookups raise (train fp32, serve quantized)."""
+        return self.cfg.value_dtype == "int8"
+
+    def _dequant(self, emb: jnp.ndarray, safe_ix: jnp.ndarray,
+                 state: TableState) -> jnp.ndarray:
+        """Decode gathered int8 rows: one [U] scale gather + a broadcast
+        multiply — the whole dequantization cost of the serving path."""
+        scale = state.qscale.at[safe_ix].get(mode="clip")
+        return emb.astype(jnp.float32) * scale[:, None]
 
     @property
     def use_pallas(self) -> bool:
@@ -320,6 +361,9 @@ class EmbeddingTable:
             slots={},
             bloom=bloom,
             insert_fails=jnp.zeros((), jnp.int32),
+            qscale=(
+                jnp.zeros((C,), jnp.float32) if self.quantized else None
+            ),
         )
 
     # ------------------------------------------------------------- initializer
@@ -335,7 +379,10 @@ class EmbeddingTable:
         cfg = self.cfg
         init = cfg.ev.init
         D = cfg.dim
-        vdt = jnp.dtype(cfg.value_dtype)
+        # Quantized tables serve missing-key defaults at full precision:
+        # the initializer row never lives in the int8 residency, it is
+        # computed fresh per lookup, so there is nothing to dequantize.
+        vdt = jnp.float32 if self.quantized else jnp.dtype(cfg.value_dtype)
         if salt is None:
             salt = self.default_salt()
         if init.kind == "constant":
@@ -599,6 +646,12 @@ class EmbeddingTable:
         updated state and a UniqueLookup whose embeddings/rows are 0-sized
         placeholders."""
         cfg = self.cfg
+        if train and self.quantized:
+            raise ValueError(
+                f"table {cfg.name}: int8 residency is serving-only — train "
+                "fp32 and restore into a quantized Predictor "
+                "(Predictor(quantize='int8'))"
+            )
         step = jnp.asarray(step, jnp.int32)
 
         bloom = state.bloom
@@ -688,6 +741,8 @@ class EmbeddingTable:
         documented "no residual, re-gather at apply" sentinel."""
         safe_ix = jnp.where(res.slot_ix >= 0, res.slot_ix, 0)
         emb = self._gather(state.values, safe_ix, state.capacity)
+        if self.quantized:
+            emb = self._dequant(emb, safe_ix, state)
         blocked_default = jnp.asarray(
             self.cfg.ev.init.default_value_no_permission, emb.dtype
         )
@@ -722,9 +777,10 @@ class EmbeddingTable:
         )
         del keys  # unchanged: no creation
         present = slot_ix >= 0
-        emb = self._gather(
-            state.values, jnp.where(present, slot_ix, 0), state.capacity
-        )
+        safe_ix = jnp.where(present, slot_ix, 0)
+        emb = self._gather(state.values, safe_ix, state.capacity)
+        if self.quantized:
+            emb = self._dequant(emb, safe_ix, state)
         emb = jnp.where(present[:, None], emb, self._init_rows(flat, salt))
         emb = jnp.where(is_pad[:, None], 0.0, emb)
         return emb.reshape(*shape, cfg.dim)
@@ -855,6 +911,9 @@ class EmbeddingTable:
             },
             bloom=state.bloom,
             insert_fails=jnp.sum(failed).astype(jnp.int32),
+            qscale=(
+                None if state.qscale is None else move(state.qscale, 0.0)
+            ),
         )
 
     def evict(self, state: TableState, step: jnp.ndarray | int,

@@ -20,6 +20,15 @@ class DSSM:
     num_user_feats: int = 4
     num_item_feats: int = 4
     hidden: Sequence[int] = (256, 128, 64)
+    # Separate user-tower widths (None = same as `hidden`). Production
+    # two-tower models are ASYMMETRIC — the user tower encodes long
+    # behavior histories and dwarfs the item tower (the data-flow
+    # asymmetry PAPERS' "Deep Recommender Models Inference" optimizes,
+    # and what makes serving-side user-tower reuse worth N×: one heavy
+    # user pass scores N candidates through the cheap item tower). The
+    # last width must match `hidden`'s (the towers meet in a dot
+    # product).
+    user_hidden: Sequence[int] = None
     ev: EmbeddingVariableOption = EmbeddingVariableOption()
 
     def __post_init__(self):
@@ -27,6 +36,13 @@ class DSSM:
             return TableConfig(name=name, dim=self.emb_dim, capacity=self.capacity,
                                ev=self.ev)
 
+        if self.user_hidden is None:
+            self.user_hidden = tuple(self.hidden)
+        if tuple(self.user_hidden)[-1:] != tuple(self.hidden)[-1:]:
+            raise ValueError(
+                f"user_hidden must end in the shared tower dim "
+                f"{tuple(self.hidden)[-1]}, got {tuple(self.user_hidden)}"
+            )
         self.user_feats = [f"U{i}" for i in range(self.num_user_feats)]
         self.item_feats = [f"V{i}" for i in range(self.num_item_feats)]
         self.features = [
@@ -37,7 +53,7 @@ class DSSM:
         k1, k2 = jax.random.split(key)
         return {
             "user": nn.mlp_init(k1, self.num_user_feats * self.emb_dim,
-                                list(self.hidden)),
+                                list(self.user_hidden)),
             "item": nn.mlp_init(k2, self.num_item_feats * self.emb_dim,
                                 list(self.hidden)),
             "temp": jnp.asarray(5.0),

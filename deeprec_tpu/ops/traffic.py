@@ -188,6 +188,33 @@ def dlrm_reference_traffic(
     return out
 
 
+# ------------------------------------------------------ serving residency
+
+
+def serving_residency_bytes(
+    *, capacity: int, dim: int, value_dtype: str = "float32",
+) -> float:
+    """Resident HBM bytes of ONE serving table's value storage at a given
+    residency dtype — the quantity `Predictor(quantize=...)` halves/quarters
+    and `roofline.py --assert-serving` pins against the measured arrays:
+
+      float32  : C * D * 4
+      bfloat16 : C * D * 2
+      int8     : C * D * 1  +  C * 4   (per-row fp32 dequant scale)
+
+    Keys/meta are excluded (identical across residencies — the comparison
+    is about the value rows, the term that scales with dim). The packed
+    small-dim layout is byte-neutral ([C//P, P*D] holds the same C*D
+    elements), so the model needs no layout arm."""
+    vb = {"float32": 4, "bfloat16": 2, "int8": 1}
+    if value_dtype not in vb:
+        raise ValueError(f"unknown residency dtype {value_dtype!r}")
+    b = float(capacity) * float(dim) * vb[value_dtype]
+    if value_dtype == "int8":
+        b += float(capacity) * 4  # per-row fp32 scale (TableState.qscale)
+    return float(b)
+
+
 # ---------------------------------------------------------- pipelining model
 
 

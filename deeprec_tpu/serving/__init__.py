@@ -1,4 +1,5 @@
 from deeprec_tpu.serving.predictor import ModelServer, Predictor, ServerGroup
+from deeprec_tpu.serving.frontend import BackendServer, Frontend, spawn_backends
 from deeprec_tpu.serving.http_server import HttpServer
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.serving.remote_store import RemoteKVClient, RemoteKVServer

@@ -1,0 +1,883 @@
+"""Socket-tier serving scale-out: a front tier dispatching coalesced
+batches across N backend serving processes.
+
+The PR 5 `ServerGroup` is an in-process shared-queue dispatcher — one
+member per device, one GIL, one process. This module generalizes that
+dispatcher over a process boundary, the DeepRec SessionGroup story taken
+to its multi-process form (SURVEY §2.4/§3.4): each **backend** is a full
+serving process (Predictor + micro-batching ModelServer + its own
+delta-chain poller, so model updates stay zero-stall per process), and
+the **frontend** is a thin routing tier that speaks a compact
+length-prefixed TCP protocol (the `remote_store.py` idiom) to whichever
+backends are healthy.
+
+Responsibilities split:
+  * Backend — owns a model replica: restore (optionally into a quantized
+    int8/bf16 residency), micro-batch coalescing, `poll_updates` against
+    the shared checkpoint dir (`_run_poll_loop` survivability contract),
+    per-process `/v1/stats`-shaped accounting.
+  * Frontend — owns the client edge: feature parsing, request routing
+    (round-robin for plain requests; user-group hash for `group_users`
+    requests, so one user's `<user, N items>` traffic keeps landing on
+    one backend and its sample-aware batches keep coalescing across the
+    socket split), sibling retry on member failure (a SIGKILLed backend
+    mid-batch costs a retry, never a failed request), member
+    health/backoff, and the merged stats/health surfaces: `/healthz` is
+    the WORST member (plus the frontend's own member-availability view),
+    `/v1/stats` spans every remote member.
+
+Wire protocol (all little-endian, one frame per message):
+  frame    : 4-byte op | u32 body length | body
+  PRED     : body = u8 flags (bit0 = group_users) + npz(features)
+             reply body = npz('__version__', 'predictions' | 'task:<t>'*)
+  HLTH/STAT/INFO/POLL : empty body; reply body = JSON
+  replies  : b"OK  " frame, or b"ERR " frame with JSON
+             {"error": ..., "kind": "bad_request" | "server"}
+
+Run a backend:  python -m deeprec_tpu.serving.frontend --backend \
+                    --model wdl --ckpt DIR --port 0 [--quantize int8]
+Run the tier :  python -m deeprec_tpu.serving.frontend --frontend \
+                    --model wdl --backends host:p1,host:p2 --http-port 8500
+"""
+from __future__ import annotations
+
+import io
+import itertools
+import json
+import random
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from deeprec_tpu.analysis.annotations import guarded_by
+from deeprec_tpu.serving.stats import ServingStats
+from deeprec_tpu.serving.predictor import (
+    BadRequest,
+    _run_poll_loop,
+)
+
+_MAX_FRAME = 256 << 20  # sanity bound on one frame's body
+
+OP_PRED = b"PRED"
+OP_HLTH = b"HLTH"
+OP_STAT = b"STAT"
+OP_POLL = b"POLL"
+OP_INFO = b"INFO"
+_OK = b"OK  "
+_ERR = b"ERR "
+
+_FLAG_GROUP_USERS = 1
+
+
+# ------------------------------------------------------------ frame helpers
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out += chunk
+    return out
+
+
+def _send_frame(wfile, op: bytes, body: bytes) -> None:
+    wfile.write(op + struct.pack("<I", len(body)) + body)
+    wfile.flush()
+
+
+def _pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Dict of numpy arrays -> npz bytes (dtype/shape preserving, no
+    pickle — array payloads only, so a hostile peer can't smuggle
+    objects through the wire format)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})  # noqa: DRT002 — wire serialization of HOST request payloads; no device value crosses here
+    return buf.getvalue()
+
+
+def _unpack_arrays(body: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+# ----------------------------------------------------------------- backend
+
+
+@guarded_by("_conn_lock")
+class BackendServer:
+    """Serve one ModelServer (or ServerGroup) over the socket protocol —
+    the per-process half of the tier. Connections are handled by
+    stdlib threads; every PRED blocks on the model server's coalescing
+    queue, so concurrent frontend connections batch into full device
+    batches exactly like local callers (the socket adds transport, not a
+    second batching policy). `_conns` (the live-connection registry
+    stop() severs) is the only cross-thread field, guarded by
+    `_conn_lock`."""
+
+    def __init__(self, model_server, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def setup(self):
+                super().setup()
+                with outer._conn_lock:
+                    outer._conns.add(self.connection)
+
+            def finish(self):
+                with outer._conn_lock:
+                    outer._conns.discard(self.connection)
+                super().finish()
+
+            def handle(self):
+                while True:
+                    hdr = self.rfile.read(8)
+                    if len(hdr) < 8:
+                        return
+                    op, n = hdr[:4], struct.unpack("<I", hdr[4:])[0]
+                    if n > _MAX_FRAME:
+                        return
+                    body = self.rfile.read(n)
+                    if len(body) < n:
+                        return
+                    try:
+                        out = outer._dispatch(op, body)
+                    except BadRequest as e:
+                        out = (_ERR, json.dumps(
+                            {**e.details, "kind": "bad_request"}).encode())
+                    except Exception as e:  # request-level: keep serving
+                        out = (_ERR, json.dumps(
+                            {"error": str(e), "kind": "server"}).encode())
+                    _send_frame(self.wfile, out[0], out[1])
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            request_queue_size = 128  # the PR 5 accept-queue lesson
+
+            def handle_error(self, request, client_address):
+                # A frontend dropping a pooled connection (its own
+                # shutdown, a member backoff) is normal churn, not a
+                # stack-trace event; real request errors were already
+                # answered with an ERR frame by the handler.
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "connection error from %s", client_address,
+                    exc_info=True)
+
+        self.server = model_server
+        self._t0 = time.monotonic()
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._srv = Server((host, port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, op: bytes, body: bytes) -> Tuple[bytes, bytes]:
+        if op == OP_PRED:
+            if not body:
+                raise BadRequest("empty PRED body")
+            grouped = bool(body[0] & _FLAG_GROUP_USERS)
+            batch = _unpack_arrays(body[1:])
+            if not batch:
+                raise BadRequest("missing 'features' object")
+            probs, version = self.server.request_versioned(
+                batch, group_users=grouped)
+            out = {"__version__": np.int64(version)}
+            if isinstance(probs, dict):
+                for k, v in probs.items():
+                    out["task:" + k] = np.asarray(v)
+            else:
+                out["predictions"] = np.asarray(probs)
+            return _OK, _pack_arrays(out)
+        if op == OP_HLTH:
+            return _OK, json.dumps(self.server.predictor.health()).encode()
+        if op == OP_STAT:
+            snap = self.server.stats_snapshot()
+            # True backend-process CPU seconds ride along: the frontend's
+            # scale-out model needs the serial-per-request CPU split
+            # between tiers, which wall-clock histograms can't give.
+            snap["process_cpu_seconds"] = time.process_time()
+            snap["uptime_seconds"] = round(time.monotonic() - self._t0, 3)
+            return _OK, json.dumps(snap).encode()
+        if op == OP_POLL:
+            updated = bool(self.server.predictor.poll_updates())
+            return _OK, json.dumps({"updated": updated}).encode()
+        if op == OP_INFO:
+            return _OK, json.dumps(self.server.predictor.model_info()).encode()
+        raise BadRequest(f"unknown op {op!r}")
+
+    def start(self) -> "BackendServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop listening AND sever live connections — so an in-process
+        stop is a faithful stand-in for backend-process death (a real
+        SIGKILL drops every established socket, and the fault tests rely
+        on the frontend observing exactly that)."""
+        self._srv.shutdown()
+        self._srv.server_close()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+# ---------------------------------------------------------------- frontend
+
+
+@guarded_by("_lock")
+class _Member:
+    """One backend endpoint: a small socket pool plus health/backoff
+    state. Pool checkout/checkin and all state transitions go through
+    the methods (which take `_lock`); `call()` holds no lock while
+    waiting on the wire, so N request threads fan out to N backends
+    concurrently."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float,
+                 backoff_base: float, backoff_max: float):
+        self.host, self.port = host, port
+        self.connect_timeout = connect_timeout
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._lock = threading.Lock()
+        self._pool: List[socket.socket] = []
+        self.fails = 0
+        self.down_until = 0.0
+        self.requests = 0
+        self.errors = 0
+        self.health: Dict = {}
+        self._rng = random.Random((host, port).__hash__() & 0xFFFFFFFF)
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def available(self, now: float) -> bool:
+        with self._lock:
+            return now >= self.down_until
+
+    def _checkout(self, connect_timeout: float) -> socket.socket:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return socket.create_connection(
+            (self.host, self.port), timeout=connect_timeout)
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._pool.append(sock)
+
+    def call(self, op: bytes, body: bytes,
+             timeout: float) -> Tuple[bytes, bytes]:
+        """One framed round trip. Socket-level failures close the
+        connection and re-raise (the frontend marks the member down and
+        retries a sibling). The retry after a failed POOLED socket dials
+        FRESH — a backend restart strands every idle pooled socket, and
+        popping a second stale one would fail a request against a
+        perfectly healthy member."""
+        # Dialing is bounded by BOTH the member's connect budget and the
+        # caller's own timeout — a 1 s health probe must not pay a 5 s
+        # connect to a partitioned host.
+        dial = min(self.connect_timeout, timeout)
+        attempts = 2
+        for i in range(attempts):
+            sock = (self._checkout(dial) if i == 0 else
+                    socket.create_connection((self.host, self.port),
+                                             timeout=dial))
+            try:
+                sock.settimeout(timeout)
+                sock.sendall(op + struct.pack("<I", len(body)) + body)
+                hdr = _recv_exact(sock, 8)
+                status, n = hdr[:4], struct.unpack("<I", hdr[4:])[0]
+                if n > _MAX_FRAME:
+                    raise ConnectionError(f"oversized reply frame ({n}B)")
+                resp = _recv_exact(sock, n)
+            except (OSError, ConnectionError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if i + 1 == attempts:
+                    raise
+                continue
+            self._checkin(sock)
+            with self._lock:
+                self.requests += 1
+            return status, resp
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def mark_down(self) -> float:
+        """Record a failure; returns the backoff deadline. Capped
+        exponential with jitter (the `_run_poll_loop` discipline), so N
+        frontend threads hitting one dead backend don't re-probe in
+        lockstep."""
+        with self._lock:
+            self.fails += 1
+            self.errors += 1
+            delay = min(self.backoff_max,
+                        self.backoff_base * (2 ** min(self.fails - 1, 8)))
+            delay *= 0.5 + self._rng.random()
+            self.down_until = time.monotonic() + delay
+            # A dead backend's pooled sockets are dead too.
+            pool, self._pool = self._pool, []
+        for s in pool:
+            try:
+                s.close()
+            except OSError:
+                pass
+        return delay
+
+    def mark_up(self, health: Optional[Dict] = None) -> None:
+        with self._lock:
+            self.fails = 0
+            self.down_until = 0.0
+            if health is not None:
+                self.health = health
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "addr": self.addr,
+                "up": time.monotonic() >= self.down_until,
+                "fails": self.fails,
+                "requests": self.requests,
+                "errors": self.errors,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for s in pool:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _FrontendPredictor:
+    """Predictor facade for the frontend tier, so `HttpServer` (and the
+    online-loop plumbing) binds a Frontend exactly like a ModelServer:
+    feature parsing comes from a LOCAL spec-only trainer (no checkpoint,
+    no table state — the model object is only read for its feature
+    specs), health is the WORST member merged with the frontend's own
+    member-availability view, model_info/poll fan out over the wire."""
+
+    def __init__(self, fe: "Frontend", model):
+        self._fe = fe
+        self.model = model
+        self._trainer = None
+        if model is not None:
+            import optax
+
+            from deeprec_tpu.optim.sparse import GradientDescent
+            from deeprec_tpu.training.trainer import Trainer
+
+            self._trainer = Trainer(model, GradientDescent(),
+                                    optax.identity())
+
+    @property
+    def feature_dtypes(self) -> Dict[str, "np.dtype"]:
+        if self._trainer is None:
+            raise RuntimeError(
+                "Frontend(model=None) cannot parse wire features — pass "
+                "the model to Frontend() for HTTP serving")
+        from deeprec_tpu import features as fcol
+
+        out = {}
+        cfgs = {n: t.cfg for n, t in self._trainer.tables.items()}
+        for f in self._trainer.sparse_specs:
+            out[f.name] = np.dtype(cfgs[fcol.resolve_table_name(f)].key_dtype)
+        for f in self._trainer.dense_specs:
+            out[f.name] = np.dtype(np.float32)
+        return out
+
+    def health(self) -> Dict:
+        """Worst-member health + the frontend's availability view: 'ok'
+        only when every member is reachable and healthy. A member that is
+        down (socket-level) contributes a synthetic degraded entry — a
+        dead process can't speak for itself."""
+        return self._fe._health_sweep()
+
+    def model_info(self) -> Dict:
+        status, body = self._fe._call_any(OP_INFO, b"")
+        if status != _OK:
+            raise RuntimeError(
+                f"backend model_info failed: {body.decode('utf-8', 'replace')}")
+        info = json.loads(body)
+        info["members"] = len(self._fe._members)
+        return info
+
+    def poll_updates(self) -> bool:
+        """The frontend's poll round: refresh member health (marking
+        recovered members back up) and, when the frontend drives updates
+        (`poll_backends=True`), broadcast POLL so every backend replays
+        the delta chain. Backends normally self-poll (poll_secs on the
+        backend CLI) — delta replay stays per-process and zero-stall
+        either way."""
+        h = self._fe._health_sweep()
+        if h.get("reachable", 0) == 0:
+            raise RuntimeError(
+                f"no reachable backends among {[m.addr for m in self._fe._members]}")
+        updated = False
+        if self._fe.poll_backends:
+            for m in self._fe._members:
+                if not m.available(time.monotonic()):
+                    continue
+                try:
+                    status, body = m.call(OP_POLL, b"", self._fe.timeout)
+                except (OSError, ConnectionError):
+                    m.mark_down()
+                    continue
+                if status == _OK:
+                    updated = json.loads(body).get("updated") or updated
+        return updated
+
+
+class Frontend:
+    """Route requests across N backend serving processes.
+
+    Duck-type compatible with ModelServer where it matters
+    (`request_versioned` / `request` / `warmup` / `stats_snapshot` /
+    `.predictor` / `close`), so `HttpServer(Frontend(...))` is the
+    multi-process serving tier.
+
+    Routing: plain requests round-robin over available members; grouped
+    (`group_users=True`) requests route by a hash of the USER feature
+    payload, so one user's candidate batches keep hitting one backend
+    and its sample-aware coalescing (user tower once per distinct user
+    per device batch) survives the socket split. On a member failure the
+    request retries on the next member in order — a killed backend costs
+    latency, never a failed request, as long as one member lives.
+    """
+
+    def __init__(self, backends: Sequence[Union[str, Tuple[str, int]]],
+                 model=None, *, timeout: float = 30.0,
+                 connect_timeout: float = 5.0,
+                 backoff_base: float = 0.2, backoff_max: float = 5.0,
+                 health_secs: float = 0.0, poll_backends: bool = False):
+        if not backends:
+            raise ValueError("need at least one backend address")
+        self._members = [
+            _Member(*self._parse_addr(b), connect_timeout=connect_timeout,
+                    backoff_base=backoff_base, backoff_max=backoff_max)
+            for b in backends
+        ]
+        self.timeout = timeout
+        self.poll_backends = poll_backends
+        self.stats = ServingStats()
+        self.update_failures = 0  # _run_poll_loop accounting
+        self.predictor = _FrontendPredictor(self, model)
+        self._rr = itertools.count()
+        self._stop = threading.Event()
+        self._poller = None
+        if health_secs > 0:
+            self._poller = threading.Thread(
+                target=_run_poll_loop, args=(self, self._stop, health_secs),
+                daemon=True)
+            self._poller.start()
+
+    @staticmethod
+    def _parse_addr(b) -> Tuple[str, int]:
+        if isinstance(b, str):
+            host, port = b.rsplit(":", 1)
+            return host, int(port)  # noqa: DRT002 — parsing a host:port config string, not a device value
+        host, port = b
+        return host, int(port)  # noqa: DRT002 — parsing a host:port config tuple, not a device value
+
+    # ------------------------------------------------------------- routing
+
+    def _order(self, start: int) -> List[_Member]:
+        """Members in attempt order: available ones first (rotated so
+        `start` picks the primary), then backed-off ones as a last
+        resort — with every sibling dead, trying a 'down' member beats
+        failing the request (it may just have restarted)."""
+        n = len(self._members)
+        rot = [self._members[(start + i) % n] for i in range(n)]
+        now = time.monotonic()
+        up = [m for m in rot if m.available(now)]
+        down = [m for m in rot if not m.available(now)]
+        return up + down
+
+    def _group_key(self, batch: Dict[str, np.ndarray]) -> int:
+        """Stable routing hash of the request's user-feature payload.
+        crc32, not builtin hash(): bytes hashing is salted per process,
+        which would re-shuffle user→backend affinity on every frontend
+        restart (and make routing unreproducible across a tier of
+        frontends)."""
+        import zlib
+
+        feats = getattr(self.predictor.model, "user_feats", None)
+        h = 0
+        if feats:
+            for name in feats:
+                v = batch.get(name)
+                if v is not None:
+                    # first row identifies the user for <user, N items>
+                    h ^= zlib.crc32(np.asarray(v)[:1].tobytes())  # noqa: DRT002 — routing hash of the HOST request payload; no device value crosses here
+        return h & 0x7FFFFFFF
+
+    def _call_any(self, op: bytes, body: bytes,
+                  start: Optional[int] = None,
+                  timeout: Optional[float] = None) -> Tuple[bytes, bytes]:
+        """Send one frame to the first member that answers, in routing
+        order; marks failed members down along the way."""
+        if start is None:
+            start = next(self._rr)
+        last: Optional[Exception] = None
+        for m in self._order(start):
+            try:
+                status, resp = m.call(op, body,
+                                      timeout if timeout is not None
+                                      else self.timeout)
+            except (OSError, ConnectionError) as e:
+                m.mark_down()
+                last = e
+                continue
+            m.mark_up()
+            return status, resp
+        raise RuntimeError(
+            f"all {len(self._members)} backends unreachable "
+            f"({[m.addr for m in self._members]})"
+        ) from last
+
+    # ------------------------------------------------------------ requests
+
+    def request(self, features: Dict[str, np.ndarray],
+                timeout: Optional[float] = None,
+                group_users: bool = False):
+        return self.request_versioned(features, timeout, group_users)[0]
+
+    def request_versioned(self, features: Dict[str, np.ndarray],
+                          timeout: Optional[float] = None,
+                          group_users: bool = False):
+        """(result, model_version) through whichever backend answered.
+        The version stamps the BACKEND snapshot that served the whole
+        request (coalesced neighbors on that backend share it)."""
+        t0 = time.monotonic()
+        rows = (int(np.asarray(next(iter(features.values()))).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
+                if features else 0)
+        flags = _FLAG_GROUP_USERS if group_users else 0
+        body = bytes([flags]) + _pack_arrays(features)
+        start = (self._group_key(features) % len(self._members)
+                 if group_users else next(self._rr))
+        try:
+            status, resp = self._call_any(OP_PRED, body, start=start,
+                                          timeout=timeout)
+        except Exception:
+            self.stats.record_error()
+            raise
+        if status == _ERR:
+            err = json.loads(resp)
+            self.stats.record_error()
+            if err.get("kind") == "bad_request":
+                err.pop("kind", None)
+                raise BadRequest(err.pop("error", "bad request"), **err)
+            raise RuntimeError(err.get("error", "backend error"))
+        out = _unpack_arrays(resp)
+        version = int(out.pop("__version__"))  # noqa: DRT002 — version scalar decoded from the wire reply, already host-side
+        if "predictions" in out:
+            probs = out["predictions"]
+        else:
+            probs = {k[len("task:"):]: v for k, v in out.items()}
+        self.stats.record_batch(1, rows)
+        self.stats.record_stage("e2e", time.monotonic() - t0)
+        return probs, version
+
+    def warmup(self, example: Dict[str, np.ndarray],
+               group_users: bool = False,
+               ladder: Optional[Sequence[int]] = None) -> int:
+        """Send warmup predicts to EVERY member — routing is bypassed on
+        purpose: each backend must compile its own batch buckets before
+        live traffic, or the first production burst pays a per-process
+        compile storm (and a scale-out bench measures compilation as
+        backend load). `ladder` warms one batch per row count (built by
+        repeating the example's first row — matching what the backend's
+        bucket padding produces); default is the example as-is."""
+        n = 0
+        flags = _FLAG_GROUP_USERS if group_users else 0
+        one = {k: np.asarray(v)[:1] for k, v in example.items()}  # noqa: DRT002 — warmup path: host example batch, no device value crosses here
+        batches = ([example] if not ladder else
+                   [{k: np.repeat(v, size, axis=0) for k, v in one.items()}
+                    for size in ladder])
+        for m in self._members:
+            ok = True
+            for batch in batches:
+                body = bytes([flags]) + _pack_arrays(batch)
+                try:
+                    status, _ = m.call(OP_PRED, body, self.timeout)
+                except (OSError, ConnectionError):
+                    m.mark_down()
+                    ok = False
+                    break
+                ok = ok and status == _OK
+            if ok:
+                m.mark_up()
+                n += 1
+        return n
+
+    # ------------------------------------------------------ health & stats
+
+    # Health probes run with a SHORT timeout and in parallel across
+    # members: /healthz is a watchdog surface — one network-partitioned
+    # backend must cost the sweep ~1 s total, not connect_timeout × N
+    # serial (a liveness prober timing out on /healthz would restart a
+    # frontend whose request routing is perfectly healthy).
+    HEALTH_PROBE_SECS = 1.0
+
+    def _probe_member(self, m: _Member) -> Dict:
+        try:
+            status, body = m.call(OP_HLTH, b"", self.HEALTH_PROBE_SECS)
+            h = json.loads(body) if status == _OK else {
+                "status": "degraded", "error": body.decode("utf-8",
+                                                           "replace")}
+            m.mark_up(h)
+        except (OSError, ConnectionError) as e:
+            m.mark_down()
+            h = {"status": "down", "member": m.addr, "error": str(e),
+                 "staleness_seconds": float("inf")}
+        h["member"] = m.addr
+        return h
+
+    def _health_sweep(self) -> Dict:
+        """Live HLTH probe of every member (parallel, bounded); returns
+        the merged /healthz body: the WORST member's health dict (the
+        `_GroupPredictor` selection, spanning processes) + frontend
+        availability counters. Down members contribute a synthetic
+        degraded entry."""
+        if len(self._members) == 1:
+            healths = [self._probe_member(self._members[0])]
+        else:
+            slots: List[Optional[Dict]] = [None] * len(self._members)
+
+            def probe(i, m):
+                slots[i] = self._probe_member(m)
+
+            threads = [threading.Thread(target=probe, args=(i, m),
+                                        daemon=True)
+                       for i, m in enumerate(self._members)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            healths = [h for h in slots if h is not None]
+        reachable = sum(1 for h in healths if h["status"] != "down")
+        worst = healths[0]
+        for h in healths:
+            if h["status"] != "ok" and worst["status"] == "ok":
+                worst = h
+            elif (h["status"] != "ok") == (worst["status"] != "ok") and (
+                h.get("staleness_seconds", 0) > worst.get(
+                    "staleness_seconds", 0)):
+                worst = h
+        out = dict(worst)
+        if out.get("staleness_seconds") == float("inf"):
+            out["staleness_seconds"] = None
+        out["members"] = len(self._members)
+        out["reachable"] = reachable
+        if reachable < len(self._members):
+            out["status"] = "degraded" if reachable else "down"
+        return out
+
+    def stats_snapshot(self) -> Dict:
+        """Merged `/v1/stats` spanning the tier: the frontend's own edge
+        accounting (client-visible e2e, routed requests, retries) plus
+        every reachable member's full per-process snapshot and summed
+        totals — one surface shows the whole tier's load balance."""
+        out = self.stats.snapshot()
+        members = []
+        totals = {"requests": 0, "batches": 0, "rows": 0, "errors": 0}
+        model = {}
+        for m in self._members:
+            entry = m.snapshot()
+            if m.available(time.monotonic()):
+                try:
+                    status, body = m.call(OP_STAT, b"",
+                                          min(self.timeout, 5.0))
+                    if status == _OK:
+                        snap = json.loads(body)
+                        entry["stats"] = snap
+                        for k in totals:
+                            totals[k] += snap.get(k, 0)
+                        mv = snap.get("model", {})
+                        if not model or mv.get("version", -1) > model.get(
+                                "version", -1):
+                            model = mv
+                except (OSError, ConnectionError):
+                    m.mark_down()
+            members.append(entry)
+        out["frontend"] = {"routed": out.pop("requests"),
+                           "errors": out["errors"]}
+        out["members"] = members
+        out["backend_totals"] = totals
+        out["model"] = model
+        out["health"] = self._health_sweep()
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+        for m in self._members:
+            m.close()
+
+
+# ------------------------------------------------------- process management
+
+
+def spawn_backends(
+    n: int, *, ckpt: str, model: str = "wdl", model_json: Optional[str] = None,
+    quantize: Optional[str] = None, poll_secs: float = 0.0,
+    max_batch: int = 256, max_wait_ms: float = 1.0,
+    env: Optional[Dict[str, str]] = None, ready_timeout: float = 180.0,
+):
+    """Launch `n` backend serving processes on this host and wait for
+    their READY lines. Returns (procs, addrs) — pass `addrs` to
+    `Frontend`. Used by tools/bench_serving.py and the fault-matrix
+    tests; production deployments run the same CLI under their own
+    process supervisor (docs/serving.md)."""
+    import os
+    import subprocess
+    import sys
+
+    procs, addrs = [], []
+    for _ in range(n):
+        argv = [
+            sys.executable, "-m", "deeprec_tpu.serving.frontend",
+            "--backend", "--ckpt", ckpt, "--model", model, "--port", "0",
+            "--max_batch", str(max_batch), "--max_wait_ms", str(max_wait_ms),
+            "--poll_secs", str(poll_secs),
+        ]
+        if model_json:
+            argv += ["--model-json", model_json]
+        if quantize:
+            argv += ["--quantize", quantize]
+        p = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env={**os.environ, **(env or {})},
+        )
+        procs.append(p)
+    import select
+
+    deadline = time.monotonic() + ready_timeout
+    for p in procs:
+        port = None
+        buf = ""
+        # select-bounded reads: a wedged child that prints NOTHING must
+        # fail after ready_timeout, not block readline() forever
+        while time.monotonic() < deadline:
+            ready, _, _ = select.select(
+                [p.stdout], [], [], max(0.1, min(1.0, deadline - time.monotonic())))
+            if not ready:
+                if p.poll() is not None:
+                    break  # child died without a READY line
+                continue
+            chunk = os.read(p.stdout.fileno(), 4096).decode(
+                "utf-8", "replace")
+            if not chunk:
+                break  # EOF
+            buf += chunk
+            for line in buf.splitlines():
+                if line.startswith("DEEPREC_BACKEND_READY"):
+                    port = int(line.split("port=")[1].strip())
+                    break
+            if port is not None:
+                break
+        if port is None:
+            for q in procs:
+                q.kill()
+            raise RuntimeError(
+                f"backend pid {p.pid} never reported READY "
+                f"(rc={p.poll()}, output tail: {buf[-500:]!r})")
+        addrs.append(("127.0.0.1", port))
+    return procs, addrs
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--backend", action="store_true",
+                      help="run one backend serving process")
+    mode.add_argument("--frontend", action="store_true",
+                      help="run the routing tier + HTTP server")
+    p.add_argument("--ckpt", help="checkpoint directory (backend mode)")
+    p.add_argument("--model", default="wdl")
+    p.add_argument("--model-json", default=None,
+                   help="JSON kwargs for the model constructor")
+    p.add_argument("--quantize", default=None,
+                   choices=["fp32", "bf16", "int8"],
+                   help="serving-side row residency (train fp32, serve "
+                        "quantized)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max_batch", type=int, default=256)
+    p.add_argument("--max_wait_ms", type=float, default=1.0)
+    p.add_argument("--poll_secs", type=float, default=10.0,
+                   help="backend delta-chain poll cadence (0 = off)")
+    p.add_argument("--backends", default="",
+                   help="frontend mode: comma-separated host:port list")
+    p.add_argument("--http-port", type=int, default=8500)
+    p.add_argument("--health_secs", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    kwargs = json.loads(args.model_json) if args.model_json else {}
+    from deeprec_tpu.models.registry import build_model
+
+    model = build_model(args.model, **kwargs)
+
+    if args.backend:
+        if not args.ckpt:
+            p.error("--ckpt is required in --backend mode")
+        from deeprec_tpu.serving.predictor import ModelServer, Predictor
+
+        pred = Predictor(model, args.ckpt, quantize=args.quantize)
+        server = ModelServer(pred, max_batch=args.max_batch,
+                             max_wait_ms=args.max_wait_ms,
+                             poll_updates_secs=args.poll_secs)
+        backend = BackendServer(server, host=args.host,
+                                port=args.port).start()
+        print(f"DEEPREC_BACKEND_READY port={backend.port}", flush=True)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            backend.stop()
+        return
+
+    from deeprec_tpu.serving.http_server import HttpServer
+
+    addrs = [a for a in args.backends.split(",") if a]
+    if not addrs:
+        p.error("--frontend needs --backends host:port[,host:port...]")
+    fe = Frontend(addrs, model, health_secs=args.health_secs)
+    http = HttpServer(fe, port=args.http_port, host=args.host).start()
+    print(f"DEEPREC_FRONTEND_READY port={http.port} backends={addrs}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        http.stop()
+        fe.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -207,15 +207,18 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e)})
         try:
             if payload.get("group_users"):
-                # sample-aware compression: a <user, N items> batch runs
-                # the user tower once per distinct user. Direct predictor
-                # call — a grouped request is already a batch, coalescing
-                # it with strangers' rows would dilute the dedup.
+                # sample-aware compression: a <user, N items> request
+                # rides the grouped lane of the coalescing queue — many
+                # grouped requests share one device batch and the user
+                # tower runs once per distinct user across ALL of them
+                # (the batcher never mixes grouped and plain requests:
+                # they dispatch through different traces).
                 try:
-                    probs, version = server.predictor.predict_versioned(
+                    probs, version = server.request_versioned(
                         batch, group_users=True)
-                except ValueError as e:  # no tower split: client error
-                    return self._send(400, {"error": str(e)})
+                except (BadRequest, ValueError) as e:  # no tower split
+                    return self._send(400, getattr(e, "details",
+                                                   {"error": str(e)}))
             else:
                 probs, version = server.request_versioned(batch)
             if isinstance(probs, dict):

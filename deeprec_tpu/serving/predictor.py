@@ -189,12 +189,39 @@ class Predictor:
     initializer value.
     """
 
+    QUANTIZE_MODES = {
+        None: "float32", "fp32": "float32", "float32": "float32",
+        "bf16": "bfloat16", "bfloat16": "bfloat16", "int8": "int8",
+    }
+
     def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None,
-                 device=None, restore_chunk="auto"):
+                 device=None, restore_chunk="auto", quantize=None):
         self.model = model
         # Serving needs no optimizer; slot-less sparse opt keeps restore lean
         # (checkpointed slot arrays are skipped when the template has none).
         self._trainer = Trainer(model, GradientDescent(), optax.identity())
+        # Quantized serving-side row residency (train fp32, serve bf16 or
+        # int8 + per-row scale): rebuild this predictor's PRIVATE bundles
+        # with the residency dtype before anything traces or restores —
+        # the checkpoint stays fp32 on disk, import_rows quantizes on the
+        # way in, and every lookup gather dequantizes (embedding/table.py).
+        # The model object itself is untouched (it may be shared with a
+        # live fp32 trainer).
+        if quantize not in self.QUANTIZE_MODES:
+            raise ValueError(
+                f"quantize must be one of {sorted(k or 'None' for k in self.QUANTIZE_MODES)}, "
+                f"got {quantize!r}"
+            )
+        self.quantize = self.QUANTIZE_MODES[quantize]
+        if self.quantize != "float32":
+            import dataclasses as _dc
+
+            from deeprec_tpu.embedding.table import EmbeddingTable
+
+            for b in self._trainer.bundles.values():
+                b.table = EmbeddingTable(
+                    _dc.replace(b.table.cfg, value_dtype=self.quantize)
+                )
         self._ck = CheckpointManager(ckpt_dir, self._trainer)
         if restore_chunk == "auto":
             # Every import slice copies the full values array once, so the
@@ -575,6 +602,41 @@ class Predictor:
         return {"step": int(snap.state.step), "table_sizes": sizes,
                 "model_version": snap.version}
 
+    def residency_info(self) -> Dict:
+        """Serving residency accounting per table: measured value-storage
+        bytes (values + per-row scale, straight off the device array
+        shapes — no sync) against the `ops/traffic.py` model, plus the
+        fp32 baseline the quantized residency is compared to. Surfaced
+        through `/v1/stats` and recorded by tools/bench_serving.py;
+        `roofline.py --assert-serving` pins measured == modeled."""
+        from deeprec_tpu.ops import traffic
+
+        snap = self._snap
+        tables = {}
+        totals = {"measured_bytes": 0, "modeled_bytes": 0.0, "fp32_bytes": 0.0}
+        for name, t in self._trainer.tables.items():
+            ts = self._trainer.table_state(snap.state, name)
+            vb = int(ts.values.size) * ts.values.dtype.itemsize
+            sb = (0 if ts.qscale is None
+                  else int(ts.qscale.size) * ts.qscale.dtype.itemsize)
+            modeled = traffic.serving_residency_bytes(
+                capacity=t.cfg.capacity, dim=t.cfg.dim,
+                value_dtype=t.cfg.value_dtype,
+            )
+            fp32 = traffic.serving_residency_bytes(
+                capacity=t.cfg.capacity, dim=t.cfg.dim, value_dtype="float32",
+            )
+            tables[name] = {
+                "value_dtype": t.cfg.value_dtype,
+                "measured_bytes": vb + sb,
+                "modeled_bytes": modeled,
+                "fp32_bytes": fp32,
+            }
+            totals["measured_bytes"] += vb + sb
+            totals["modeled_bytes"] += modeled
+            totals["fp32_bytes"] += fp32
+        return {"quantize": self.quantize, "tables": tables, **totals}
+
 
 def _run_poll_loop(owner, stop: threading.Event, secs: float,
                    max_backoff_secs: float = 30.0,
@@ -713,10 +775,14 @@ class ModelServer:
         """Admit `nxt` into the forming batch unless it would push the row
         count past max_batch — an overflowing batch falls off the bucket
         ladder and traces a fresh arrival-timing-dependent XLA shape, the
-        exact stall class this server exists to prevent. The rejected
-        request leads the NEXT batch instead. Returns the new row count
-        (== max_batch signals 'batch is full, dispatch')."""
-        if pending and rows + nxt[1] > self.max_batch:
+        exact stall class this server exists to prevent — or it disagrees
+        with the batch on `group_users` (a grouped batch dispatches
+        through the sample-aware compressed trace, an ungrouped one
+        through the plain trace: they cannot share a dispatch). The
+        rejected request leads the NEXT batch instead. Returns the new
+        row count (== max_batch signals 'batch is full, dispatch')."""
+        if pending and (rows + nxt[1] > self.max_batch
+                        or nxt[4] != pending[0][4]):
             self._carry = nxt
             return self.max_batch
         pending.append(nxt)
@@ -755,12 +821,15 @@ class ModelServer:
                     rows = self._take(pending, rows, nxt)
             self._serve(pending)
 
-    def _serve(self, pending: List[Tuple[Dict, int, "queue.Queue", float]]):
+    def _serve(
+        self, pending: List[Tuple[Dict, int, "queue.Queue", float, bool]]
+    ):
         t0 = time.monotonic()
-        for _, _, _, t_enq in pending:
+        grouped = pending[0][4]  # homogeneous by _take's admission rule
+        for _, _, _, t_enq, _ in pending:
             self.stats.record_stage("queue", t0 - t_enq)
-        reqs = [r for r, _, _, _ in pending]
-        sizes = [n for _, n, _, _ in pending]
+        reqs = [r for r, _, _, _, _ in pending]
+        sizes = [n for _, n, _, _, _ in pending]
         batch = {
             k: np.concatenate([np.asarray(r[k]) for r in reqs])  # noqa: DRT002 — micro-batch assembly of host request payloads before the one dispatch
             for k in reqs[0]
@@ -768,6 +837,8 @@ class ModelServer:
         # Pad to a bucket from the fixed ladder so the jitted predict
         # compiles once per bucket instead of once per arrival-timing
         # dependent size — otherwise concurrent load is a compile storm.
+        # Repeating the LAST row keeps a grouped batch's distinct-user
+        # count unchanged (the padding user already exists).
         total = sum(sizes)
         bucket = self._bucket_for(total)
         if bucket > total:
@@ -778,11 +849,13 @@ class ModelServer:
         self.stats.record_stage("pad", time.monotonic() - t0)
         try:
             t1 = time.monotonic()
-            probs, version = self.predictor.predict_versioned(batch)
+            probs, version = self.predictor.predict_versioned(
+                batch, group_users=grouped
+            )
             t2 = time.monotonic()
             self.stats.record_stage("device", t2 - t1)
             off = 0
-            for (_, _, reply, _), n in zip(pending, sizes):
+            for (_, _, reply, _, _), n in zip(pending, sizes):
                 sl = (
                     {k: v[off : off + n] for k, v in probs.items()}
                     if isinstance(probs, dict)
@@ -794,7 +867,7 @@ class ModelServer:
             self.stats.record_batch(len(pending), total)
         except Exception as e:
             self.stats.record_error(len(pending))
-            for _, _, reply, _ in pending:
+            for _, _, reply, _, _ in pending:
                 reply.put(e)
 
     def _buckets(self) -> List[int]:
@@ -815,14 +888,19 @@ class ModelServer:
                 return b
         return total  # > max_batch: serve as-is (caller bounded by queue)
 
-    def warmup(self, example: Dict[str, np.ndarray]) -> int:
+    def warmup(self, example: Dict[str, np.ndarray],
+               group_users: bool = False) -> int:
         """Precompile every batch bucket from one example row, so the first
         production burst never waits on XLA. Returns the number of buckets
         compiled. The serving counterpart of the reference's warmup
         requests (Processor.md warmup section). Each bucket batch is also
         registered with the predictor, so every future model update
         re-warms the same ladder against the incoming state BEFORE the
-        snapshot swap (warm-before-swap)."""
+        snapshot swap (warm-before-swap). `group_users=True` additionally
+        compiles the sample-aware grouped trace per bucket (one-repeated-
+        user batches: the G=1 group bucket — live traffic's larger
+        distinct-user buckets compile on first sight, bounded by the
+        power-of-two group ladder)."""
         one = {k: np.asarray(v)[:1] for k, v in example.items()}  # noqa: DRT002 — warmup path: builds the bucket ladder from one host example
         sizes = self._buckets()
         for size in sizes:
@@ -830,18 +908,31 @@ class ModelServer:
                 k: np.concatenate([v] * size, axis=0) for k, v in one.items()
             }
             self.predictor.predict(batch)
+            if group_users:
+                self.predictor.predict(batch, group_users=True)
             self.predictor.register_warm_batch(batch)
         return len(sizes)
 
-    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
-        """Blocking predict for one (mini-)request — the process() call."""
-        return self.request_versioned(features, timeout)[0]
+    def submit(self, features: Dict[str, np.ndarray],
+               group_users: bool = False) -> "queue.Queue":
+        """Enqueue one request onto the coalescing queue and return the
+        reply queue (a one-shot future: `.get()` yields `(result,
+        model_version)` or an Exception). The non-blocking half of
+        `request_versioned` — frontends that multiplex many in-flight
+        requests (the socket tier) use this directly.
 
-    def request_versioned(
-        self, features: Dict[str, np.ndarray], timeout: float = 30.0
-    ):
-        """(result, model_version) — the version the whole request was
-        served from (one snapshot; coalesced neighbors share it)."""
+        `group_users=True` marks the request for sample-aware compression:
+        the batcher coalesces it ONLY with other grouped requests, so one
+        device batch carries many `<user, N items>` requests and the user
+        tower runs once per distinct user across all of them. Validated
+        here (not at dispatch) so a tower-less model fails this request
+        alone, never a coalesced batch of strangers."""
+        if group_users and not hasattr(self.predictor.model,
+                                       "apply_with_user"):
+            raise BadRequest(
+                f"{type(self.predictor.model).__name__} has no user/item "
+                "tower split (needs user_feats/user_vector/apply_with_user)"
+            )
         reply: "queue.Queue" = queue.Queue(maxsize=1)
         rows = (
             int(np.asarray(next(iter(features.values()))).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
@@ -849,7 +940,24 @@ class ModelServer:
         )
         t0 = time.monotonic()
         self._arrivals.note(t0, rows)
-        self._q.put((features, rows, reply, t0))
+        self._q.put((features, rows, reply, t0, bool(group_users)))
+        return reply
+
+    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0,
+                group_users: bool = False):
+        """Blocking predict for one (mini-)request — the process() call."""
+        return self.request_versioned(features, timeout, group_users)[0]
+
+    def request_versioned(
+        self, features: Dict[str, np.ndarray], timeout: float = 30.0,
+        group_users: bool = False,
+    ):
+        """(result, model_version) — the version the whole request was
+        served from (one snapshot; coalesced neighbors share it, so a
+        grouped request's N candidate scores are stamped with ONE
+        version even when strangers' users rode the same device batch)."""
+        t0 = time.monotonic()
+        reply = self.submit(features, group_users=group_users)
         out = reply.get(timeout=timeout)
         self.stats.record_stage("e2e", time.monotonic() - t0)
         if isinstance(out, Exception):
@@ -867,6 +975,7 @@ class ModelServer:
             "last_update_ms": p.last_update_ms,
         }
         out["health"] = p.health()
+        out["residency"] = p.residency_info()
         return out
 
     def close(self):
@@ -935,7 +1044,8 @@ class ServerGroup:
     def __init__(self, model, ckpt_dir: str, *, replicas: int = 2,
                  devices=None, stores: Optional[Dict] = None,
                  max_batch: int = 256, max_wait_ms: float = 2.0,
-                 poll_updates_secs: float = 0.0, adaptive: bool = True):
+                 poll_updates_secs: float = 0.0, adaptive: bool = True,
+                 quantize=None):
         if devices is None:
             avail = jax.local_devices()
             devices = avail[: max(1, min(replicas, len(avail)))]
@@ -950,7 +1060,8 @@ class ServerGroup:
         self._q: "queue.Queue" = queue.Queue()
         self.members = [
             ModelServer(
-                Predictor(model, ckpt_dir, stores=stores, device=d),
+                Predictor(model, ckpt_dir, stores=stores, device=d,
+                          quantize=quantize),
                 max_batch=max_batch, max_wait_ms=max_wait_ms,
                 adaptive=adaptive, request_queue=self._q, stats=self.stats,
                 arrivals=self._arrivals,
@@ -970,18 +1081,28 @@ class ServerGroup:
     def _poll_loop(self, secs: float):
         _run_poll_loop(self, self._stop, secs)
 
-    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0):
+    def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0,
+                group_users: bool = False):
         # Any member's request() enqueues onto the SHARED queue; whichever
         # member is free serves it.
-        return self.members[0].request(features, timeout=timeout)
+        return self.members[0].request(features, timeout=timeout,
+                                       group_users=group_users)
 
     def request_versioned(
-        self, features: Dict[str, np.ndarray], timeout: float = 30.0
+        self, features: Dict[str, np.ndarray], timeout: float = 30.0,
+        group_users: bool = False,
     ):
-        return self.members[0].request_versioned(features, timeout=timeout)
+        return self.members[0].request_versioned(
+            features, timeout=timeout, group_users=group_users)
 
-    def warmup(self, example: Dict[str, np.ndarray]) -> int:
-        return sum(s.warmup(example) for s in self.members)
+    def submit(self, features: Dict[str, np.ndarray],
+               group_users: bool = False) -> "queue.Queue":
+        return self.members[0].submit(features, group_users=group_users)
+
+    def warmup(self, example: Dict[str, np.ndarray],
+               group_users: bool = False) -> int:
+        return sum(s.warmup(example, group_users=group_users)
+                   for s in self.members)
 
     def stats_snapshot(self) -> Dict:
         out = self.stats.snapshot()
@@ -997,6 +1118,7 @@ class ServerGroup:
         # selection /healthz uses (_GroupPredictor.health), so the two
         # watchdog surfaces can never disagree about the group's status.
         out["health"] = self.predictor.health()
+        out["residency"] = ps[0].residency_info()
         return out
 
     def close(self):

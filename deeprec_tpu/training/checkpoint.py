@@ -265,9 +265,19 @@ def import_rows(
     # Restored rows are LOGICAL; scatter_rows_any re-packs on the way in.
     # Exact restore for f32; bf16 values round stochastically (identity
     # for rows that came out of a bf16 table — already representable).
+    # int8 serving residency quantizes ON IMPORT: checkpoints stay fp32
+    # on disk, the per-row scale lands in TableState.qscale, and the
+    # quantize ops run at the same fixed chunk shape as the scatter —
+    # the zero-retrace delta-replay contract holds unchanged.
+    val_rows = jnp.asarray(rows["values"], np.float32)
+    qscale = state.qscale
+    if getattr(table, "quantized", False):
+        from deeprec_tpu.embedding.table import quantize_rows_int8
+
+        val_rows, scale = quantize_rows_int8(val_rows)
+        qscale = qscale.at[ix].set(scale, mode="drop")
     values = scatter_rows_any(
-        state.values, put_ix, jnp.asarray(rows["values"], np.float32),
-        state.capacity,
+        state.values, put_ix, val_rows, state.capacity,
     )
     from deeprec_tpu.embedding.table import META_FREQ, META_VERSION
 
@@ -294,6 +304,7 @@ def import_rows(
         bloom = jnp.asarray(rows["bloom"])
     return state.replace(
         keys=new_keys, values=values, meta=meta, slots=slots, bloom=bloom,
+        qscale=qscale,
     )
 
 

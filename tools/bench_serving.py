@@ -1,13 +1,15 @@
 """Serving latency bench: p50/p99 through the HTTP server under
-concurrent load — single ModelServer vs ServerGroup replicas, batching
-on/off, and the rolling-update blip.
+concurrent load — single ModelServer vs ServerGroup replicas, the
+multi-process socket tier, quantized row residency, the grouped
+two-tower arm, and the rolling-update blip.
 
 The measurement SessionGroup exists for (docs/docs_en/SessionGroup.md:
 tail latency under concurrency, plus model updates without a serving
 gap). Run:
 
     python tools/bench_serving.py [--groups 2,4] [--clients 8] \
-        [--seconds 5] [--rows 8] [--out SERVING_BENCH.json]
+        [--seconds 5] [--rows 8] [--processes 1,2,4] \
+        [--quantize fp32,bf16,int8] [--grouped] [--out SERVING_BENCH.json]
 
 Prints one JSON line per configuration:
     {"config": "group-2", "rps": ..., "p50_ms": ..., "p99_ms": ...,
@@ -19,9 +21,26 @@ checkpoint lands mid-load and rolls across the replicas:
     {"config": "group-4+rolling-update", ..., "during_update_p99_ms": ...,
      "during_update_max_ms": ..., "model_version_advanced": true}
 
-`--smoke` runs a tiny two-config pass (CI: compiles both the single and
-group dispatch paths, lands one delta update mid-load, checks /v1/stats
-over HTTP) and asserts structure, not timings.
+The extra grids:
+  * `--processes 1,2,4` — the socket-tier scale-out (serving/frontend.py):
+    N backend serving PROCESSES behind one Frontend + HTTP edge, with a
+    delta update broadcast mid-load at the largest N. Records measured
+    rps per arm plus a CPU-split Amdahl model (frontend vs backend CPU
+    seconds per request) — on a host with fewer cores than processes the
+    measured arms are core-bound and the model carries the scaling claim
+    (`cpu_limited: true`; `roofline.py --assert-serving` gates the model
+    there and the measurement on capable hosts).
+  * `--quantize fp32,bf16,int8` — single-process arms serving the same
+    checkpoint at each residency; int8 additionally replays a delta
+    chain under a trace guard (steady-state serving compiles must be 0)
+    and records measured-vs-modeled residency bytes.
+  * `--grouped` — the DSSM two-tower arm: `<user, N items>` requests
+    with and without `group_users` (sample-aware user-tower reuse);
+    headline metric is candidates/sec.
+
+`--smoke` runs a tiny pass over every grid (CI: group dispatch, a
+2-process socket tier + int8 + grouped arms, one delta update mid-load,
+/v1/stats over HTTP) and asserts structure, not timings.
 
 On a TPU host run WITHOUT JAX_PLATFORMS=cpu to serve from the chip.
 """
@@ -34,10 +53,29 @@ import threading
 import time
 import urllib.request
 
+import numpy as np
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def build(tmp, emb_dim=16, steps=5):
+# one definition feeds both the in-process model and the backend CLI
+# (spawn_backends ships it as --model-json), so the socket-tier arms serve
+# exactly the checkpoint build() trained
+WDL_ARGS = {"emb_dim": 16, "capacity": 1 << 14, "hidden": [128, 64],
+            "num_cat": 8, "num_dense": 4}
+
+# The socket-tier arm serves a production-width ranking tower (4096/2048
+# vs the PR 5 toy's 128/64): process scale-out is the regime where
+# backend compute dominates the routing edge. With the tiny model,
+# efficient coalescing leaves the GIL-bound frontend as the ~1.3
+# ms/request ceiling and no process count helps — measured here so the
+# ceiling is recorded, not hidden. The legacy single/group arms keep the
+# PR 5 model untouched for protocol continuity.
+SCALE_ARGS = {"emb_dim": 16, "capacity": 1 << 14, "hidden": [4096, 2048],
+              "num_cat": 8, "num_dense": 4}
+
+
+def build(tmp, steps=5, margs=None):
     import jax.numpy as jnp
     import optax
 
@@ -47,8 +85,10 @@ def build(tmp, emb_dim=16, steps=5):
     from deeprec_tpu.training import Trainer
     from deeprec_tpu.training.checkpoint import CheckpointManager
 
-    model = WDL(emb_dim=emb_dim, capacity=1 << 14, hidden=(128, 64),
-                num_cat=8, num_dense=4)
+    kw = dict(margs or WDL_ARGS)
+    model = WDL(emb_dim=kw["emb_dim"], capacity=kw["capacity"],
+                hidden=tuple(kw["hidden"]), num_cat=kw["num_cat"],
+                num_dense=kw["num_dense"])
     tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
     st = tr.init(0)
     gen = SyntheticCriteo(batch_size=256, num_cat=8, num_dense=4,
@@ -86,12 +126,18 @@ def build(tmp, emb_dim=16, steps=5):
     return model, req, save_next
 
 
-def drive(port, payloads, seconds, clients, until_event=None):
+def drive(port, payloads, seconds, clients, until_event=None,
+          thread_cpu=None):
     """Concurrent closed-loop clients; returns [(t_start, latency_s)]
     sorted by start time. Runs for `seconds`, extended while `until_event`
     (if given) is unset — the rolling-update phase must outlast the
     update. Any request failure aborts the bench loudly — silent drops
-    would report flattering numbers from a broken server."""
+    would report flattering numbers from a broken server.
+
+    `thread_cpu` (a list) collects each client thread's own CPU seconds:
+    the scale-out arms subtract the LOAD GENERATOR's CPU from the bench
+    process's, so the recorded frontend-tier CPU split describes the
+    serving tier, not the drivers (which are remote in production)."""
     recs = []
     errors = []
     lock = threading.Lock()
@@ -107,6 +153,7 @@ def drive(port, payloads, seconds, clients, until_event=None):
     def worker(i):
         body = payloads[i % len(payloads)]
         mine = []
+        cpu0 = time.thread_time()
         try:
             while keep_going():
                 t0 = time.monotonic()
@@ -126,6 +173,8 @@ def drive(port, payloads, seconds, clients, until_event=None):
         finally:
             with lock:
                 recs.extend(mine)
+                if thread_cpu is not None:
+                    thread_cpu.append(time.thread_time() - cpu0)
 
     threads = [threading.Thread(target=worker, args=(i,))
                for i in range(clients)]
@@ -171,6 +220,315 @@ def summarize(name, recs, seconds, clients, rows, extra=None, server=None):
     return out
 
 
+def make_payloads(req, clients, rows):
+    """One JSON body per closed-loop client, sliced from the example
+    request (the PR 5 drive protocol)."""
+    payloads = []
+    for off in range(clients):
+        sl = {k: np.asarray(v)[off * rows:(off + 1) * rows]
+              for k, v in req.items()}
+        payloads.append(json.dumps(
+            {"features": {k: v.tolist() for k, v in sl.items()}}
+        ).encode())
+    return payloads
+
+
+def _backend_cpu_seconds(fe) -> float:
+    """Sum of the backend processes' CPU seconds (each BackendServer
+    reports `time.process_time()` in its STAT frame)."""
+    total = 0.0
+    for m in fe.stats_snapshot()["members"]:
+        total += m.get("stats", {}).get("process_cpu_seconds", 0.0)
+    return total
+
+
+def scale_out_grid(args, results):
+    """The socket-tier arms: N backend serving processes behind one
+    Frontend + HTTP edge. Measures rps per N, the frontend/backend CPU
+    split per request, and (at the largest N) a delta update broadcast
+    mid-load. Returns the `scale_out` section of the bench JSON: on a
+    host with fewer cores than processes the measured arms are
+    core-bound, so the CPU-split Amdahl model carries the scaling claim
+    (`cpu_limited: true` — `roofline.py --assert-serving` gates the
+    model there, the measurement on capable hosts)."""
+    import os
+    import tempfile as _tempfile
+
+    from deeprec_tpu.serving import Frontend, HttpServer, spawn_backends
+
+    counts = sorted({int(x) for x in args.processes.split(",") if x})
+    host_cores = len(os.sched_getaffinity(0))
+    biggest = max(counts)
+    section = {
+        "host_cores": host_cores,
+        # Linear MEASURED scaling needs a core per backend, one for the
+        # frontend/HTTP edge, and one for the in-process closed-loop
+        # drivers — gating the measurement on a host that is merely
+        # "barely enough" cores would flake, which is exactly what the
+        # modeled fallback exists for.
+        "cpu_limited": host_cores < biggest + 2,
+        "arms": {},
+    }
+    mj = json.dumps(SCALE_ARGS)
+    scale_dir = _tempfile.mkdtemp(prefix="deeprec-scale-")
+    model, req, save_next = build(scale_dir, margs=SCALE_ARGS)
+    payloads = make_payloads(req, args.clients, args.rows)
+    for n in counts:
+        procs, addrs = spawn_backends(
+            n, ckpt=scale_dir, model="wdl", model_json=mj, poll_secs=0.0,
+            max_batch=256, max_wait_ms=1.0)
+        fe = Frontend(addrs, model, poll_backends=True)
+        http = HttpServer(fe, port=0).start()
+        try:
+            # Deterministic per-backend bucket-ladder warm: EVERY member
+            # compiles every coalescing bucket the measured concurrency
+            # can produce, or the window measures XLA compilation as
+            # backend load (round-robin settle traffic doesn't guarantee
+            # every member sees every bucket).
+            example = {k: np.asarray(v)[:1] for k, v in req.items()}
+            top = 8
+            while top < min(256, args.clients * args.rows):
+                top <<= 1
+            ladder, b = [], 8
+            while b < top:
+                ladder.append(b)
+                b <<= 1
+            ladder.append(top)
+            fe.warmup(example, ladder=ladder)
+            drive(http.port, payloads, 0.5, args.clients)  # settle
+            fe.stats.reset()
+            bcpu0 = _backend_cpu_seconds(fe)
+            client_cpu = []
+            fcpu0 = time.process_time()
+            recs = drive(http.port, payloads, args.seconds, args.clients,
+                         thread_cpu=client_cpu)
+            fcpu1 = time.process_time()
+            bcpu1 = _backend_cpu_seconds(fe)
+            out = summarize(f"procs-{n}", recs, args.seconds, args.clients,
+                            args.rows, server=fe)
+            nreq = max(len(recs), 1)
+            out["processes"] = n
+            out["host_cores"] = host_cores
+            # tier CPU only: the closed-loop drivers' own CPU is load
+            # generation, not serving (remote in production) — subtract it
+            out["frontend_cpu_per_req_ms"] = round(
+                1e3 * (fcpu1 - fcpu0 - sum(client_cpu)) / nreq, 4)
+            out["client_cpu_per_req_ms"] = round(
+                1e3 * sum(client_cpu) / nreq, 4)
+            out["backend_cpu_per_req_ms"] = round(
+                1e3 * (bcpu1 - bcpu0) / nreq, 4)
+            results.append(out)
+            print(json.dumps(out), flush=True)
+            section["arms"][str(n)] = {
+                "rps": out["rps"],
+                "frontend_cpu_per_req_ms": out["frontend_cpu_per_req_ms"],
+                "backend_cpu_per_req_ms": out["backend_cpu_per_req_ms"],
+            }
+            if n == biggest:
+                results.append(rolling_update_phase(
+                    fe, http, payloads, args, f"procs-{n}",
+                    lambda: save_next("delta"), label="+delta-update"))
+        finally:
+            http.stop()
+            fe.close()
+            for p in procs:
+                p.kill()
+            for p in procs:
+                p.wait()
+    one = section["arms"].get("1")
+    if one:
+        s_f = one["frontend_cpu_per_req_ms"]
+        s_b = one["backend_cpu_per_req_ms"]
+        # Amdahl over the CPU split: the frontend's per-request CPU is the
+        # serial term, the backends' divides by N. Modeled rps(N) =
+        # 1 / max(serial, parallel / N) — what this tier does the moment
+        # each process owns a core.
+        modeled = {
+            str(n): (round(1e3 / max(s_f, s_b / n), 1)
+                     if max(s_f, s_b) > 0 else None)
+            for n in counts
+        }
+        section["modeled"] = {
+            "rps": modeled,
+            "speedup": {
+                k: (round(v / modeled["1"], 2)
+                    if v and modeled.get("1") else None)
+                for k, v in modeled.items()
+            },
+            "frontend_cpu_per_req_ms": s_f,
+            "backend_cpu_per_req_ms": s_b,
+        }
+        section["measured_speedup"] = {
+            k: round(a["rps"] / one["rps"], 2)
+            for k, a in section["arms"].items()
+        }
+    import shutil
+
+    shutil.rmtree(scale_dir, ignore_errors=True)
+    return section
+
+
+def quantize_arms(args, tmp, model, req, payloads, save_next, results):
+    """Residency arms: serve the SAME checkpoint at fp32/bf16/int8 in a
+    single-process ModelServer. Each arm records measured + modeled
+    residency bytes; non-fp32 arms additionally replay a delta chain
+    under a trace guard — the zero-retrace serving contract extended to
+    the quantized import path (steady-state compiles must be 0)."""
+    from deeprec_tpu.analysis.trace_guard import trace_guard
+    from deeprec_tpu.serving import HttpServer, ModelServer, Predictor
+
+    section = {}
+    for q in [x for x in args.quantize.split(",") if x]:
+        pred = Predictor(model, tmp, quantize=q)
+        server = ModelServer(pred, max_batch=256, max_wait_ms=1.0)
+        server.warmup({k: np.asarray(v)[:args.rows]
+                       for k, v in req.items()})
+        http = HttpServer(server, port=0).start()
+        try:
+            drive(http.port, payloads, 0.5, 2)
+            server.stats.reset()
+            recs = drive(http.port, payloads, args.seconds, args.clients)
+            out = summarize(f"quant-{q}", recs, args.seconds, args.clients,
+                            args.rows, server=server)
+            out["residency"] = pred.residency_info()
+            # steady-state delta replay on this residency: the first
+            # replay + probe pad every cache, then the guarded replay +
+            # predict must compile 0 (the PR 5 zero-retrace contract on
+            # the quantized import path)
+            probe = {k: np.asarray(v)[:args.rows] for k, v in req.items()}
+            save_next("delta")
+            pred.poll_updates()
+            pred.predict(probe)
+            save_next("delta")
+            with trace_guard(max_compiles=None) as g:
+                pred.poll_updates()
+                pred.predict(probe)
+            out["serving_compiles"] = g.compiles
+            results.append(out)
+            print(json.dumps(out), flush=True)
+            section[q] = {
+                "rps": out["rps"],
+                "residency": out["residency"],
+                "serving_compiles": out["serving_compiles"],
+            }
+        finally:
+            http.stop()
+            server.close()
+    return section
+
+
+def build_two_tower(tmp, steps=4):
+    """Train the modelzoo DSSM briefly and checkpoint it — the two-tower
+    stimulus of the grouped arm. The towers are ASYMMETRIC (8 user
+    features through a 512-wide tower vs 2 item features through a
+    128-wide one): the production retrieval shape — user side encodes
+    the heavy behavior context, item side is a cheap projection — and
+    the regime where scoring N candidates per user-tower evaluation
+    pays N×, per PAPERS' asymmetric-data-flow analysis."""
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.data import SyntheticTwoTower
+    from deeprec_tpu.models import DSSM
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = DSSM(emb_dim=16, capacity=1 << 14, num_user_feats=8,
+                 num_item_feats=2, hidden=(128, 64),
+                 user_hidden=(4096, 512, 64))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(2e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(batch_size=256, num_user=8, num_item=2,
+                            vocab=20000, seed=23)
+    for _ in range(steps):
+        st, _ = tr.train_step(st, {k: jnp.asarray(v)
+                                   for k, v in gen.batch().items()})
+    CheckpointManager(tmp, tr).save(st)
+    base = {k: np.asarray(v) for k, v in gen.batch().items()
+            if not k.startswith("label")}
+    return model, base
+
+
+def grouped_arms(args, results):
+    """The N-candidate user-tower-reuse arm: `<user, N items>` requests
+    through the micro-batcher with and without `group_users`. Headline
+    metric is candidates/sec — sample-aware compression runs the user
+    tower once per distinct user per coalesced batch, so the grouped arm
+    scores the same candidates for a fraction of the tower FLOPs."""
+    import tempfile as _tempfile
+
+    from deeprec_tpu.serving import HttpServer, ModelServer, Predictor
+
+    R = args.grouped_rows
+    with _tempfile.TemporaryDirectory() as tmp2:
+        model, base = build_two_tower(tmp2)
+        B = len(next(iter(base.values())))
+
+        def items_slice(v, u):
+            start = (u * R) % max(1, B - R + 1)
+            return v[start:start + R]
+
+        payloads = {}
+        for grouped in (False, True):
+            per_client = []
+            for u in range(args.clients):
+                req = {}
+                for k, v in base.items():
+                    rows = (np.repeat(v[u:u + 1], R, axis=0)
+                            if k in model.user_feats else items_slice(v, u))
+                    req[k] = rows
+                body = {"features": {k: x.tolist() for k, x in req.items()}}
+                if grouped:
+                    body["group_users"] = True
+                per_client.append(json.dumps(body).encode())
+            payloads[grouped] = per_client
+        section = {"rows_per_request": R}
+        pred = Predictor(model, tmp2)
+        server = ModelServer(pred, max_batch=max(256, 4 * R),
+                             max_wait_ms=1.0)
+        example = {k: v[:R] for k, v in base.items()}
+        server.warmup(example, group_users=True)
+        # Warm the grouped (row-bucket, group-bucket) grid the coalesced
+        # load will hit: k of the `clients` distinct users per batch →
+        # k·R rows with k groups. Without this the measured window pays
+        # the compile storms the bucket ladder exists to prevent.
+        for k in range(1, args.clients + 1):
+            batch = {}
+            for name, v in base.items():
+                if name in model.user_feats:
+                    rows = np.repeat(v[:k], R, axis=0)
+                else:
+                    rows = np.concatenate(
+                        [items_slice(v, u) for u in range(k)])
+                batch[name] = rows
+            pred.predict(batch, group_users=True)
+            pred.predict(batch)
+        http = HttpServer(server, port=0).start()
+        try:
+            for grouped in (False, True):
+                name = ("two-tower-grouped" if grouped
+                        else "two-tower-ungrouped")
+                drive(http.port, payloads[grouped], 0.5, 2)
+                server.stats.reset()
+                recs = drive(http.port, payloads[grouped], args.seconds,
+                             args.clients)
+                out = summarize(name, recs, args.seconds, args.clients, R,
+                                server=server)
+                out["candidates_per_sec"] = round(out["rps"] * R, 1)
+                results.append(out)
+                print(json.dumps(out), flush=True)
+                section["grouped_cps" if grouped else "ungrouped_cps"] = (
+                    out["candidates_per_sec"])
+        finally:
+            http.stop()
+            server.close()
+        if section.get("ungrouped_cps"):
+            section["factor"] = round(
+                section["grouped_cps"] / section["ungrouped_cps"], 2)
+        return section
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--groups", default="2,4",
@@ -179,18 +537,32 @@ def main():
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=8,
                     help="rows per client request")
+    ap.add_argument("--processes", default="",
+                    help="comma-separated backend PROCESS counts for the "
+                         "socket-tier grid (e.g. 1,2,4; empty = skip)")
+    ap.add_argument("--quantize", default="",
+                    help="comma-separated residency arms (fp32,bf16,int8; "
+                         "empty = skip)")
+    ap.add_argument("--grouped", action="store_true",
+                    help="run the DSSM two-tower grouped/ungrouped arm")
+    ap.add_argument("--grouped-rows", type=int, default=128,
+                    help="candidate items per <user, N items> request")
     ap.add_argument("--out", default=None,
                     help="also write the result list to this JSON file")
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny CI pass: single + group-2, one delta update "
-                         "mid-load, structural asserts (stats present, "
-                         "version advanced, zero failed requests)")
+                    help="tiny CI pass: group-2 + a 2-process socket tier "
+                         "+ int8 + grouped arms, one delta update mid-load, "
+                         "structural asserts (stats present, version "
+                         "advanced, zero failed requests)")
     args = ap.parse_args()
     if args.smoke:
         args.groups, args.seconds, args.clients, args.rows = "2", 1.2, 4, 4
+        args.processes, args.quantize = "1,2", "int8"
+        # grouped arm keeps the full per-request candidate count: the
+        # compressed-vs-plain ratio is the contract the serving gate
+        # pins, and it only exists where the user tower dominates
+        args.grouped, args.grouped_rows = True, 128
     groups = [int(g) for g in args.groups.split(",") if g]
-
-    import numpy as np
 
     from deeprec_tpu.serving import (
         HttpServer, ModelServer, Predictor, ServerGroup,
@@ -198,13 +570,7 @@ def main():
 
     with tempfile.TemporaryDirectory() as tmp:
         model, req, save_next = build(tmp)
-        payloads = []
-        for off in range(args.clients):
-            sl = {k: np.asarray(v)[off * args.rows:(off + 1) * args.rows]
-                  for k, v in req.items()}
-            payloads.append(json.dumps(
-                {"features": {k: v.tolist() for k, v in sl.items()}}
-            ).encode())
+        payloads = make_payloads(req, args.clients, args.rows)
 
         results = []
         # max_batch=1 disables cross-request coalescing — the "batching
@@ -254,13 +620,24 @@ def main():
             finally:
                 http.stop()
                 server.close()
+
+        sections = {}
+        if args.processes:
+            sections["scale_out"] = scale_out_grid(args, results)
+        if args.quantize:
+            sections["quantized"] = quantize_arms(
+                args, tmp, model, req, payloads, save_next, results)
+        if args.grouped:
+            sections["grouped"] = grouped_arms(args, results)
+
         if args.smoke:
             check_smoke_results(results, groups)
+            check_smoke_sections(sections)
             print("bench_serving smoke OK", flush=True)
         if args.out:
             with open(args.out, "w") as f:
-                json.dump({"results": results,
-                           "protocol": vars(args)}, f, indent=1)
+                json.dump({"results": results, "protocol": vars(args),
+                           **sections}, f, indent=1)
         return results
 
 
@@ -281,6 +658,26 @@ def check_smoke_results(results, groups):
     assert upd["model_version_advanced"], upd
     assert upd["during_update_p99_ms"] is not None
     assert upd["model"]["updates"] >= 1
+
+
+def check_smoke_sections(sections):
+    """Structural asserts for the scale-out / quantized / grouped grids
+    (timing-free — `roofline.py --assert-serving` owns the numeric
+    gates): every requested arm ran, the CPU-split model exists, the
+    quantized arm measured residency AND replayed deltas, the grouped
+    arm measured candidates/sec both ways, and the socket tier rolled a
+    delta update with zero failed requests (drive() raises otherwise)."""
+    so = sections["scale_out"]
+    assert so["arms"], so
+    assert "1" in so["arms"] and len(so["arms"]) >= 2, so
+    assert so["modeled"]["rps"], so
+    qa = sections["quantized"]
+    assert "int8" in qa, qa
+    ri = qa["int8"]["residency"]
+    assert ri["measured_bytes"] == ri["modeled_bytes"], ri
+    assert "serving_compiles" in qa["int8"], qa
+    gr = sections["grouped"]
+    assert gr.get("grouped_cps") and gr.get("ungrouped_cps"), gr
 
 
 def rolling_update_phase(server, http, payloads, args, name, save_next,

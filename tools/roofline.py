@@ -248,6 +248,130 @@ def assert_compiles(json_path: str, budget: int) -> int:
     return 0
 
 
+def assert_serving(json_path: str, scale_floor: float,
+                   grouped_factor: float, quant_ratio: float) -> int:
+    """CI gate for the serving scale-out grid (tools/bench_serving.py
+    --processes/--quantize/--grouped JSON):
+
+      * scaling — at the largest process count P the tier must reach
+        `scale_floor`·P speedup over one process. On a host with enough
+        cores the MEASURED speedup is gated; on a core-starved host
+        (`cpu_limited`, e.g. single-core CI where N processes time-slice
+        one core) the CPU-split Amdahl model carries the claim — same
+        discipline as --assert-overlap, where single-core CI gates the
+        contract and the capable host pins the measurement.
+      * quantized residency — measured bytes must equal the
+        ops/traffic.py model EXACTLY (the accounting is shape math, not
+        an estimate), int8 must sit under `quant_ratio`× the fp32
+        baseline, and the delta replay under the trace guard must have
+        compiled ZERO programs (the zero-retrace serving contract on the
+        quantized import path).
+      * grouped — the two-tower arm's candidates/sec with sample-aware
+        user-tower reuse must beat the plain arm by `grouped_factor`×.
+    """
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    rc = 0
+
+    so = rec.get("scale_out")
+    if not so or not so.get("arms"):
+        print(f"roofline: {json_path} has no 'scale_out' record "
+              "(run bench_serving with --processes)", file=sys.stderr)
+        rc = 1
+    else:
+        counts = sorted(int(k) for k in so["arms"])
+        P = counts[-1]
+        need = scale_floor * P
+        measured = so.get("measured_speedup", {}).get(str(P))
+        if so.get("cpu_limited"):
+            sp = so.get("modeled", {}).get("speedup", {}).get(str(P))
+            kind = f"modeled (host has {so.get('host_cores')} core(s) for " \
+                   f"{P} backends + the edge: measured arms are core-bound)"
+        else:
+            sp = measured
+            kind = "measured"
+        if sp is None or sp < need:
+            print(
+                f"roofline: serving scale-out gate FAILED — {kind} speedup "
+                f"at {P} processes is {sp} (need ≥ {need:.2f} = "
+                f"{scale_floor:.2f}×{P}); measured {measured}",
+                file=sys.stderr,
+            )
+            rc = 1
+        else:
+            print(
+                f"roofline: serving scale-out ok — {kind} speedup {sp:.2f} "
+                f"at {P} processes (floor {need:.2f}; measured "
+                f"{measured}, cpu split {so.get('modeled', {}).get('frontend_cpu_per_req_ms')}"
+                f"/{so.get('modeled', {}).get('backend_cpu_per_req_ms')} ms "
+                f"front/back per request)"
+            )
+
+    qa = rec.get("quantized", {})
+    q8 = qa.get("int8")
+    if not q8:
+        print(f"roofline: {json_path} has no int8 'quantized' record "
+              "(run bench_serving with --quantize int8)", file=sys.stderr)
+        rc = 1
+    else:
+        ri = q8["residency"]
+        if ri["measured_bytes"] != ri["modeled_bytes"]:
+            print(
+                f"roofline: quantized residency gate FAILED — measured "
+                f"{ri['measured_bytes']}B != modeled {ri['modeled_bytes']}B "
+                f"(ops/traffic.py serving_residency_bytes drifted from the "
+                f"actual table layout)", file=sys.stderr,
+            )
+            rc = 1
+        if ri["measured_bytes"] > quant_ratio * ri["fp32_bytes"]:
+            print(
+                f"roofline: quantized residency gate FAILED — int8 bytes "
+                f"{ri['measured_bytes']} exceed {quant_ratio:.2f}× the fp32 "
+                f"baseline {ri['fp32_bytes']}", file=sys.stderr,
+            )
+            rc = 1
+        if q8.get("serving_compiles", -1) != 0:
+            print(
+                f"roofline: quantized serving compile gate FAILED — "
+                f"{q8.get('serving_compiles')} XLA compile(s) during the "
+                f"guarded delta replay (the quantize-on-import path "
+                f"retraces; must be 0)", file=sys.stderr,
+            )
+            rc = 1
+        if rc == 0:
+            print(
+                f"roofline: quantized residency ok — int8 "
+                f"{ri['measured_bytes'] / 2 ** 20:.2f} MiB = "
+                f"{ri['measured_bytes'] / ri['fp32_bytes']:.3f}× fp32 "
+                f"(bound {quant_ratio:.2f}), model exact, 0 replay compiles"
+            )
+
+    gr = rec.get("grouped")
+    if not gr or not gr.get("factor"):
+        print(f"roofline: {json_path} has no 'grouped' record "
+              "(run bench_serving with --grouped)", file=sys.stderr)
+        rc = 1
+    elif gr["factor"] < grouped_factor:
+        print(
+            f"roofline: grouped serving gate FAILED — candidates/sec "
+            f"factor {gr['factor']} under the {grouped_factor:.1f}× floor "
+            f"(grouped {gr.get('grouped_cps')} vs ungrouped "
+            f"{gr.get('ungrouped_cps')} at {gr.get('rows_per_request')} "
+            f"candidates/request)", file=sys.stderr,
+        )
+        rc = 1
+    else:
+        print(
+            f"roofline: grouped serving ok — {gr['factor']:.2f}× "
+            f"candidates/sec ({gr.get('grouped_cps')} vs "
+            f"{gr.get('ungrouped_cps')} at {gr.get('rows_per_request')} "
+            f"candidates/request)"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -295,6 +419,25 @@ def main(argv=None):
                    help="allowed relative plan-arm step-time regression vs "
                         "the uniform arm (default 0.25 — the skew workload "
                         "is tiny, single-core CI timing is noisy)")
+    p.add_argument("--assert-serving", metavar="SERVING_JSON", default=None,
+                   help="don't run the step: validate the serving "
+                        "scale-out grid recorded by tools/bench_serving.py "
+                        "(process scaling floor, quantized residency bytes "
+                        "vs the traffic model + zero replay compiles, "
+                        "grouped candidates/sec floor; CI smoke gate)")
+    p.add_argument("--serving-scale-floor", type=float, default=0.8,
+                   help="required per-process speedup fraction at the "
+                        "largest process count (default 0.8 — e.g. ≥3.2× "
+                        "at 4 processes); gated on the measured arms where "
+                        "the host has the cores, on the CPU-split model "
+                        "where it doesn't")
+    p.add_argument("--serving-grouped-factor", type=float, default=2.0,
+                   help="required grouped/ungrouped candidates-per-sec "
+                        "factor on the two-tower arm (default 2.0)")
+    p.add_argument("--serving-quant-ratio", type=float, default=0.55,
+                   help="int8 residency bytes bound as a fraction of fp32 "
+                        "(default 0.55 — int8 + per-row scale must at "
+                        "least halve the value storage)")
     args = p.parse_args(argv)
     if args.assert_traffic:
         sys.exit(assert_traffic(args.assert_traffic))
@@ -306,6 +449,11 @@ def main(argv=None):
     if args.assert_imbalance:
         sys.exit(assert_imbalance(args.assert_imbalance,
                                   args.imbalance_factor, args.imbalance_tol))
+    if args.assert_serving:
+        sys.exit(assert_serving(args.assert_serving,
+                                args.serving_scale_floor,
+                                args.serving_grouped_factor,
+                                args.serving_quant_ratio))
 
     import jax
     import jax.numpy as jnp
