@@ -50,6 +50,10 @@ echo "== serving scale-out / quantized residency / grouped gates (drift fails th
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-serving /tmp/deeprec_serving_smoke.json
 
+echo "== obs overhead gate, serving arm (telemetry plane ≤2% + /metrics parses) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-obs /tmp/deeprec_serving_smoke.json
+
 echo "== freshness bench (CPU smoke: online loop, trainer SIGKILL + supervised restart, zero failed requests) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke
 
@@ -74,6 +78,10 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== steady-state retrace gate (compiles inside timed windows fail the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-compiles /tmp/deeprec_bench_smoke.json
+
+echo "== obs overhead gate, K-step scan arm (telemetry plane ≤2% + registry renders) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-obs /tmp/deeprec_bench_smoke.json
 
 echo "== bench (CPU smoke, budgets disabled: legacy dedup path compiles) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \

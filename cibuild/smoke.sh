@@ -25,4 +25,6 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_online_loop.py::test_poll_thread_survives_raising_poll_and_recovers \
   tests/test_analysis.py::test_repo_check_is_green \
   tests/test_analysis.py::test_trace_guard_catches_reintroduced_per_call_jit_lambda \
+  tests/test_obs.py::test_disabled_tracing_is_zero_allocation \
+  tests/test_obs_wiring.py::test_trace_id_spans_http_edge_to_backend_stages \
   "$@"

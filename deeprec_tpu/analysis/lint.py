@@ -31,6 +31,17 @@ hand-review (docs/analysis.md has the incident list):
                                for free.
   DRT006 shadowed-name         parameters shadowing builtins or module
                                imports.
+  DRT007 metric-label-cardinality
+                               obs-plane metric constructors
+                               (counter/gauge/histogram/
+                               register_callback/.labels) whose label
+                               VALUE interpolates per-request data — a
+                               user id, raw key, request payload — so
+                               the series set grows without bound and
+                               the registry becomes a memory leak with a
+                               /metrics body to match. Label values must
+                               come from bounded sets (stage names,
+                               table names, member addresses).
 
 Suppression: a trailing ``# noqa: DRT004`` (comma-list allowed) on the
 flagged line, ideally with a one-line justification after it. Repo-wide
@@ -64,6 +75,8 @@ RULES = {
               "thread-launched code",
     "DRT005": "unused-import",
     "DRT006": "shadowed-name: parameter shadows a builtin or module import",
+    "DRT007": "metric-label-cardinality: metric label value derived from "
+              "per-request data",
 }
 
 # DRT002 call-graph roots: any function/method with one of these names.
@@ -724,6 +737,102 @@ def _rule_thread_safety(mods: List[Module], findings: List[Finding]) -> None:
                         ))
 
 
+# -------------------------------------------- DRT007 metric label cardinality
+
+# Metric-constructing calls whose label values the rule inspects.
+_METRIC_FACTORIES = frozenset({
+    "counter", "gauge", "histogram", "register_callback",
+})
+
+# Identifier shapes that smell like per-request data. Deliberately
+# name-based (this is a static rule): `user_id`, `uid`, `raw_key`,
+# `request`, `req`, `query`, `session_id`, `item_id`, `example` —
+# underscore-delimited so `table`/`stage`/`shard` never match.
+_REQ_NAME_RE = re.compile(
+    r"(?:^|_)(user|uid|key|request|req|query|session|item|example|row|id)"
+    r"s?(?:_|$)",
+    re.IGNORECASE,
+)
+
+
+def _per_request_refs(expr: ast.AST) -> List[str]:
+    """Names inside `expr` (including through f-strings, str() calls,
+    attributes, subscripts) that match the per-request pattern."""
+    hits = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and _REQ_NAME_RE.search(node.id):
+            hits.append(node.id)
+        elif isinstance(node, ast.Attribute) and \
+                _REQ_NAME_RE.search(node.attr):
+            hits.append(node.attr)
+    return hits
+
+
+def _label_dict_of(call: ast.Call) -> Optional[ast.Dict]:
+    """The labels dict literal of a metric-factory call, if visible:
+    `labels={...}` kwarg, or ANY positional dict literal — the factories
+    take labels at different positions (counter/gauge/histogram: (name,
+    help, labels); register_callback: (name, fn, help, labels)), and a
+    dict literal in a metric-factory call is a labels dict in every
+    idiom this rule covers."""
+    for kw in call.keywords:
+        if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+            return kw.value
+    for a in call.args:
+        if isinstance(a, ast.Dict):
+            return a
+    return None
+
+
+def _rule_label_cardinality(mod: Module, findings: List[Finding]) -> None:
+    encl = _enclosing_functions(mod.tree)
+
+    def scope_of(node):
+        fn = encl.get(node)
+        while fn is not None and isinstance(fn, ast.Lambda):
+            fn = encl.get(fn)
+        return fn.name if fn is not None else "<module>"
+
+    def flag(node, label, refs):
+        findings.append(Finding(
+            "DRT007", mod.relpath, node.lineno, node.col_offset,
+            scope_of(node),
+            f"metric label {label} takes a value derived from per-request "
+            f"data ({', '.join(sorted(set(refs)))}): unbounded series "
+            "cardinality — label from a bounded set instead, or justify "
+            "with a noqa",
+            mod.snippet_at(node.lineno),
+        ))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        if attr in _METRIC_FACTORIES:
+            d = _label_dict_of(node)
+            if d is None:
+                continue
+            for k, v in zip(d.keys, d.values):
+                refs = _per_request_refs(v)
+                if refs:
+                    key = (repr(k.value) if isinstance(k, ast.Constant)
+                           else "<dynamic>")
+                    flag(node, key, refs)
+        elif attr == "labels":
+            # prometheus-client idiom: metric.labels(user=uid, ...)
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                refs = _per_request_refs(kw.value)
+                if refs:
+                    flag(node, repr(kw.arg), refs)
+            for a in node.args:
+                refs = _per_request_refs(a)
+                if refs:
+                    flag(node, "<positional>", refs)
+
+
 # --------------------------------------------------- DRT005 / DRT006 hygiene
 
 
@@ -843,6 +952,8 @@ def run_rules(mods: List[Module],
             _rule_unused_imports(m, findings)
         if "DRT006" in want:
             _rule_shadowed_names(m, findings)
+        if "DRT007" in want:
+            _rule_label_cardinality(m, findings)
     if "DRT002" in want:
         _rule_host_sync(mods, findings)
     if "DRT004" in want:

@@ -369,6 +369,37 @@ class MultiTierTable:
         self._pending: Optional[dict] = None
         self.sync_stall_ms: float = 0.0
         self.on_io = None
+        # obs plane: per-table tier movement counters + occupancy gauges
+        # (table label = config name, a bounded set). No-op singletons
+        # when DEEPREC_OBS=off.
+        from deeprec_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.default_registry()
+        lab = {"table": cfg.name}
+        self._m_demoted = reg.counter(
+            "deeprec_tier_demoted_rows", "device→host demotions", lab)
+        self._m_promoted = reg.counter(
+            "deeprec_tier_promoted_rows", "host/disk→device promotions",
+            lab)
+        self._m_spilled = reg.counter(
+            "deeprec_tier_spilled_rows", "host→disk spills", lab)
+        self._m_host_size = reg.gauge(
+            "deeprec_tier_host_rows", "host-tier resident rows", lab)
+        self._m_device_size = reg.gauge(
+            "deeprec_tier_device_rows", "device-tier live rows", lab)
+        self._m_stall = reg.gauge(
+            "deeprec_tier_sync_stall_ms",
+            "cumulative caller-side tier sync stall", lab)
+
+    def _publish_obs(self, stats: "TierStats") -> None:
+        """Fold one sync round's TierStats into the obs plane — values
+        the round already computed, no extra device traffic."""
+        self._m_demoted.inc(stats.demoted)
+        self._m_promoted.inc(stats.promoted)
+        self._m_spilled.inc(stats.spilled)
+        self._m_host_size.set(stats.host_size)
+        self._m_device_size.set(stats.device_size)
+        self._m_stall.set(self.sync_stall_ms)
 
     # --------------------------------------------------------- packed rows
 
@@ -580,6 +611,7 @@ class MultiTierTable:
         stats.device_size = int(self.table.size(state))
         if self.disk is not None:
             stats.disk_size = len(self.disk)
+        self._publish_obs(stats)
         return state, stats
 
     # ------------------------------------------------------ overlapped sync
@@ -640,6 +672,7 @@ class MultiTierTable:
         )
         self._worker.start()
         self.sync_stall_ms += (time.perf_counter() - t0) * 1e3
+        self._publish_obs(stats)
         return state, stats
 
     def join(self) -> None:
@@ -678,6 +711,7 @@ class MultiTierTable:
         if self.disk is not None:
             stats.disk_size = len(self.disk)
         self.sync_stall_ms += (time.perf_counter() - t0) * 1e3
+        self._publish_obs(stats)
         return state, stats
 
     def _worker_main(self, demote_pkg, snap) -> None:
@@ -686,6 +720,9 @@ class MultiTierTable:
         overflow. READ-only on promotion sources — erasure happens at
         apply time on the training thread."""
         try:
+            from deeprec_tpu.obs import trace as obs_trace
+
+            t0w = time.time()
             if self.on_io is not None:
                 self.on_io()  # test seam (ordering-based overlap tests)
             if demote_pkg is not None:
@@ -741,6 +778,11 @@ class MultiTierTable:
                 self.disk.put(ks[out], vs[out], fs[out], vers[out])  # noqa: DRT004 — spill write, round-exclusive ownership
                 self.host.erase(ks[out])  # noqa: DRT004 — spill erase, round-exclusive ownership
                 self._spilled_bg = int(n_spill)
+            # obs timeline span: one background tier-IO round (demote put
+            # + promote scan + spill) — the "tier worker" track of the
+            # training timeline. No-op unless DEEPREC_TRACE is set.
+            obs_trace.phase_span("tier_io_round", t0w, time.time(),
+                                 cat="train")
         except BaseException as e:
             self._worker_err = e
 

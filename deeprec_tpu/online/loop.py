@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, Optional
 
+from deeprec_tpu.obs import metrics as obs_metrics
+from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.online.supervisor import Heartbeat
 from deeprec_tpu.parallel.elastic import EXIT_RESCALE, ElasticCoordinator
 from deeprec_tpu.training.checkpoint import CheckpointManager
@@ -93,6 +95,23 @@ class TrainLoop:
         self.save_failures = 0
         self.last_save_step: Optional[int] = None
         self.last_save_error: Optional[str] = None
+        # obs plane (process-wide registry; no-op singletons when off):
+        # one counter inc per step is the whole per-step cost — the
+        # counter's own ring answers steps/sec over any window, and the
+        # gauge is refreshed at save cadence so scrapes between saves
+        # stay free.
+        reg = obs_metrics.default_registry()
+        self._m_steps = reg.counter(
+            "deeprec_train_steps", "training steps completed")
+        self._m_step = reg.gauge(
+            "deeprec_train_step", "current train step")
+        self._m_steps_per_sec = reg.gauge(
+            "deeprec_train_steps_per_sec",
+            "training throughput over the trailing 30 s window")
+        self._m_saves = reg.counter(
+            "deeprec_train_saves", "cadence checkpoint saves")
+        self._m_save_failures = reg.counter(
+            "deeprec_train_save_failures", "cadence saves that failed")
         # Whether the chain has (or will durably have — an async full may
         # still be in flight) an anchor; checking latest_full() alone
         # would race the background writer and over-anchor.
@@ -155,6 +174,7 @@ class TrainLoop:
         want_full = (
             not self._anchored or (self.saves + 1) % self.full_every == 0
         )
+        t0w = time.time()
         try:
             if want_full:
                 state, path = self.ckpt.save_async(state)
@@ -164,10 +184,17 @@ class TrainLoop:
             self.saves += 1
             self.last_save_step = step
             self.last_save_error = None
+            self._m_saves.inc()
+            self._m_step.set(step)
+            self._m_steps_per_sec.set(self._m_steps.window_rate(30.0))
+            obs_trace.phase_span(
+                "ckpt_save_" + ("full" if want_full else "delta"),
+                t0w, time.time(), cat="train")
             self._print(f"SAVED {os.path.basename(path).split('-')[0]} {step}")
         except Exception as e:
             self.save_failures += 1
             self.last_save_error = str(e)
+            self._m_save_failures.inc()
             # A failed writer may have taken the would-be anchor with it;
             # re-derive from disk so the next cadence re-anchors if needed.
             self._anchored = self.ckpt.latest_full() is not None
@@ -201,6 +228,7 @@ class TrainLoop:
                 state, {k: jnp.asarray(v) for k, v in batch.items()}
             )
             step += 1
+            self._m_steps.inc()
             if self.log_every and step % self.log_every == 0:
                 self._print(f"STEP {step} {float(mets['loss']):.5f}")  # noqa: DRT002 — log-cadence-gated sync, deliberate
             if step % self.save_every == 0:
@@ -336,15 +364,13 @@ class ServeLoop:
         self.poll_rounds += 1
         if self.heartbeat is None:
             return
+        # The heartbeat payload IS the unified health schema
+        # (obs/schema.py — the predictor emits it), re-stamped with the
+        # poll round's own status; historical keys ride along as
+        # canonical members, so existing readers keep working.
         h = self.predictor.health()
-        self.heartbeat.beat(
-            step=h["step"],
-            status=status if status != "ok" else h["status"],
-            model_version=h["model_version"],
-            staleness_seconds=h["staleness_seconds"],
-            consecutive_poll_failures=h["consecutive_poll_failures"],
-            quarantined=h["quarantined"],
-        )
+        h["status"] = status if status != "ok" else h["status"]
+        self.heartbeat.beat(**h)
 
     def pause(self) -> None:
         self._paused.set()

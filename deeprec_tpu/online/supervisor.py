@@ -341,8 +341,21 @@ class Supervisor:
             return False
 
     def stats(self) -> Dict[str, Dict]:
+        """Per-worker restart accounting (the numbers
+        tools/bench_freshness.py records per injected fault), extended
+        with the lease view — heartbeat age and remaining restart
+        budget — and mirrored into the obs plane as per-worker gauges
+        (deeprec_supervisor_*, worker=<spec name>: bounded label set)."""
+        from deeprec_tpu.obs import metrics as obs_metrics
+
+        reg = (obs_metrics.default_registry()
+               if obs_metrics.metrics_enabled() else None)
+        specs = {s.name: s for s in self.specs}
         out = {}
         for name, st in self._states.items():
+            spec = specs.get(name)
+            hb_age = (Heartbeat.age(spec.heartbeat_path)
+                      if spec is not None and spec.heartbeat_path else None)
             out[name] = {
                 "restarts": st.restarts,
                 "wedge_kills": st.wedge_kills,
@@ -352,7 +365,29 @@ class Supervisor:
                 "gave_up": st.gave_up,
                 "done": st.done,
                 "alive": st.proc is not None and st.proc.poll() is None,
+                "heartbeat_age_seconds": (
+                    round(hb_age, 3) if hb_age is not None else None),
+                "restart_budget_remaining": (
+                    max(0, spec.max_restarts - st.consecutive_failures)
+                    if spec is not None else None),
             }
+            if reg is not None:
+                lab = {"worker": name}
+                reg.gauge("deeprec_supervisor_restarts",
+                          "worker restarts", lab).set(st.restarts)
+                reg.gauge("deeprec_supervisor_wedge_kills",
+                          "wedge-detected kills", lab).set(st.wedge_kills)
+                reg.gauge("deeprec_supervisor_restart_budget_remaining",
+                          "consecutive failures left before give-up",
+                          lab).set(out[name]["restart_budget_remaining"]
+                                   or 0)
+                reg.gauge("deeprec_supervisor_alive",
+                          "worker process liveness",
+                          lab).set(1 if out[name]["alive"] else 0)
+                if hb_age is not None:
+                    reg.gauge("deeprec_supervisor_heartbeat_age_seconds",
+                              "age of the worker's lease stamp",
+                              lab).set(hb_age)
         return out
 
     def stop(self, kill_workers: bool = True) -> None:

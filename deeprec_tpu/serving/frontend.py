@@ -55,6 +55,9 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from deeprec_tpu.analysis.annotations import guarded_by
+from deeprec_tpu.obs import metrics as obs_metrics
+from deeprec_tpu.obs import schema as obs_schema
+from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.serving.predictor import (
     BadRequest,
@@ -68,10 +71,15 @@ OP_HLTH = b"HLTH"
 OP_STAT = b"STAT"
 OP_POLL = b"POLL"
 OP_INFO = b"INFO"
+OP_METR = b"METR"  # obs metrics snapshot (JSON) — the /metrics merge op
 _OK = b"OK  "
 _ERR = b"ERR "
 
 _FLAG_GROUP_USERS = 1
+# bit1: the npz body is prefixed by obs_trace.WIRE_BYTES of trace
+# context (two LE u64s: trace id, parent span id) — how a sampled
+# request's trace id crosses the frontend->backend socket hop
+_FLAG_TRACE = 2
 
 
 # ------------------------------------------------------------ frame helpers
@@ -184,11 +192,16 @@ class BackendServer:
             if not body:
                 raise BadRequest("empty PRED body")
             grouped = bool(body[0] & _FLAG_GROUP_USERS)
-            batch = _unpack_arrays(body[1:])
+            off = 1
+            ctx = None
+            if body[0] & _FLAG_TRACE:
+                ctx = obs_trace.unpack_wire(body[1:1 + obs_trace.WIRE_BYTES])
+                off = 1 + obs_trace.WIRE_BYTES
+            batch = _unpack_arrays(body[off:])
             if not batch:
                 raise BadRequest("missing 'features' object")
             probs, version = self.server.request_versioned(
-                batch, group_users=grouped)
+                batch, group_users=grouped, trace_ctx=ctx)
             out = {"__version__": np.int64(version)}
             if isinstance(probs, dict):
                 for k, v in probs.items():
@@ -211,6 +224,12 @@ class BackendServer:
             return _OK, json.dumps({"updated": updated}).encode()
         if op == OP_INFO:
             return _OK, json.dumps(self.server.predictor.model_info()).encode()
+        if op == OP_METR:
+            # obs-plane snapshot (mergeable JSON, obs/metrics.py): the
+            # frontend relabels it per member for the tier /metrics.
+            fn = getattr(self.server, "metrics_snapshot", None)
+            snap = fn() if fn is not None else {"metrics": {}}
+            return _OK, json.dumps(snap).encode()
         raise BadRequest(f"unknown op {op!r}")
 
     def start(self) -> "BackendServer":
@@ -265,6 +284,10 @@ class _Member:
         self.requests = 0
         self.errors = 0
         self.health: Dict = {}
+        # Last obs snapshot this member answered with: a DOWN member's
+        # series re-render from it stale-marked — visible absence, not
+        # silent disappearance (guarded by _lock like the rest).
+        self.last_metrics: Optional[Dict] = None
         self._rng = random.Random((host, port).__hash__() & 0xFFFFFFFF)
 
     @property
@@ -484,6 +507,16 @@ class Frontend:
         self.timeout = timeout
         self.poll_backends = poll_backends
         self.stats = ServingStats()
+        r = self.stats.registry
+        if r is not None:
+            r.register_callback(
+                "deeprec_frontend_members", lambda: len(self._members),
+                "configured backend members")
+            r.register_callback(
+                "deeprec_frontend_members_up",
+                lambda: sum(1 for m in self._members
+                            if m.available(time.monotonic())),
+                "members currently routable (not backed off)")
         self.update_failures = 0  # _run_poll_loop accounting
         self.predictor = _FrontendPredictor(self, model)
         self._rr = itertools.count()
@@ -568,20 +601,32 @@ class Frontend:
 
     def request_versioned(self, features: Dict[str, np.ndarray],
                           timeout: Optional[float] = None,
-                          group_users: bool = False):
+                          group_users: bool = False,
+                          trace_ctx: Optional[Tuple[int, int]] = None):
         """(result, model_version) through whichever backend answered.
         The version stamps the BACKEND snapshot that served the whole
-        request (coalesced neighbors on that backend share it)."""
+        request (coalesced neighbors on that backend share it).
+
+        A sampled trace context (`trace_ctx`, or the calling thread's
+        open span — the HTTP edge's) crosses the socket hop as a
+        16-byte prefix on the PRED frame (_FLAG_TRACE), so the backend's
+        dispatch + stage spans land under the same trace id."""
         t0 = time.monotonic()
         rows = (int(np.asarray(next(iter(features.values()))).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
                 if features else 0)
+        sp = obs_trace.span("frontend_dispatch", "serving", ctx=trace_ctx)
         flags = _FLAG_GROUP_USERS if group_users else 0
-        body = bytes([flags]) + _pack_arrays(features)
+        prefix = b""
+        if sp.ctx is not None:
+            flags |= _FLAG_TRACE
+            prefix = obs_trace.pack_wire(sp.ctx)
+        body = bytes([flags]) + prefix + _pack_arrays(features)
         start = (self._group_key(features) % len(self._members)
                  if group_users else next(self._rr))
         try:
-            status, resp = self._call_any(OP_PRED, body, start=start,
-                                          timeout=timeout)
+            with sp:
+                status, resp = self._call_any(OP_PRED, body, start=start,
+                                              timeout=timeout)
         except Exception:
             self.stats.record_error()
             raise
@@ -646,14 +691,17 @@ class Frontend:
     def _probe_member(self, m: _Member) -> Dict:
         try:
             status, body = m.call(OP_HLTH, b"", self.HEALTH_PROBE_SECS)
-            h = json.loads(body) if status == _OK else {
-                "status": "degraded", "error": body.decode("utf-8",
-                                                           "replace")}
+            h = (json.loads(body) if status == _OK
+                 else obs_schema.health_payload(
+                     "degraded", error=body.decode("utf-8", "replace")))
             m.mark_up(h)
         except (OSError, ConnectionError) as e:
             m.mark_down()
-            h = {"status": "down", "member": m.addr, "error": str(e),
-                 "staleness_seconds": float("inf")}
+            # synthetic entry for a dead process — same unified schema
+            # (obs/schema.py) as a live member's own health payload
+            h = obs_schema.health_payload(
+                "down", staleness_seconds=float("inf"),
+                member=m.addr, error=str(e))
         h["member"] = m.addr
         return h
 
@@ -685,8 +733,8 @@ class Frontend:
             if h["status"] != "ok" and worst["status"] == "ok":
                 worst = h
             elif (h["status"] != "ok") == (worst["status"] != "ok") and (
-                h.get("staleness_seconds", 0) > worst.get(
-                    "staleness_seconds", 0)):
+                (h.get("staleness_seconds") or 0) > (
+                    worst.get("staleness_seconds") or 0)):
                 worst = h
         out = dict(worst)
         if out.get("staleness_seconds") == float("inf"):
@@ -731,6 +779,79 @@ class Frontend:
         out["model"] = model
         out["health"] = self._health_sweep()
         return out
+
+    # ------------------------------------------------------------- metrics
+
+    # Scrape budget per member: /metrics is a watchdog-adjacent surface —
+    # one wedged backend must cost the scrape ~2 s, not timeout × N, and
+    # members are probed in PARALLEL (the _health_sweep discipline).
+    METRICS_PROBE_SECS = 2.0
+
+    def _member_metrics(self, m: _Member) -> Tuple[Optional[Dict], bool]:
+        """(snapshot, stale): a live member answers METR and refreshes
+        its cache; a down (or just-failed) member serves its LAST known
+        snapshot with stale=True — a killed backend's series must stay
+        visible in the merge, marked, never silently vanish. A failed
+        scrape deliberately does NOT mark the member down: observability
+        traffic must never mutate request-routing state (an external
+        scraper's cadence would otherwise drive serving availability)."""
+        if m.available(time.monotonic()):
+            try:
+                status, body = m.call(OP_METR, b"",
+                                      min(self.timeout,
+                                          self.METRICS_PROBE_SECS))
+                if status == _OK:
+                    snap = json.loads(body)
+                    with m._lock:
+                        m.last_metrics = snap
+                    return snap, False
+            except (OSError, ConnectionError):
+                pass
+        with m._lock:
+            return m.last_metrics, True
+
+    def metrics_text(self) -> str:
+        """The tier's `GET /metrics`: the frontend's own edge series +
+        the process-wide plane + every member's snapshot relabeled with
+        member="host:port" (down members stale="1"), plus a
+        deeprec_member_up gauge per member — one scrape shows the whole
+        tier's load balance and who is missing from it. Duplicate
+        family headers across the per-member blocks are collapsed
+        (concat_prometheus) so real Prometheus parsers accept the body."""
+        parts = []
+        if self.stats.registry is not None:
+            parts.append(obs_metrics.render_snapshot(
+                self.stats.registry.snapshot(),
+                extra_labels={"tier": "frontend"}))
+        if obs_metrics.metrics_enabled():
+            parts.append(
+                obs_metrics.default_registry().render_prometheus())
+        slots: List[Optional[Tuple[Optional[Dict], bool]]] = \
+            [None] * len(self._members)
+        if len(self._members) == 1:
+            slots[0] = self._member_metrics(self._members[0])
+        else:
+            def probe(i, m):
+                slots[i] = self._member_metrics(m)
+
+            threads = [threading.Thread(target=probe, args=(i, m),
+                                        daemon=True)
+                       for i, m in enumerate(self._members)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        up_lines = ["# TYPE deeprec_member_up gauge"]
+        for m, got in zip(self._members, slots):
+            snap, stale = got if got is not None else (None, True)
+            up_lines.append(
+                'deeprec_member_up{member="%s"} %d'
+                % (m.addr, 0 if stale else 1))
+            if snap:
+                parts.append(obs_metrics.render_snapshot(
+                    snap, extra_labels={"member": m.addr}, stale=stale))
+        parts.append("\n".join(up_lines) + "\n")
+        return obs_metrics.concat_prometheus(parts)
 
     def close(self) -> None:
         self._stop.set()

@@ -35,6 +35,8 @@ from typing import Optional
 
 import numpy as np
 
+from deeprec_tpu.obs import metrics as obs_metrics
+from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.serving.predictor import (
     BadRequest,
     ModelServer,
@@ -79,6 +81,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   ctype: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _named(self, name: str) -> Optional[ModelServer]:
         srv = self.servers.get(name)
         if srv is None:
@@ -105,6 +116,20 @@ class _Handler(BaseHTTPRequestHandler):
             # Live per-stage serving histograms — the same accounting
             # tools/bench_serving.py records per measured configuration.
             self._send(200, self.model_server.stats_snapshot())
+        elif self.path == "/metrics":
+            # Prometheus-text exposition of the obs plane: this server's
+            # serving series + the process-wide registry (training /
+            # supervisor / placement gauges). A Frontend merges every
+            # backend's series here, stale-marking down members. Must
+            # never 500 — a scrape is a watchdog surface.
+            try:
+                fn = getattr(self.model_server, "metrics_text", None)
+                text = (fn() if fn is not None
+                        else obs_metrics.default_registry()
+                        .render_prometheus())
+            except Exception as e:
+                return self._send_text(503, f"# metrics error: {e}\n")
+            self._send_text(200, text)
         elif (self.path.startswith("/v1/models/")
               and self.path.endswith("/stats")):
             srv = self._named(self.path[len("/v1/models/"):-len("/stats")])
@@ -206,6 +231,15 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send(400, {"error": str(e)})
         try:
+            # Sampled request tracing: continue the caller's context from
+            # the X-Deeprec-Trace header, or make the edge sampling
+            # decision here; the span context rides into the micro-batcher
+            # (and, through a Frontend, over the TCP frames to a backend)
+            # so one trace id spans edge -> dispatch -> stage spans. The
+            # no-op singleton makes this line free with tracing off.
+            edge = obs_trace.server_span(
+                "http_predict", "edge",
+                header=self.headers.get(obs_trace.HEADER))
             if payload.get("group_users"):
                 # sample-aware compression: a <user, N items> request
                 # rides the grouped lane of the coalescing queue — many
@@ -214,13 +248,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # (the batcher never mixes grouped and plain requests:
                 # they dispatch through different traces).
                 try:
-                    probs, version = server.request_versioned(
-                        batch, group_users=True)
+                    with edge:
+                        probs, version = server.request_versioned(
+                            batch, group_users=True)
                 except (BadRequest, ValueError) as e:  # no tower split
                     return self._send(400, getattr(e, "details",
                                                    {"error": str(e)}))
             else:
-                probs, version = server.request_versioned(batch)
+                with edge:
+                    probs, version = server.request_versioned(batch)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}
             else:

@@ -32,6 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeprec_tpu.obs import metrics as obs_metrics
+from deeprec_tpu.obs import schema as obs_schema
+from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.optim.sparse import GradientDescent
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.training.checkpoint import CheckpointManager
@@ -257,6 +260,14 @@ class Predictor:
         self.last_good_version = 0
         self.last_poll_ok_time = time.monotonic()
         self.last_update_time = time.monotonic()
+        # Train-to-serve lag of the LAST applied update: wall-clock age
+        # of the newest applied checkpoint's manifest at swap time (the
+        # trainer committed it then; serving started answering from it
+        # now). None until the first post-boot update. The obs plane
+        # exposes it as the deeprec_train_to_serve_lag_seconds gauge,
+        # and tools/bench_freshness.py pins it against its own
+        # probe-measured freshness lag.
+        self.last_apply_lag_seconds: Optional[float] = None
         # Test seam: called after the next state is fully built and
         # warmed, immediately before the snapshot swap — lets tests gate
         # the publish on an event (torn-read pinning) without wall-clock.
@@ -359,6 +370,7 @@ class Predictor:
         `consecutive_poll_failures` for the watchdogs and re-raises — the
         caller loop (`_run_poll_loop`) retries with capped backoff."""
         t0 = time.perf_counter()
+        t0w = time.time()
         try:
             with self._lock:
                 changed = self._poll_locked(t0)
@@ -368,7 +380,29 @@ class Predictor:
         self.consecutive_poll_failures = 0
         self.last_poll_ok_time = time.monotonic()
         self.last_good_version = self._snap.version
+        if changed:
+            # online-timeline event: the delta poll that changed the model
+            obs_trace.phase_span("delta_poll", t0w, time.time(),
+                                 cat="online")
         return changed
+
+    def _stamp_apply_lag(self, dirnames) -> None:
+        """Record the wall-clock age of the freshest checkpoint this
+        round applied (manifest mtime = the trainer's commit instant) —
+        the live train-to-serve lag signal. Host-side file metadata
+        only; failure to stat must never fail the update."""
+        newest = None
+        for d in dirnames:
+            try:
+                m = os.path.getmtime(
+                    os.path.join(self._ck.dir, d, "manifest.json"))
+            except OSError:
+                continue
+            if newest is None or m > newest:
+                newest = m
+        if newest is not None:
+            self.last_apply_lag_seconds = round(
+                max(0.0, time.time() - newest), 3)
 
     def _poll_locked(self, t0: float) -> bool:
         new = [d for d in self._dirs() if d not in self._applied]
@@ -376,9 +410,11 @@ class Predictor:
             return False
         if any(d.startswith("full-") for d in new):
             self.reload()
+            self._stamp_apply_lag(new)
         else:
             state = self._snap.state
             applied = set(self._applied)
+            replayed: List[str] = []
             progressed = False
             for d in sorted(new, key=lambda s: int(s.split("-")[1])):  # noqa: DRT002 — host string parse of a checkpoint dir name, no device value
                 path = os.path.join(self._ck.dir, d)
@@ -395,12 +431,14 @@ class Predictor:
                     self._ck.quarantine(path, f"delta replay failed: {e}")
                     break
                 applied.add(d)
+                replayed.append(d)
                 progressed = True
             if not progressed:
                 return False
             if self._device is not None:
                 state = jax.device_put(state, self._device)
             self._publish(state, applied)
+            self._stamp_apply_lag(replayed)
         self.update_count += 1
         self.last_update_time = time.monotonic()
         self.last_update_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -411,20 +449,23 @@ class Predictor:
         and the ServeLoop heartbeat payload. `staleness_seconds` is the
         age of the last successful poll round (the last time serving
         CONFIRMED it is as fresh as the checkpoint dir), not the age of
-        the last model change — an idle trainer is not staleness."""
+        the last model change — an idle trainer is not staleness.
+
+        The payload is the unified obs schema (obs/schema.py) — the one
+        shape the frontend sweep and the online-loop heartbeat also
+        emit; every historical key is a canonical member of it."""
         now = time.monotonic()
-        return {
-            "status": "ok" if self.consecutive_poll_failures == 0
-            else "degraded",
-            "model_version": self.version,
-            "step": self.step,
-            "staleness_seconds": round(now - self.last_poll_ok_time, 3),
-            "last_update_age_seconds": round(
-                now - self.last_update_time, 3),
-            "consecutive_poll_failures": self.consecutive_poll_failures,
-            "last_good_version": self.last_good_version,
-            "quarantined": self._ck.quarantine_count,
-        }
+        return obs_schema.health_payload(
+            "ok" if self.consecutive_poll_failures == 0 else "degraded",
+            model_version=self.version,
+            step=self.step,
+            staleness_seconds=round(now - self.last_poll_ok_time, 3),
+            last_update_age_seconds=round(now - self.last_update_time, 3),
+            consecutive_poll_failures=self.consecutive_poll_failures,
+            last_good_version=self.last_good_version,
+            quarantined=self._ck.quarantine_count,
+            train_to_serve_lag_seconds=self.last_apply_lag_seconds,
+        )
 
     # ------------------------------------------------------------- predict
 
@@ -697,6 +738,18 @@ def _run_poll_loop(owner, stop: threading.Event, secs: float,
                 pass  # accounting must never kill the poller
 
 
+def _server_metrics_snapshot(stats: ServingStats) -> Dict:
+    """One mergeable snapshot for a serving front: its own obs-plane
+    series + the process-wide plane (training/supervisor/placement
+    gauges) — the body of the METR wire op and of `GET /metrics`.
+    Shared by ModelServer and ServerGroup so the frontend's merge sees
+    one shape regardless of which server type backs a member."""
+    snaps = [stats.metrics_snapshot()]
+    if obs_metrics.metrics_enabled():
+        snaps.append(obs_metrics.default_registry().snapshot())
+    return obs_metrics.merge_snapshots([s for s in snaps if s])
+
+
 class ModelServer:
     """Micro-batching front: coalesce single requests into device batches.
 
@@ -738,6 +791,28 @@ class ModelServer:
         )
         self._carry = None  # request deferred to lead the next batch
         self._stop = threading.Event()
+        # obs plane collectors: evaluated at scrape time against live
+        # objects, zero cost between scrapes. A ServerGroup's members
+        # share one stats registry — re-registration replaces, so the
+        # group's /metrics shows one (shared-queue) depth and the last
+        # member's model identity, matching the shared-front semantics.
+        r = self.stats.registry
+        if r is not None:
+            r.register_callback(
+                "deeprec_serving_queue_depth", self._q.qsize,
+                "requests waiting in the coalescing queue")
+            r.register_callback(
+                "deeprec_serving_model_version",
+                lambda: self.predictor.version, "live snapshot version")
+            r.register_callback(
+                "deeprec_serving_staleness_seconds",
+                lambda: time.monotonic() - self.predictor.last_poll_ok_time,
+                "age of the last successful update poll round")
+            r.register_callback(
+                "deeprec_train_to_serve_lag_seconds",
+                lambda: self.predictor.last_apply_lag_seconds,
+                "trainer-commit to serving-swap age of the last applied "
+                "checkpoint")
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
         self._poller = None
@@ -822,14 +897,15 @@ class ModelServer:
             self._serve(pending)
 
     def _serve(
-        self, pending: List[Tuple[Dict, int, "queue.Queue", float, bool]]
+        self, pending: List[Tuple[Dict, int, "queue.Queue", float, bool,
+                                  Optional[tuple]]]
     ):
         t0 = time.monotonic()
         grouped = pending[0][4]  # homogeneous by _take's admission rule
-        for _, _, _, t_enq, _ in pending:
+        for _, _, _, t_enq, _, _ in pending:
             self.stats.record_stage("queue", t0 - t_enq)
-        reqs = [r for r, _, _, _, _ in pending]
-        sizes = [n for _, n, _, _, _ in pending]
+        reqs = [r for r, _, _, _, _, _ in pending]
+        sizes = [n for _, n, _, _, _, _ in pending]
         batch = {
             k: np.concatenate([np.asarray(r[k]) for r in reqs])  # noqa: DRT002 — micro-batch assembly of host request payloads before the one dispatch
             for k in reqs[0]
@@ -855,7 +931,7 @@ class ModelServer:
             t2 = time.monotonic()
             self.stats.record_stage("device", t2 - t1)
             off = 0
-            for (_, _, reply, _, _), n in zip(pending, sizes):
+            for (_, _, reply, _, _, _), n in zip(pending, sizes):
                 sl = (
                     {k: v[off : off + n] for k, v in probs.items()}
                     if isinstance(probs, dict)
@@ -863,11 +939,29 @@ class ModelServer:
                 )
                 reply.put((sl, version))
                 off += n
-            self.stats.record_stage("post", time.monotonic() - t2)
+            t3 = time.monotonic()
+            self.stats.record_stage("post", t3 - t2)
             self.stats.record_batch(len(pending), total)
+            if obs_trace.tracing_enabled():
+                # Retrospective per-request stage spans off the timings
+                # already accounted above (tracing adds emission, never a
+                # second clock): every sampled request in the batch gets
+                # its own queue/pad/device/post children under its
+                # dispatch span. monotonic -> wall via one offset.
+                wall = time.time() - t3
+                for _, _, _, t_enq, _, ctx in pending:
+                    if ctx is None:
+                        continue
+                    for nm, a, b in (("stage_queue", t_enq, t0),
+                                     ("stage_pad", t0, t1),
+                                     ("stage_device", t1, t2),
+                                     ("stage_post", t2, t3)):
+                        obs_trace.emit(nm, "serving", wall + a, wall + b,
+                                       ctx=obs_trace.child(ctx),
+                                       parent=ctx[1])
         except Exception as e:
             self.stats.record_error(len(pending))
-            for _, _, reply, _, _ in pending:
+            for _, _, reply, _, _, _ in pending:
                 reply.put(e)
 
     def _buckets(self) -> List[int]:
@@ -914,7 +1008,8 @@ class ModelServer:
         return len(sizes)
 
     def submit(self, features: Dict[str, np.ndarray],
-               group_users: bool = False) -> "queue.Queue":
+               group_users: bool = False,
+               trace_ctx: Optional[tuple] = None) -> "queue.Queue":
         """Enqueue one request onto the coalescing queue and return the
         reply queue (a one-shot future: `.get()` yields `(result,
         model_version)` or an Exception). The non-blocking half of
@@ -940,7 +1035,8 @@ class ModelServer:
         )
         t0 = time.monotonic()
         self._arrivals.note(t0, rows)
-        self._q.put((features, rows, reply, t0, bool(group_users)))
+        self._q.put((features, rows, reply, t0, bool(group_users),
+                     trace_ctx))
         return reply
 
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0,
@@ -950,15 +1046,22 @@ class ModelServer:
 
     def request_versioned(
         self, features: Dict[str, np.ndarray], timeout: float = 30.0,
-        group_users: bool = False,
+        group_users: bool = False, trace_ctx: Optional[tuple] = None,
     ):
         """(result, model_version) — the version the whole request was
         served from (one snapshot; coalesced neighbors share it, so a
         grouped request's N candidate scores are stamped with ONE
-        version even when strangers' users rode the same device batch)."""
+        version even when strangers' users rode the same device batch).
+
+        `trace_ctx` (or the calling thread's open span — e.g. the HTTP
+        edge's) makes this request a sampled trace: a `dispatch` span
+        here plus the stage spans the batcher emits under it."""
         t0 = time.monotonic()
-        reply = self.submit(features, group_users=group_users)
-        out = reply.get(timeout=timeout)
+        sp = obs_trace.span("dispatch", "serving", ctx=trace_ctx)
+        with sp:
+            reply = self.submit(features, group_users=group_users,
+                                trace_ctx=sp.ctx)
+            out = reply.get(timeout=timeout)
         self.stats.record_stage("e2e", time.monotonic() - t0)
         if isinstance(out, Exception):
             raise out
@@ -977,6 +1080,13 @@ class ModelServer:
         out["health"] = p.health()
         out["residency"] = p.residency_info()
         return out
+
+    def metrics_snapshot(self) -> Dict:
+        return _server_metrics_snapshot(self.stats)
+
+    def metrics_text(self) -> str:
+        """Prometheus text for `GET /metrics` on this server."""
+        return obs_metrics.render_snapshot(self.metrics_snapshot())
 
     def close(self):
         self._stop.set()
@@ -1090,14 +1200,17 @@ class ServerGroup:
 
     def request_versioned(
         self, features: Dict[str, np.ndarray], timeout: float = 30.0,
-        group_users: bool = False,
+        group_users: bool = False, trace_ctx: Optional[tuple] = None,
     ):
         return self.members[0].request_versioned(
-            features, timeout=timeout, group_users=group_users)
+            features, timeout=timeout, group_users=group_users,
+            trace_ctx=trace_ctx)
 
     def submit(self, features: Dict[str, np.ndarray],
-               group_users: bool = False) -> "queue.Queue":
-        return self.members[0].submit(features, group_users=group_users)
+               group_users: bool = False,
+               trace_ctx: Optional[tuple] = None) -> "queue.Queue":
+        return self.members[0].submit(features, group_users=group_users,
+                                      trace_ctx=trace_ctx)
 
     def warmup(self, example: Dict[str, np.ndarray],
                group_users: bool = False) -> int:
@@ -1120,6 +1233,12 @@ class ServerGroup:
         out["health"] = self.predictor.health()
         out["residency"] = ps[0].residency_info()
         return out
+
+    def metrics_snapshot(self) -> Dict:
+        return _server_metrics_snapshot(self.stats)
+
+    def metrics_text(self) -> str:
+        return obs_metrics.render_snapshot(self.metrics_snapshot())
 
     def close(self):
         self._stop.set()

@@ -1,5 +1,5 @@
 """Serving observability: per-request stage timers aggregated into
-histograms.
+histograms, reported into the obs metrics plane.
 
 Every request through the micro-batching front is accounted in four
 stages, the same decomposition bench.py's phase profiler gives training
@@ -17,17 +17,29 @@ One ``ServingStats`` may be shared by several ``ModelServer`` members
 describe the serving front as a whole. Snapshots are cheap JSON-ready
 dicts — `GET /v1/stats` returns one live, and tools/bench_serving.py
 records one per measured configuration.
+
+Registry adoption (obs/metrics.py): unless ``DEEPREC_OBS=off``, the
+stage histograms and counters live in a per-stats ``MetricsRegistry``
+(per-stats so two servers in one process never share series and
+`/v1/stats` stays per-server) — the SAME objects back both the legacy
+snapshot() and the Prometheus ``GET /metrics`` exposition, and their
+ring buffers answer windowed queries ("p99 over the last 60 s") for the
+autoscaler. With the plane off, plain ``LatencyHistogram``s keep the
+legacy surface identical at zero obs cost.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from deeprec_tpu.analysis.annotations import guarded_by
+from deeprec_tpu.obs import metrics as obs_metrics
 from deeprec_tpu.training.profiler import LatencyHistogram
 
 STAGES = ("queue", "pad", "device", "post", "e2e")
+
+_COUNTERS = ("requests", "batches", "rows", "errors")
 
 
 @guarded_by("_lock")
@@ -35,15 +47,40 @@ class ServingStats:
     """Thread-safe aggregate of the serving front's stage timers plus
     batch-shape and error counters."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional["obs_metrics.MetricsRegistry"]
+                 = None):
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
-        self.stage = {s: LatencyHistogram() for s in STAGES}
-        self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)  # rows, not s
+        if registry is None and obs_metrics.metrics_enabled():
+            registry = obs_metrics.MetricsRegistry()
+        self.registry = registry  # None when the obs plane is off
+        self._make_metrics()
         self.requests = 0
         self.batches = 0
         self.rows = 0
         self.errors = 0
+
+    def _make_metrics(self) -> None:
+        r = self.registry
+        if r is not None:
+            self.stage = {
+                s: r.histogram(
+                    "deeprec_serving_stage_seconds",
+                    "per-request serving stage latency", {"stage": s})
+                for s in STAGES
+            }
+            self.batch_rows = r.histogram(
+                "deeprec_serving_batch_rows",
+                "rows per coalesced device batch", lo=1.0, hi=1 << 20)
+            self._counters = {
+                k: r.counter(f"deeprec_serving_{k}",
+                             f"serving front {k} total")
+                for k in _COUNTERS
+            }
+        else:
+            self.stage = {s: LatencyHistogram() for s in STAGES}
+            self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)
+            self._counters = None
 
     # ----------------------------------------------------------- recording
 
@@ -56,12 +93,29 @@ class ServingStats:
             self.requests += n_requests
             self.rows += n_rows
         self.batch_rows.record(float(n_rows))
+        c = self._counters
+        if c is not None:
+            c["batches"].inc()
+            c["requests"].inc(n_requests)
+            c["rows"].inc(n_rows)
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:
             self.errors += n
+        if self._counters is not None:
+            self._counters["errors"].inc(n)
 
     # ----------------------------------------------------------- reporting
+
+    def window_p99_ms(self, stage: str = "e2e",
+                      seconds: float = 60.0) -> Optional[float]:
+        """p99 of `stage` over the trailing window (None with the obs
+        plane off) — the autoscaler's load signal, answered from the
+        metric's own ring buffer."""
+        h = self.stage.get(stage)
+        if self.registry is None or h is None:
+            return None
+        return h.window_summary(seconds)["p99_ms"]
 
     def snapshot(self) -> Dict:
         """JSON-ready view: per-stage latency summaries + counters. The
@@ -86,9 +140,18 @@ class ServingStats:
         }
         return out
 
+    def metrics_snapshot(self) -> Optional[Dict]:
+        """The registry snapshot (None with the plane off) — what the
+        socket frontend merges across backends for its `/metrics`."""
+        return None if self.registry is None else self.registry.snapshot()
+
     def reset(self) -> None:
         with self._lock:
-            self.stage = {s: LatencyHistogram() for s in STAGES}
-            self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)
+            if self.registry is not None:
+                # drops metric accumulations; collector callbacks
+                # registered on this registry (queue depth, model
+                # version) survive a stats reset by design
+                self.registry.reset()
+            self._make_metrics()
             self.requests = self.batches = self.rows = self.errors = 0
             self._t0 = time.monotonic()

@@ -933,8 +933,16 @@ class CheckpointManager:
             if self.on_write is not None:
                 self.on_write(plan.path)  # test seam (crash/overlap tests)
             t0 = time.perf_counter()
+            t0w = time.time()
             self._write_plan(plan)  # noqa: DRT004 — single-writer invariant: _save_async drains the previous writer, readers wait() first
             record["write_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            # obs timeline span: the background npz+manifest write — the
+            # "checkpoint writer" track of the train→delta→serve trace
+            # (no-op unless DEEPREC_TRACE is configured)
+            from deeprec_tpu.obs import trace as obs_trace
+
+            obs_trace.phase_span(f"ckpt_write_{plan.kind}", t0w,
+                                 time.time(), cat="train")
             if plan.kind == "full":
                 self._force_full = False  # chain re-anchored durably
         except BaseException as e:  # surfaced by wait()/next save/restore

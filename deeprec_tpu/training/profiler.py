@@ -70,8 +70,15 @@ class PhaseProfiler:
     def phase(self, name: str, block=None) -> Iterator[None]:
         """Time the enclosed block under `name`. Pass `block` (an array or
         pytree) to `jax.block_until_ready` before the clock stops so async
-        dispatch doesn't attribute device time to the NEXT phase."""
+        dispatch doesn't attribute device time to the NEXT phase.
+
+        When obs tracing is configured (DEEPREC_TRACE), each phase also
+        lands as a timeline span in the obs JSONL — the training half of
+        the train→delta→serve Perfetto timeline (tools/obs_trace.py)."""
+        from deeprec_tpu.obs import trace as obs_trace
+
         t0 = time.perf_counter()
+        t0w = time.time()
         with jax.profiler.TraceAnnotation(f"phase_{name}"):
             try:
                 yield
@@ -81,6 +88,7 @@ class PhaseProfiler:
                 self._times.setdefault(name, []).append(
                     time.perf_counter() - t0
                 )
+                obs_trace.phase_span(f"phase_{name}", t0w, time.time())
 
     def timed(self, name: str, fn, *args, **kwargs):
         """Run fn(*args, **kwargs), block on its result, record under

@@ -1031,7 +1031,42 @@ class Trainer:
                     out[fcol.resolve_table_name(f)]["per_shard"] = per_shard
                 if not b.stacked:
                     break  # shared-table bundles hold one merged counter
+        self._publish_dedup_obs(out)
         return out
+
+    @staticmethod
+    def _publish_dedup_obs(stats: Dict[str, Dict]) -> None:
+        """Mirror the dedup/per-shard telemetry into the obs plane:
+        per-table unique-fraction + overflow gauges, and — for sharded
+        trainers — the per-shard exchange-bytes series plus the max/mean
+        imbalance gauge whose windowed SLOPE is the drift signal
+        Placement v2's replan cadence keys off. Values are the host ints
+        this method already paid the device_get for; labels (table name,
+        shard index) are bounded sets."""
+        from deeprec_tpu.obs import metrics as obs_metrics
+
+        if not obs_metrics.metrics_enabled():
+            return
+        reg = obs_metrics.default_registry()
+        for tname, rec in stats.items():
+            lab = {"table": tname}
+            if rec.get("unique_fraction") is not None:
+                reg.gauge("deeprec_dedup_unique_fraction",
+                          "budgeted uniques + overflow over id positions",
+                          lab).set(rec["unique_fraction"])
+            reg.gauge("deeprec_dedup_overflow",
+                      "ids past the unique budget since last reset",
+                      lab).set(rec.get("dedup_overflow") or 0)
+            ps = rec.get("per_shard")
+            if not ps:
+                continue
+            reg.gauge("deeprec_shard_imbalance",
+                      "max/mean per-shard exchange-bytes imbalance",
+                      lab).set(ps["imbalance"])
+            for i, xb in enumerate(ps.get("exchange_bytes", ())):
+                reg.gauge("deeprec_shard_exchange_bytes",
+                          "modeled exchange bytes per mesh position",
+                          {"table": tname, "shard": str(i)}).set(xb)
 
     def update_budgets(
         self, state: TrainState, *, slack: float = 1.5, ema: float = 0.5

@@ -334,6 +334,56 @@ def test_drt006_param_shadowing(tmp_path):
     ]
 
 
+# ------------------------------------------------------------ DRT007 rule
+
+
+def test_drt007_flags_per_request_label_values(tmp_path):
+    """Label values interpolating per-request data (user ids, raw keys)
+    are unbounded-cardinality bugs — through dict literals, f-strings,
+    the positional labels arg, and the prometheus-style .labels()."""
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        def serve(reg, metric, user_id, raw_key, fn):
+            reg.counter("hits", "h", {"user": user_id}).inc()
+            reg.gauge("g", "h", labels={"key": f"k-{raw_key}"}).set(1)
+            reg.histogram("lat", "h", {"who": str(user_id)})
+            reg.register_callback("cb", fn, "h", {"req": raw_key})
+            metric.labels(user=user_id).inc()
+    """}, rules=["DRT007"])
+    assert codes(active) == ["DRT007"] * 5
+    assert all("unbounded" in f.message for f in active)
+
+
+def test_drt007_negatives_bounded_label_sets(tmp_path):
+    """Bounded label sources — constants, stage names, loop vars over
+    fixed tuples, table names, shard indices — are the contract, not a
+    finding; labels dicts the rule cannot see into are left alone."""
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        STAGES = ("queue", "pad", "device", "post")
+
+        def wire(reg, tname, labels):
+            reg.counter("ok", "h", {"stage": "queue"}).inc()
+            for s in STAGES:
+                reg.histogram("lat", "h", {"stage": s})
+            for i in range(8):
+                reg.gauge("xb", "h", {"table": tname, "shard": str(i)})
+            reg.counter("opaque", "h", labels)   # not a literal: skip
+    """}, rules=["DRT007"])
+    assert active == []
+
+
+def test_drt007_suppressable_and_repo_is_clean(tmp_path):
+    _, active = lint_files(tmp_path, {"pkg/m.py": """
+        def serve(reg, user_id):
+            reg.counter("hits", "h", {"user": user_id}).inc()  # noqa: DRT007 — bounded: user_id is a 4-way experiment arm
+    """}, rules=["DRT007"])
+    assert active == []
+    # the shipped tree (obs plane included) carries no DRT007 findings
+    mods = lint.collect_modules(lint.repo_root(), lint.DEFAULT_TARGETS)
+    repo_active, _ = lint.split_suppressed(
+        mods, lint.run_rules(mods, ["DRT007"]))
+    assert repo_active == []
+
+
 # ------------------------------------------- repo baseline + gate mechanics
 
 

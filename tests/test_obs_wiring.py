@@ -1,0 +1,259 @@
+"""obs plane wired through the serving stack: Prometheus /metrics on a
+backend server and merged across the socket tier (killed backend →
+stale-marked series, never silent disappearance), one trace id from the
+HTTP edge over the TCP frames into the backend stage spans, the unified
+health schema on every surface, the train-to-serve lag gauge, and the
+dedup/supervisor gauges on the process-wide registry."""
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.obs import metrics as M, schema, trace as T
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.serving import (
+    BackendServer,
+    Frontend,
+    HttpServer,
+    ModelServer,
+    Predictor,
+)
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.training.checkpoint import CheckpointManager
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("obs-wdl")
+    model = WDL(emb_dim=4, capacity=1 << 10, hidden=(8,), num_cat=2,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=32, num_cat=2, num_dense=2, vocab=300,
+                          seed=5)
+    for _ in range(2):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp), tr)
+    st, _ = ck.save(st)
+    req = {k: np.asarray(v)[:4] for k, v in gen.batch().items()
+           if not k.startswith("label")}
+    # train_step donates its state arg — tests that advance training must
+    # thread the live state through this holder
+    holder = {"st": st}
+    return model, tr, holder, ck, gen, str(tmp), req
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    T.shutdown()
+    yield
+    T.shutdown()
+
+
+def scrape(port):
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    return text, M.parse_prometheus(text)
+
+
+def test_backend_metrics_endpoint_and_lag_gauge(trained):
+    model, tr, holder, ck, gen, tmp, req = trained
+    pred = Predictor(model, tmp)
+    server = ModelServer(pred, max_batch=32, max_wait_ms=0.5)
+    http = HttpServer(server, port=0).start()
+    try:
+        server.request(req)
+        text, parsed = scrape(http.port)
+        names = {k[0] for k in parsed}
+        # serving series: per-stage histograms (p99 derivable), queue
+        # depth and model identity as live collector gauges
+        assert "deeprec_serving_stage_seconds_bucket" in names
+        assert "deeprec_serving_requests_total" in names
+        assert parsed[("deeprec_serving_queue_depth", "")] == 0.0
+        assert ("deeprec_serving_model_version", "") in parsed
+        # the lag gauge appears once an update has been APPLIED
+        assert "deeprec_train_to_serve_lag_seconds" not in names
+        holder["st"], _ = tr.train_step(holder["st"], J(gen.batch()))
+        holder["st"], _ = ck.save_incremental(holder["st"])
+        assert pred.poll_updates()
+        lag = pred.last_apply_lag_seconds
+        assert lag is not None and 0.0 <= lag < 30.0
+        _, parsed = scrape(http.port)
+        assert parsed[("deeprec_train_to_serve_lag_seconds", "")] == lag
+        # windowed query straight off the stats registry ring
+        p99 = server.stats.window_p99_ms("e2e", 60.0)
+        assert p99 is not None and p99 > 0.0
+    finally:
+        http.stop()
+        server.close()
+
+
+def test_stats_snapshot_health_uses_unified_schema(trained):
+    model, _, _, _, _, tmp, req = trained
+    pred = Predictor(model, tmp)
+    server = ModelServer(pred, max_batch=32, max_wait_ms=0.5)
+    try:
+        snap = server.stats_snapshot()
+        assert schema.is_health_payload(snap["health"])
+        assert snap["health"]["schema"] == schema.HEALTH_SCHEMA
+        # legacy keys unchanged for existing consumers
+        assert "staleness_seconds" in snap["health"]
+        assert "consecutive_poll_failures" in snap["health"]
+    finally:
+        server.close()
+
+
+def make_tier(model, tmp, n=2):
+    backends = [
+        BackendServer(
+            ModelServer(Predictor(model, tmp), max_batch=32,
+                        max_wait_ms=0.5)).start()
+        for _ in range(n)
+    ]
+    fe = Frontend([("127.0.0.1", b.port) for b in backends], model)
+    return backends, fe
+
+
+def test_frontend_metrics_merge_and_stale_marking(trained):
+    model, _, _, _, _, tmp, req = trained
+    backends, fe = make_tier(model, tmp)
+    http = HttpServer(fe, port=0).start()
+    try:
+        for _ in range(4):
+            fe.request(req)
+        text, parsed = scrape(http.port)
+        addrs = [m.addr for m in fe._members]
+        # every member's serving series appear relabeled, plus the
+        # frontend's own edge series and the per-member up gauge
+        for a in addrs:
+            assert parsed[("deeprec_member_up", f'{{member="{a}"}}')] == 1.0
+            assert any(k[0] == "deeprec_serving_batches_total"
+                       and f'member="{a}"' in k[1] for k in parsed)
+        assert any("tier=\"frontend\"" in k[1] for k in parsed)
+        # one # TYPE line per family across the per-member blocks —
+        # real Prometheus parsers reject duplicates
+        type_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        assert len(type_lines) == len(set(type_lines)), type_lines
+
+        # kill backend 0: its series must survive STALE-MARKED in the
+        # merge (visible absence), and its up gauge must read 0
+        backends[0].server.close()
+        backends[0].stop()
+        text, parsed = scrape(http.port)
+        dead = addrs[0]
+        assert parsed[("deeprec_member_up", f'{{member="{dead}"}}')] == 0.0
+        stale = [k for k in parsed
+                 if k[0] == "deeprec_serving_batches_total"
+                 and f'member="{dead}"' in k[1] and 'stale="1"' in k[1]]
+        assert stale, f"dead member's series vanished from:\n{text}"
+        # the failed SCRAPE must not have mutated routing state: the
+        # member is only marked down when request/health traffic fails
+        assert fe._members[0].available(__import__("time").monotonic())
+        # the live member's series stay fresh (no stale label)
+        assert any(k[0] == "deeprec_serving_batches_total"
+                   and f'member="{addrs[1]}"' in k[1]
+                   and "stale" not in k[1] for k in parsed)
+    finally:
+        http.stop()
+        fe.close()
+        for b in backends:
+            try:
+                b.server.close()
+                b.stop()
+            except Exception:
+                pass
+
+
+def test_trace_id_spans_http_edge_to_backend_stages(trained, tmp_path):
+    """One trace id, propagated from the X-Deeprec-Trace header through
+    the frontend's TCP frame into the backend micro-batcher: the edge,
+    frontend dispatch, backend dispatch and all four stage spans share
+    it (in-process backends share this process's tracer, so the wire
+    decode path is exactly what a remote backend runs)."""
+    model, _, _, _, _, tmp, req = trained
+    path = str(tmp_path / "tier.jsonl")
+    backends, fe = make_tier(model, tmp, n=1)
+    http = HttpServer(fe, port=0).start()
+    T.configure(path, sample=1.0, service="tier")
+    try:
+        body = json.dumps(
+            {"features": {k: v.tolist() for k, v in req.items()}}).encode()
+        trace_hex = "00000000000abcde"
+        r = urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{http.port}/v1/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         T.HEADER: f"{trace_hex}-0000000000000001"},
+                method="POST"),
+            timeout=30)
+        assert r.status == 200
+        T.flush()
+        evs = [json.loads(ln) for ln in open(path)]
+        mine = [e for e in evs
+                if (e.get("args") or {}).get("trace") == trace_hex]
+        names = {e["name"] for e in mine}
+        assert {"http_predict", "frontend_dispatch", "dispatch",
+                "stage_queue", "stage_pad", "stage_device",
+                "stage_post"} <= names, names
+    finally:
+        http.stop()
+        fe.close()
+        for b in backends:
+            b.server.close()
+            b.stop()
+
+
+def test_frontend_health_sweep_unified_schema_with_down_member(trained):
+    model, _, _, _, _, tmp, req = trained
+    backends, fe = make_tier(model, tmp)
+    try:
+        backends[1].server.close()
+        backends[1].stop()
+        h = fe.predictor.health()
+        assert schema.is_health_payload(h)
+        assert h["status"] == "degraded"
+        assert h["reachable"] == 1 and h["members"] == 2
+    finally:
+        fe.close()
+        backends[0].server.close()
+        backends[0].stop()
+
+
+def test_dedup_stats_publishes_placement_gauges(trained):
+    model, tr, holder, _, _, _, _ = trained
+    stats = tr.dedup_stats(holder["st"])
+    assert stats  # at least one table reported
+    reg = M.default_registry()
+    tname = next(iter(stats))
+    w = reg.window("deeprec_dedup_unique_fraction", {"table": tname})
+    if stats[tname]["unique_fraction"] is not None:
+        assert w["last"] == stats[tname]["unique_fraction"]
+    # single-device trainer has no shard axis -> no per_shard series;
+    # the sharded path is exercised by the bench/placement suites
+    assert "per_shard" not in stats[tname] or (
+        reg.window("deeprec_shard_imbalance", {"table": tname})["last"]
+        is not None)
+
+
+def test_supervisor_stats_lease_view_and_gauges():
+    from deeprec_tpu.online.supervisor import ProcessSpec, Supervisor
+
+    spec = ProcessSpec(name="w0", argv=["true"], max_restarts=5)
+    sup = Supervisor([spec])
+    stats = sup.stats()["w0"]
+    assert stats["restart_budget_remaining"] == 5
+    assert stats["heartbeat_age_seconds"] is None  # no lease configured
+    reg = M.default_registry()
+    w = reg.window("deeprec_supervisor_restart_budget_remaining",
+                   {"worker": "w0"})
+    assert w["last"] == 5.0

@@ -273,6 +273,7 @@ def run(args):
     import signal
 
     from deeprec_tpu.data.stream import FileStreamServer, criteo_line_parser
+    from deeprec_tpu.obs import trace as obs_trace
     from deeprec_tpu.online import faults
     from deeprec_tpu.online.loop import ServeLoop
     from deeprec_tpu.online.supervisor import Heartbeat, ProcessSpec, Supervisor
@@ -281,6 +282,14 @@ def run(args):
     stream = os.path.join(tmp, "stream.txt")
     ckpt = os.path.join(tmp, "ckpt")
     open(stream, "w").close()
+    # Cross-process tracing: the serving half appends to serve.jsonl in
+    # THIS process, the supervised trainer inherits trainer.jsonl through
+    # DEEPREC_TRACE — tools/obs_trace.py merges both into one
+    # Perfetto-loadable train→delta→serve timeline at the end.
+    trace_dir = os.path.join(tmp, "obs")
+    os.makedirs(trace_dir, exist_ok=True)
+    obs_trace.configure(os.path.join(trace_dir, "serve.jsonl"),
+                        sample=1.0, service="serve")
     broker = FileStreamServer(stream, follow=True, poll_secs=0.02).start()
 
     B = args.batch_size
@@ -303,7 +312,9 @@ def run(args):
         grace_secs=120,
         max_restarts=5,
         backoff_base_secs=0.2,
-        env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+             "DEEPREC_TRACE": os.path.join(trace_dir, "trainer.jsonl"),
+             "DEEPREC_TRACE_SAMPLE": "1.0"},
         cwd=REPO,
         stdout=os.path.join(tmp, "trainer.log"),
     )
@@ -351,6 +362,47 @@ def run(args):
             failed.append("steady: no steps reflected in predictions")
         if steady["failed_requests"]:
             failed.append("steady: failed requests")
+
+        # HTTP-edge traced requests: real POST /v1/predict through the
+        # ServeLoop's HttpServer so the exported timeline carries a
+        # single trace id from the HTTP edge through dispatch into the
+        # backend queue/pad/device/post stages (the acceptance shape).
+        import urllib.request
+
+        body = json.dumps(
+            {"features": {k: np.asarray(v).tolist()  # noqa: DRT002 — host request payload serialization (name-collision reachability)
+                          for k, v in req.items()}}).encode()
+        for _ in range(5):
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{serve.http.port}/v1/predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST"),
+                timeout=30).read()
+
+        # Gauge-vs-probe agreement: the predictor's
+        # train_to_serve_lag_seconds (trainer manifest commit → serving
+        # swap, stamped at apply time) measures a SUFFIX of the probe's
+        # ingest→served pipeline, so it must be nonnegative and bounded
+        # by the probe's observed end-to-end lag (+ slack for the
+        # serve-side sampling tail) — disagreement means the gauge (or
+        # the manifest clock) regressed.
+        lag_gauge = serve.predictor.last_apply_lag_seconds
+        probe_ref = steady.get("p50_s") or steady.get("max_s")
+        result["lag_gauge"] = {
+            "train_to_serve_lag_seconds": lag_gauge,
+            "probe_p50_s": steady.get("p50_s"),
+            "probe_max_s": steady.get("max_s"),
+            "tolerance_s": 1.0,
+        }
+        if lag_gauge is None:
+            failed.append("lag_gauge: never stamped despite applied updates")
+        elif probe_ref is not None and not (
+                0.0 <= lag_gauge <= probe_ref + 1.0):
+            failed.append(
+                f"lag_gauge: {lag_gauge}s disagrees with probe lag "
+                f"{probe_ref}s (+1.0s tolerance)")
         result["faults"] = {}
 
         # ------------------------------------------- 1. trainer SIGKILL
@@ -475,6 +527,34 @@ def run(args):
             broker.stop()
         except Exception:
             pass
+        obs_trace.flush()
+
+    # ------------------------------------------- timeline export + check
+    # Merge the serving + trainer JSONL into one Perfetto-loadable file
+    # and verify at least one HTTP-edge request's trace id spans the
+    # whole serving path (edge → dispatch → queue/pad/device/post).
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_trace as exporter
+
+    trace_out = args.trace_out or os.path.join(tmp, "trace.json")
+    rep = exporter.export([trace_dir], trace_out)
+    ids = exporter.trace_ids(exporter.load_events([trace_dir]))
+    need = {"http_predict", "dispatch", "stage_queue", "stage_pad",
+            "stage_device", "stage_post"}
+    complete = [t for t, names in ids.items() if need <= set(names)]
+    result["trace"] = {
+        "file": trace_out,
+        "events": rep["events"],
+        "processes": rep["processes"],
+        "request_traces": len(ids),
+        "complete_request_traces": len(complete),
+    }
+    if not complete:
+        failed.append("trace: no single trace id spans HTTP edge -> "
+                      "dispatch -> queue/pad/device/post")
+    if rep["processes"] < 2:
+        failed.append("trace: trainer process contributed no spans "
+                      "(train->serve timeline incomplete)")
     result["ok"] = not failed
     if failed:
         result["failures"] = failed
@@ -497,6 +577,9 @@ def main(argv=None):
                    help="write the result JSON here (default: "
                         "FRESHNESS_BENCH.json for full runs, none for "
                         "--smoke)")
+    p.add_argument("--trace-out", default=None,
+                   help="write the merged Perfetto/Chrome trace JSON "
+                        "here (default: <run tmpdir>/trace.json)")
     p.add_argument("--smoke", action="store_true",
                    help="CI: short steady window + one trainer kill; "
                         "asserts recovery and zero failed requests")

@@ -1,0 +1,139 @@
+"""Export deeprec obs trace JSONL file(s) to one Chrome-trace /
+Perfetto-loadable JSON timeline.
+
+The runtime (deeprec_tpu/obs/trace.py) appends self-contained Chrome
+"X" events, one JSON object per line, to per-process files. This tool
+merges any number of them — the trainer worker's, the serving process's,
+the frontend's — into ``{"traceEvents": [...]}``, which
+https://ui.perfetto.dev (or chrome://tracing) loads directly, so a whole
+train → delta → serve round renders as one timeline and a sampled
+request's trace id can be followed from the HTTP edge through the
+frontend dispatch into the backend queue/pad/device/post stages.
+
+    python tools/obs_trace.py RUN_DIR_OR_FILE... --out trace.json
+    python tools/obs_trace.py trace.jsonl --summary     # ids + span names
+
+``--trace-id HEX`` filters to one request's spans (plus untagged
+process-timeline events when ``--keep-untagged`` is set).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def iter_event_files(paths: Iterable[str]) -> List[str]:
+    """Expand directories to their *.jsonl members; keep files as-is."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
+def load_events(paths: Iterable[str]) -> List[dict]:
+    """Parse every well-formed event line; torn tails (a process killed
+    mid-append) are skipped, not fatal — a trace of a fault run must
+    load even when the fault hit the writer."""
+    events: List[dict] = []
+    for path in iter_event_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        ev = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(ev, dict) and "name" in ev and "ts" in ev:
+                        events.append(ev)
+        except OSError as e:
+            print(f"obs_trace: cannot read {path}: {e}", file=sys.stderr)
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def trace_ids(events: Iterable[dict]) -> Dict[str, List[str]]:
+    """{trace_id_hex: sorted span names} over the event set."""
+    out: Dict[str, set] = {}
+    for ev in events:
+        t = (ev.get("args") or {}).get("trace")
+        if t:
+            out.setdefault(t, set()).add(ev["name"])
+    return {t: sorted(names) for t, names in out.items()}
+
+
+def export(paths: Iterable[str], out_path: str,
+           trace_id: Optional[str] = None,
+           keep_untagged: bool = True) -> Dict:
+    """Write the merged Chrome JSON; returns a small report
+    (event/trace counts) the benches record."""
+    events = load_events(paths)
+    if trace_id:
+        events = [
+            ev for ev in events
+            if (ev.get("args") or {}).get("trace") == trace_id
+            or (keep_untagged and "trace" not in (ev.get("args") or {}))
+        ]
+    # Process-name metadata rows make the Perfetto track list readable.
+    meta = []
+    seen_pids = {}
+    for ev in events:
+        pid = ev.get("pid")
+        svc = (ev.get("args") or {}).get("service")
+        if pid is not None and pid not in seen_pids:
+            seen_pids[pid] = svc or f"pid {pid}"
+    for pid, name in sorted(seen_pids.items()):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": name}})
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return {
+        "events": len(events),
+        "processes": len(seen_pids),
+        "traces": len(trace_ids(events)),
+        "out": out_path,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("inputs", nargs="+",
+                   help="obs JSONL file(s) or directories of them")
+    p.add_argument("--out", default=None,
+                   help="write the merged Chrome/Perfetto JSON here")
+    p.add_argument("--trace-id", default=None,
+                   help="keep only spans of this trace id (16-hex)")
+    p.add_argument("--drop-untagged", action="store_true",
+                   help="with --trace-id: also drop process-timeline "
+                        "events that carry no trace id")
+    p.add_argument("--summary", action="store_true",
+                   help="print trace ids and their span names, no export")
+    args = p.parse_args(argv)
+
+    if args.summary or not args.out:
+        events = load_events(args.inputs)
+        ids = trace_ids(events)
+        print(json.dumps({
+            "events": len(events),
+            "traces": {t: names for t, names in sorted(ids.items())},
+        }, indent=1))
+        return 0
+    rep = export(args.inputs, args.out, trace_id=args.trace_id,
+                 keep_untagged=not args.drop_untagged)
+    print(json.dumps(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

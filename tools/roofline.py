@@ -372,6 +372,61 @@ def assert_serving(json_path: str, scale_floor: float,
     return rc
 
 
+def assert_obs(json_path: str, tol: float) -> int:
+    """CI gate for the telemetry plane (bench.py / tools/bench_serving.py
+    'obs_overhead' section): both arms (instrumented vs DEEPREC_OBS=off)
+    must exist, the gated overhead — per-record registry cost × obs ops
+    per step/request over the measured step/request time, a deterministic
+    model (same discipline as the CPU-limited serving gate: wall-clock
+    arm deltas on a shared CI box are noise beyond any honest overhead
+    bound; the raw arms are recorded for inspection) — must sit under
+    `tol`, and the recorded /metrics (or registry-render) parse check
+    must have passed with a nonzero series count. Instrumentation whose
+    cost grows past 2% of the hot path is a regression this fails."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    ob = rec.get("obs_overhead")
+    if not ob:
+        print(f"roofline: {json_path} has no 'obs_overhead' record "
+              "(bench too old?)", file=sys.stderr)
+        return 1
+    rc = 0
+    arms = ob.get("arms", {})
+    if "on" not in arms or "off" not in arms:
+        print("roofline: obs_overhead needs 'on' and 'off' arms, got "
+              f"{sorted(arms)}", file=sys.stderr)
+        rc = 1
+    ov = ob.get("overhead_pct")
+    if ov is None or ov > tol * 100.0:
+        print(
+            f"roofline: obs overhead gate FAILED — modeled overhead "
+            f"{ov}% exceeds {tol * 100:.1f}% "
+            f"(per_record_ns {ob.get('per_record_ns')}, ops "
+            f"{ob.get('ops_per_step', ob.get('ops_per_request'))}) — the "
+            "metrics plane got too expensive for the hot path",
+            file=sys.stderr,
+        )
+        rc = 1
+    me = ob.get("metrics_endpoint") or ob.get("metrics_parse")
+    if not me or not me.get("parsed") or not me.get("series"):
+        print(
+            f"roofline: obs exposition gate FAILED — /metrics parse check "
+            f"missing or failed ({me}) — the Prometheus rendering broke",
+            file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: obs gate ok — modeled overhead {ov}% "
+            f"(bound {tol * 100:.1f}%; measured arms on/off "
+            f"{arms['on']} / {arms['off']}), "
+            f"{me['series']} metric series parsed"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -434,6 +489,15 @@ def main(argv=None):
     p.add_argument("--serving-grouped-factor", type=float, default=2.0,
                    help="required grouped/ungrouped candidates-per-sec "
                         "factor on the two-tower arm (default 2.0)")
+    p.add_argument("--assert-obs", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the telemetry-plane "
+                        "cost recorded in a bench.py or bench_serving.py "
+                        "JSON (instrumented vs DEEPREC_OBS=off arms "
+                        "present, modeled overhead under --obs-tol, "
+                        "/metrics parse check green; CI smoke gate)")
+    p.add_argument("--obs-tol", type=float, default=0.02,
+                   help="allowed obs-plane overhead as a fraction of the "
+                        "measured step/request time (default 0.02)")
     p.add_argument("--serving-quant-ratio", type=float, default=0.55,
                    help="int8 residency bytes bound as a fraction of fp32 "
                         "(default 0.55 — int8 + per-row scale must at "
@@ -454,6 +518,8 @@ def main(argv=None):
                                 args.serving_scale_floor,
                                 args.serving_grouped_factor,
                                 args.serving_quant_ratio))
+    if args.assert_obs:
+        sys.exit(assert_obs(args.assert_obs, args.obs_tol))
 
     import jax
     import jax.numpy as jnp
