@@ -46,7 +46,11 @@ echo "== serving bench (CPU smoke: group dispatch + 2-process socket tier + int8
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_serving.py --smoke \
     --out /tmp/deeprec_serving_smoke.json
 
-echo "== serving scale-out / quantized residency / grouped gates (drift fails the smoke) =="
+echo "== fleet bench (CPU smoke: lease discovery, rolling restart of every backend via EXIT_RESCALE respawn, 2->4->2 autoscale, torn lease — zero failed requests) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_fleet.py --smoke \
+    --out /tmp/deeprec_serving_smoke.json
+
+echo "== serving scale-out / quantized residency / grouped / fleet gates (drift fails the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-serving /tmp/deeprec_serving_smoke.json
 

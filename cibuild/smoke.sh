@@ -27,4 +27,8 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_analysis.py::test_trace_guard_catches_reintroduced_per_call_jit_lambda \
   tests/test_obs.py::test_disabled_tracing_is_zero_allocation \
   tests/test_obs_wiring.py::test_trace_id_spans_http_edge_to_backend_stages \
+  tests/test_backoff.py \
+  tests/test_fleet.py::test_ring_remap_fraction_on_join_at_most_2_over_n \
+  tests/test_fleet.py::test_registry_stale_lease_eviction_and_readmission_race \
+  tests/test_fleet.py::test_frontend_drain_excludes_new_assignments_zero_failures \
   "$@"

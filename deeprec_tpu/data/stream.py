@@ -28,6 +28,8 @@ from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
+from deeprec_tpu.utils import backoff
+
 
 def criteo_line_parser(num_dense: int = 13, num_cat: int = 26) -> Callable:
     """Default record parser shared by the stream readers: Criteo TSV lines
@@ -219,15 +221,14 @@ class TCPStreamReader:
     def backoff_delay(self, attempt: int) -> float:
         """Capped exponential reconnect delay BEFORE jitter: the k-th
         consecutive failure waits base * 2^(k-1), never above
-        reconnect_max_secs. Pure — pinned by tests without sleeping."""
-        return min(
-            self.reconnect_max_secs,
-            self.reconnect_secs * (2 ** max(0, min(attempt - 1, 20))),
-        )
+        reconnect_max_secs. Pure — pinned by tests without sleeping
+        (the shared `utils/backoff.py` policy)."""
+        return backoff.backoff_delay(
+            attempt, self.reconnect_secs, self.reconnect_max_secs)
 
     def _backoff_sleep(self) -> None:
         d = self.backoff_delay(self.consecutive_connect_failures)
-        time.sleep(d * (0.5 + self._rng.random()))  # [0.5, 1.5)x jitter
+        time.sleep(backoff.jittered(d, self._rng))
 
     def _connect(self) -> socket.socket:
         self.connect_attempts += 1

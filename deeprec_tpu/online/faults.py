@@ -20,6 +20,12 @@ tests, and tools/bench_freshness.py all drive the SAME failure modes:
     reconnect handles.
   * subprocess helpers (`spawn_worker`, `wait_for_line`, `sigkill`) for
     tests that need a real process to murder.
+  * fleet injectors (`torn_lease_write`, `env_slow_join_secs`,
+    `sigkill_fleet_member`) — the serving-fleet failure modes
+    (serving/fleet.py): a torn lease file a reader must skip (never
+    trust), a slow joiner that is reachable but unannounced, and member
+    / frontend SIGKILL mid-stream, all driven by tools/bench_fleet.py
+    and tests/test_fleet.py.
 """
 from __future__ import annotations
 
@@ -31,6 +37,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 KILL_STEP_ENV = "DEEPREC_FAULT_KILL_STEP"
+SLOW_JOIN_ENV = "DEEPREC_FAULT_SLOW_JOIN_SECS"
 
 
 # ------------------------------------------------------------ kill at step
@@ -134,6 +141,42 @@ def corrupt_latest_delta(ckpt_dir: str, mode: str = "bitflip",
     else:
         flip_bit(target)
     return target
+
+
+# ----------------------------------------------------------- fleet faults
+
+
+def torn_lease_write(registry, addr: str, role: str = "backend",
+                     pid: Optional[int] = None) -> str:
+    """Plant a TORN lease file (truncated mid-JSON) at the path the
+    member at `addr` would stamp — what a non-atomic writer or FS
+    corruption leaves. The registry's own writes are atomic tmp+rename
+    (Heartbeat), so this deliberately bypasses them; a sweep must read
+    it as 'no lease' (skip), never trust it and never crash. Returns
+    the planted path."""
+    path = registry.lease_path(addr, role, pid=pid)
+    with open(path, "w") as f:
+        f.write('{"pid": 1234, "time": 17')  # cut mid-value
+    return path
+
+
+def env_slow_join_secs() -> float:
+    """The slow-joiner fault, subprocess form: DEEPREC_FAULT_SLOW_JOIN_SECS
+    delays a fleet backend's FIRST lease stamp — the process binds its
+    socket and serves, but stays unannounced. The fleet must keep full
+    service meanwhile (nobody routes to an unleased member) and admit it
+    when the stamp finally lands."""
+    v = os.environ.get(SLOW_JOIN_ENV)
+    return float(v) if v else 0.0
+
+
+def sigkill_fleet_member(proc: subprocess.Popen, wait: float = 30.0) -> int:
+    """SIGKILL a fleet member (backend or frontend) mid-stream: sockets
+    drop, the lease goes stale and eviction retires it — no drain, no
+    unregister, the exact opposite of the polite exit. Alias of
+    `sigkill` with the fleet contract spelled out: the tier must retry
+    in-flight requests on siblings with zero failed requests."""
+    return sigkill(proc, wait=wait)
 
 
 # --------------------------------------------------------- broker outage

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from deeprec_tpu.parallel.elastic import EXIT_RESCALE
+from deeprec_tpu.utils import backoff as _backoff
 
 _log_lock = threading.Lock()
 
@@ -156,7 +157,8 @@ class Supervisor:
     injected fault."""
 
     def __init__(self, specs: Sequence[ProcessSpec], poll_secs: float = 0.25,
-                 on_event: Optional[Callable[[str], None]] = None):
+                 on_event: Optional[Callable[[str], None]] = None,
+                 keep_alive: bool = False):
         self.specs = list(specs)
         self.poll_secs = poll_secs
         self._states: Dict[str, _ProcState] = {
@@ -166,13 +168,20 @@ class Supervisor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._rng = random.Random(0xFA117)
+        # Elastic fleets add/remove specs at runtime (the serving
+        # autoscaler): mutations serialize on _speclock, and keep_alive
+        # stops run() from returning in the window where every CURRENT
+        # worker happens to be done (more may be added next tick).
+        self.keep_alive = keep_alive
+        self._speclock = threading.Lock()
 
     # ------------------------------------------------------------- events
 
     def _event(self, spec_name: str, msg: str) -> None:
         line = f"supervisor[{spec_name}]: {msg}"
-        st = self._states[spec_name]
-        st.log.append(line)
+        st = self._states.get(spec_name)
+        if st is not None:  # spec may have been removed mid-event
+            st.log.append(line)
         if self._on_event is not None:
             self._on_event(line)
         else:
@@ -186,7 +195,9 @@ class Supervisor:
         return [str(x) for x in a]
 
     def _spawn(self, spec: ProcessSpec) -> None:
-        st = self._states[spec.name]
+        st = self._states.get(spec.name)
+        if st is None:
+            return  # spec removed (fleet retire) between check and spawn
         env = dict(os.environ)
         if spec.env:
             extra = spec.env() if callable(spec.env) else spec.env
@@ -204,8 +215,15 @@ class Supervisor:
         self._event(spec.name, f"spawned pid {st.proc.pid}")
 
     def start(self) -> "Supervisor":
-        for spec in self.specs:
-            self._spawn(spec)
+        # Same guard as run()'s startup loop: a spec added via add_spec
+        # before start() already has a live proc (and add_spec holds the
+        # next_spawn_at=inf gate while ITS spawn runs) — spawning again
+        # would double-fork and orphan the first PID.
+        now = time.monotonic()
+        for spec in list(self.specs):
+            st = self._states.get(spec.name)
+            if st is not None and st.proc is None and now >= st.next_spawn_at:
+                self._spawn(spec)
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name="online-supervisor")
         self._thread.start()
@@ -215,20 +233,27 @@ class Supervisor:
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
         stop = stop or self._stop
-        # Foreground use: spawn anything start() didn't.
-        for spec in self.specs:
-            if self._states[spec.name].proc is None:
+        # Foreground use: spawn anything start() didn't. Honors the
+        # next_spawn_at gate (add_spec publishes inf while IT spawns —
+        # spawning here too would double-fork and orphan one PID) and
+        # tolerates specs removed concurrently.
+        now = time.monotonic()
+        for spec in list(self.specs):
+            st = self._states.get(spec.name)
+            if st is not None and st.proc is None and now >= st.next_spawn_at:
                 self._spawn(spec)
         while not stop.wait(self.poll_secs):
-            for spec in self.specs:
+            for spec in list(self.specs):
                 self._check(spec)
-            if all(s.done or s.gave_up for s in self._states.values()):
+            if not self.keep_alive and all(
+                    s.done or s.gave_up
+                    for s in list(self._states.values())):
                 return
 
     def _check(self, spec: ProcessSpec) -> None:
-        st = self._states[spec.name]
-        if st.done or st.gave_up:
-            return
+        st = self._states.get(spec.name)
+        if st is None or st.done or st.gave_up:
+            return  # removed mid-round (fleet retire) or settled
         now = time.monotonic()
         if st.proc is None:
             if now >= st.next_spawn_at:
@@ -304,10 +329,9 @@ class Supervisor:
                 f"({spec.max_restarts}) — giving up",
             )
             return
-        delay = min(
-            spec.backoff_max_secs,
-            spec.backoff_base_secs * (2 ** (st.consecutive_failures - 1)),
-        ) * (0.5 + self._rng.random())
+        delay = _backoff.jittered_backoff(
+            st.consecutive_failures, spec.backoff_base_secs,
+            spec.backoff_max_secs, self._rng)
         st.restarts += 1
         st.next_spawn_at = time.monotonic() + delay
         self._event(
@@ -317,6 +341,55 @@ class Supervisor:
         )
 
     # ------------------------------------------------------------ control
+
+    def add_spec(self, spec: ProcessSpec, spawn: bool = True) -> None:
+        """Adopt a NEW worker at runtime (the serving autoscaler's
+        scale-up path): the spec joins the watch set and is spawned
+        immediately (or on the next poll round when `spawn=False`).
+
+        The spec is published with its spawn gate CLOSED
+        (next_spawn_at=inf) until our own _spawn below finishes —
+        otherwise the poll loop's _check can race us in the window
+        between publish and spawn and fork a SECOND process that the
+        state record then orphans (untracked, unkilled at stop())."""
+        st = _ProcState()
+        if spawn:
+            st.next_spawn_at = float("inf")
+        with self._speclock:
+            if spec.name in self._states:
+                raise ValueError(f"duplicate spec name {spec.name!r}")
+            self._states[spec.name] = st
+            self.specs.append(spec)
+        if spawn:
+            try:
+                self._spawn(spec)
+            finally:
+                st.next_spawn_at = 0.0
+
+    def remove_spec(self, name: str, kill: bool = True) -> bool:
+        """Stop watching a worker (the scale-down path). The polite
+        retirement is: request a drain, wait for the worker's clean
+        exit (state.done), THEN remove — `kill=False` leaves a
+        still-running process alone (it is expected to exit on its
+        own); `kill=True` reaps it. Returns whether the spec existed."""
+        with self._speclock:
+            st = self._states.pop(name, None)
+            self.specs = [s for s in self.specs if s.name != name]
+        if st is None:
+            return False
+        if kill and st.proc is not None:
+            try:
+                st.proc.kill()
+                st.proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        return True
+
+    def state(self, name: str) -> Optional[_ProcState]:
+        """The live accounting record for one worker (None when
+        unknown) — the autoscaler polls `.done` to confirm a retired
+        member's clean exit before removing its spec."""
+        return self._states.get(name)
 
     def note_progress(self, name: str) -> None:
         """External progress signal (e.g. the bench saw fresh steps
@@ -350,9 +423,9 @@ class Supervisor:
 
         reg = (obs_metrics.default_registry()
                if obs_metrics.metrics_enabled() else None)
-        specs = {s.name: s for s in self.specs}
+        specs = {s.name: s for s in list(self.specs)}
         out = {}
-        for name, st in self._states.items():
+        for name, st in list(self._states.items()):
             spec = specs.get(name)
             hb_age = (Heartbeat.age(spec.heartbeat_path)
                       if spec is not None and spec.heartbeat_path else None)
@@ -396,7 +469,7 @@ class Supervisor:
             self._thread.join(timeout=5)
             self._thread = None
         if kill_workers:
-            for st in self._states.values():
+            for st in list(self._states.values()):
                 if st.proc is not None:
                     try:
                         st.proc.kill()

@@ -1,5 +1,17 @@
 from deeprec_tpu.serving.predictor import ModelServer, Predictor, ServerGroup
-from deeprec_tpu.serving.frontend import BackendServer, Frontend, spawn_backends
+from deeprec_tpu.serving.frontend import (
+    BackendServer,
+    Frontend,
+    spawn_backends,
+    spawn_frontends,
+)
+from deeprec_tpu.serving.fleet import (
+    FleetAutoscaler,
+    FleetClient,
+    FleetRegistry,
+    HashRing,
+    LeaseStamper,
+)
 from deeprec_tpu.serving.http_server import HttpServer
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.serving.remote_store import RemoteKVClient, RemoteKVServer

@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from deeprec_tpu.analysis.annotations import guarded_by
+from deeprec_tpu.utils import backoff
 from deeprec_tpu.obs import metrics as obs_metrics
 from deeprec_tpu.obs import schema as obs_schema
 from deeprec_tpu.obs import trace as obs_trace
@@ -128,7 +129,9 @@ class BackendServer:
     stop() severs) is the only cross-thread field, guarded by
     `_conn_lock`."""
 
-    def __init__(self, model_server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, model_server, host: str = "127.0.0.1", port: int = 0,
+                 *, registry=None, capacity: int = 1, member_name: str = "",
+                 lease_delay_secs: float = 0.0):
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -183,9 +186,30 @@ class BackendServer:
         self._t0 = time.monotonic()
         self._conns: set = set()
         self._conn_lock = threading.Lock()
+        self._inflight = 0  # live PRED frames (guarded by _conn_lock)
         self._srv = Server((host, port), Handler)
         self.port = self._srv.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # Fleet membership (serving/fleet.py): with a registry, this
+        # backend announces itself by stamping a lease (addr, capacity,
+        # model_version, started_at) — frontends admit it at runtime; a
+        # SIGKILL leaves the lease to go stale (eviction), a drain exits
+        # politely. `lease_delay_secs` defers the FIRST stamp (the
+        # slow-joiner fault: reachable but unannounced — the fleet must
+        # not route to it until the lease lands).
+        self.addr = f"{host}:{self.port}"
+        self.stamper = None
+        self._lease_delay = lease_delay_secs
+        self._lease_timer: Optional[threading.Timer] = None
+        if registry is not None:
+            from deeprec_tpu.serving import fleet as _fleet
+
+            if isinstance(registry, str):
+                registry = _fleet.FleetRegistry(registry)
+            self.stamper = _fleet.LeaseStamper(
+                registry, self.addr, role=_fleet.ROLE_BACKEND,
+                capacity=capacity, name=member_name,
+                version_fn=lambda: self.server.predictor.version)
 
     def _dispatch(self, op: bytes, body: bytes) -> Tuple[bytes, bytes]:
         if op == OP_PRED:
@@ -200,8 +224,14 @@ class BackendServer:
             batch = _unpack_arrays(body[off:])
             if not batch:
                 raise BadRequest("missing 'features' object")
-            probs, version = self.server.request_versioned(
-                batch, group_users=grouped, trace_ctx=ctx)
+            with self._conn_lock:
+                self._inflight += 1
+            try:
+                probs, version = self.server.request_versioned(
+                    batch, group_users=grouped, trace_ctx=ctx)
+            finally:
+                with self._conn_lock:
+                    self._inflight -= 1
             out = {"__version__": np.int64(version)}
             if isinstance(probs, dict):
                 for k, v in probs.items():
@@ -232,17 +262,69 @@ class BackendServer:
             return _OK, json.dumps(snap).encode()
         raise BadRequest(f"unknown op {op!r}")
 
+    def inflight(self) -> int:
+        with self._conn_lock:
+            return self._inflight
+
     def start(self) -> "BackendServer":
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True)
         self._thread.start()
+        if self.stamper is not None:
+            if self._lease_delay > 0:
+                # slow joiner: serve but don't announce yet — the first
+                # stamp (and with it fleet admission) lands later
+                self._lease_timer = threading.Timer(
+                    self._lease_delay, lambda: self.stamper.start())
+                self._lease_timer.daemon = True
+                self._lease_timer.start()
+            else:
+                self.stamper.start()
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout: float = 30.0, respawn: bool = False,
+              quiet_rounds: int = 3, poll_secs: float = 0.05) -> int:
+        """The leaving half of the EXIT_RESCALE choreography applied to
+        serving: stamp the lease ``draining`` (frontends stop NEW
+        assignments within one membership sweep), let in-flight grouped
+        streams finish (`quiet_rounds` consecutive polls with zero live
+        PRED frames and an idle coalescing queue — one empty poll can be
+        a gap between a stream's requests), then stop and unregister.
+        Returns the exit code to leave with: EXIT_RESCALE when
+        `respawn` (a supervisor respawns the member for free — rolling
+        restart), else 0 (retirement)."""
+        if self.stamper is not None:
+            self.stamper.begin_drain(respawn=respawn)
+        deadline = time.monotonic() + timeout
+        quiet = 0
+        while time.monotonic() < deadline and quiet < quiet_rounds:
+            qsize_fn = getattr(getattr(self.server, "_q", None),
+                               "qsize", lambda: 0)
+            quiet = (quiet + 1
+                     if self.inflight() == 0 and qsize_fn() == 0 else 0)
+            time.sleep(poll_secs)
+        self.stop()
+        if self.stamper is not None:
+            return self.stamper.exit_code()
+        from deeprec_tpu.parallel.elastic import EXIT_RESCALE
+
+        return EXIT_RESCALE if respawn else 0
+
+    def stop(self, unregister: bool = True) -> None:
         """Stop listening AND sever live connections — so an in-process
         stop is a faithful stand-in for backend-process death (a real
         SIGKILL drops every established socket, and the fault tests rely
-        on the frontend observing exactly that)."""
+        on the frontend observing exactly that). `unregister=False`
+        additionally leaves the lease behind to go STALE, which is what
+        a real SIGKILL does — the eviction-path tests want exactly
+        that."""
+        if self._lease_timer is not None:
+            # a slow joiner stopped BEFORE its deferred first stamp must
+            # never announce a dead server afterwards
+            self._lease_timer.cancel()
+            self._lease_timer = None
+        if self.stamper is not None:
+            self.stamper.stop(unregister=unregister)
         self._srv.shutdown()
         self._srv.server_close()
         with self._conn_lock:
@@ -284,6 +366,13 @@ class _Member:
         self.requests = 0
         self.errors = 0
         self.health: Dict = {}
+        # Fleet-membership view (set by the frontend's membership sweep
+        # under ITS lock; plain attribute reads elsewhere — a stale read
+        # is one routing round behind, which churn tolerates by design):
+        # a draining member takes no NEW assignments but finishes
+        # in-flight grouped streams; lease carries capacity/version.
+        self.draining = False
+        self.lease: Optional[object] = None
         # Last obs snapshot this member answered with: a DOWN member's
         # series re-render from it stale-marked — visible absence, not
         # silent disappearance (guarded by _lock like the rest).
@@ -350,15 +439,15 @@ class _Member:
 
     def mark_down(self) -> float:
         """Record a failure; returns the backoff deadline. Capped
-        exponential with jitter (the `_run_poll_loop` discipline), so N
-        frontend threads hitting one dead backend don't re-probe in
+        exponential with jitter (the shared `utils/backoff.py` policy),
+        so N frontend threads hitting one dead backend don't re-probe in
         lockstep."""
         with self._lock:
             self.fails += 1
             self.errors += 1
-            delay = min(self.backoff_max,
-                        self.backoff_base * (2 ** min(self.fails - 1, 8)))
-            delay *= 0.5 + self._rng.random()
+            delay = backoff.jittered_backoff(
+                self.fails, self.backoff_base, self.backoff_max,
+                self._rng, max_exponent=8)
             self.down_until = time.monotonic() + delay
             # A dead backend's pooled sockets are dead too.
             pool, self._pool = self._pool, []
@@ -378,13 +467,23 @@ class _Member:
 
     def snapshot(self) -> Dict:
         with self._lock:
-            return {
+            out = {
                 "addr": self.addr,
                 "up": time.monotonic() >= self.down_until,
                 "fails": self.fails,
                 "requests": self.requests,
                 "errors": self.errors,
+                "draining": self.draining,
             }
+        lease = self.lease
+        if lease is not None:
+            out["lease"] = {
+                "capacity": lease.capacity,
+                "model_version": lease.model_version,
+                "age_seconds": round(lease.age, 3),
+                "started_at": lease.started_at,
+            }
+        return out
 
     def close(self) -> None:
         with self._lock:
@@ -462,7 +561,7 @@ class _FrontendPredictor:
                 f"no reachable backends among {[m.addr for m in self._fe._members]}")
         updated = False
         if self._fe.poll_backends:
-            for m in self._fe._members:
+            for m in list(self._fe._members):
                 if not m.available(time.monotonic()):
                     continue
                 try:
@@ -484,26 +583,65 @@ class Frontend:
     multi-process serving tier.
 
     Routing: plain requests round-robin over available members; grouped
-    (`group_users=True`) requests route by a hash of the USER feature
-    payload, so one user's candidate batches keep hitting one backend
-    and its sample-aware coalescing (user tower once per distinct user
-    per device batch) survives the socket split. On a member failure the
-    request retries on the next member in order — a killed backend costs
-    latency, never a failed request, as long as one member lives.
+    (`group_users=True`) requests route on a consistent-hash ring
+    (virtual nodes over the member set, `serving/fleet.py`) keyed by a
+    hash of the USER feature payload, so one user's candidate batches
+    keep hitting one backend and its sample-aware coalescing (user
+    tower once per distinct user per device batch) survives the socket
+    split AND survives membership churn — a join/leave remaps only
+    ~1/N of users instead of reshuffling everyone. On a member failure
+    the request retries along the ring's preference order (which is
+    exactly where those users will land if the member really left) —
+    a killed backend costs latency, never a failed request, as long as
+    one member lives.
+
+    Membership is either a static `backends` list (the PR 10 shape), a
+    `registry` (a `fleet.FleetRegistry` or its directory path: lease-
+    file discovery — members admit themselves by stamping a lease and
+    retire by draining or going stale), or both (static seeds are
+    permanent, leased members come and go at runtime).
     """
 
-    def __init__(self, backends: Sequence[Union[str, Tuple[str, int]]],
-                 model=None, *, timeout: float = 30.0,
+    def __init__(self,
+                 backends: Optional[
+                     Sequence[Union[str, Tuple[str, int]]]] = None,
+                 model=None, *, registry=None, timeout: float = 30.0,
                  connect_timeout: float = 5.0,
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
-                 health_secs: float = 0.0, poll_backends: bool = False):
-        if not backends:
-            raise ValueError("need at least one backend address")
-        self._members = [
-            _Member(*self._parse_addr(b), connect_timeout=connect_timeout,
-                    backoff_base=backoff_base, backoff_max=backoff_max)
-            for b in backends
-        ]
+                 health_secs: float = 0.0, poll_backends: bool = False,
+                 membership_secs: float = 1.0, reprobe_secs: float = 2.0,
+                 vnodes: int = 64, lease_secs: Optional[float] = None):
+        from deeprec_tpu.serving import fleet as _fleet
+
+        self._fleet_mod = _fleet
+        # lease_secs must match the fleet's --lease-secs: a frontend
+        # sweeping with a SHORTER bound than the members' stamp cadence
+        # (lease_secs/3) would flap them in and out of membership.
+        self.registry = (
+            _fleet.FleetRegistry(
+                registry, **({"lease_secs": lease_secs}
+                             if lease_secs is not None else {}))
+            if isinstance(registry, str) else registry)
+        if not backends and self.registry is None:
+            raise ValueError(
+                "need at least one backend address or a fleet registry")
+        self._member_kwargs = dict(connect_timeout=connect_timeout,
+                                   backoff_base=backoff_base,
+                                   backoff_max=backoff_max)
+        self.vnodes = vnodes
+        self._static_addrs = ["%s:%d" % self._parse_addr(b)
+                              for b in (backends or [])]
+        # Membership state: mutated ONLY under _mlock by whole-object
+        # replacement (new list/dict/ring assigned atomically), so
+        # request paths read a coherent snapshot lock-free.
+        self._mlock = threading.Lock()
+        self._by_addr: Dict[str, _Member] = {}
+        self._members: List[_Member] = []
+        self._ring = _fleet.HashRing([], vnodes=vnodes)
+        self._routing_view: Dict[str, bool] = {}  # addr -> draining
+        self.membership_rounds = 0
+        with self._mlock:
+            self._apply_membership(self._membership_view())
         self.timeout = timeout
         self.poll_backends = poll_backends
         self.stats = ServingStats()
@@ -511,12 +649,16 @@ class Frontend:
         if r is not None:
             r.register_callback(
                 "deeprec_frontend_members", lambda: len(self._members),
-                "configured backend members")
+                "admitted backend members")
             r.register_callback(
                 "deeprec_frontend_members_up",
                 lambda: sum(1 for m in self._members
                             if m.available(time.monotonic())),
                 "members currently routable (not backed off)")
+            r.register_callback(
+                "deeprec_frontend_members_draining",
+                lambda: sum(1 for m in self._members if m.draining),
+                "members draining (in-flight only, no new assignments)")
         self.update_failures = 0  # _run_poll_loop accounting
         self.predictor = _FrontendPredictor(self, model)
         self._rr = itertools.count()
@@ -527,6 +669,19 @@ class Frontend:
                 target=_run_poll_loop, args=(self, self._stop, health_secs),
                 daemon=True)
             self._poller.start()
+        self._membership_thread = None
+        if self.registry is not None and membership_secs > 0:
+            self._membership_thread = threading.Thread(
+                target=self._membership_loop, args=(membership_secs,),
+                daemon=True, name="fleet-membership")
+            self._membership_thread.start()
+        self.reprobe_secs = reprobe_secs
+        self._reprober = None
+        if reprobe_secs > 0:
+            self._reprober = threading.Thread(
+                target=self._reprobe_loop, daemon=True,
+                name="member-reprobe")
+            self._reprober.start()
 
     @staticmethod
     def _parse_addr(b) -> Tuple[str, int]:
@@ -536,18 +691,138 @@ class Frontend:
         host, port = b
         return host, int(port)  # noqa: DRT002 — parsing a host:port config tuple, not a device value
 
+    # ---------------------------------------------------------- membership
+
+    def _membership_view(self) -> Dict[str, Optional[object]]:
+        """Desired membership right now: static seeds (always, with no
+        lease) plus every live backend lease in the registry. One
+        registry sweep — stale leases are already evicted and duplicate
+        addrs already arbitrated by `FleetRegistry.members`."""
+        desired: Dict[str, Optional[object]] = {
+            a: None for a in self._static_addrs}
+        if self.registry is not None:
+            for lease in self.registry.members(self._fleet_mod.ROLE_BACKEND):
+                desired[lease.addr] = lease
+        return desired
+
+    def _apply_membership(self, desired: Dict[str, Optional[object]]
+                          ) -> Tuple[List[str], List[str]]:
+        """Reconcile the member set (caller holds `_mlock`): admit new
+        addrs, retire vanished ones (evicted/unregistered — their socket
+        pools close), update drain flags, and rebuild the routing ring
+        over non-draining members. Returns (admitted, retired) addrs."""
+        by_addr = dict(self._by_addr)
+        admitted, retired = [], []
+        for addr, lease in desired.items():
+            m = by_addr.get(addr)
+            if m is None:
+                host, port = addr.rsplit(":", 1)
+                m = _Member(host, int(port), **self._member_kwargs)  # noqa: DRT002 — parsing a lease addr string, host-side control plane
+                by_addr[addr] = m
+                admitted.append(addr)
+            m.lease = lease  # refresh age/version view even when routing
+            # is unchanged (member snapshots report it)
+            m.draining = bool(lease is not None and lease.draining)
+        for addr in set(by_addr) - set(desired):
+            retired.append(addr)
+            by_addr.pop(addr).close()
+        self._by_addr = by_addr
+        # Rebuild the routing view (ordered list + hash ring: N*vnodes
+        # hashes + a sort) only when the (membership, drain) view
+        # actually changed — sweeps run every membership_secs AND on
+        # every /healthz and /v1/stats call, and steady state is
+        # no-change ~always. membership_rounds therefore counts CHURN
+        # events, not sweeps.
+        view = {a: by_addr[a].draining for a in by_addr}
+        if admitted or retired or view != self._routing_view:
+            self._routing_view = view
+            # static seeds keep their GIVEN order (callers index
+            # fe._members against the list they constructed with — the
+            # PR 10 contract); leased members follow, sorted so every
+            # frontend replica agrees
+            static = [a for a in self._static_addrs if a in by_addr]
+            dynamic = sorted(a for a in by_addr if a not in set(static))
+            self._members = [by_addr[a] for a in static + dynamic]
+            self._ring = self._fleet_mod.HashRing(
+                [a for a, m in by_addr.items() if not m.draining],
+                vnodes=self.vnodes)
+            self.membership_rounds += 1
+        return admitted, retired
+
+    def refresh_membership(self) -> Tuple[List[str], List[str]]:
+        """One reconcile round against the registry (the membership
+        thread's body; callable directly for deterministic tests and
+        for lazy refresh when routing finds nobody)."""
+        if self.registry is None:
+            return [], []
+        desired = self._membership_view()
+        with self._mlock:
+            return self._apply_membership(desired)
+
+    def _membership_loop(self, secs: float) -> None:
+        while not self._stop.wait(secs):
+            try:
+                self.refresh_membership()
+            except Exception:
+                # a failed sweep (FS blip) keeps the previous view; the
+                # next round retries — discovery must never kill routing
+                pass
+
+    def _reprobe_loop(self) -> None:
+        """Periodic re-probe of members in failure backoff: a backend
+        that died and came back at the SAME addr (process restart under
+        an external supervisor — no membership churn, static lists
+        included) is readmitted to routing without waiting for live
+        traffic to risk a request on it or for an operator to restart
+        the frontend."""
+        while not self._stop.wait(self.reprobe_secs):
+            now = time.monotonic()
+            for m in list(self._members):
+                if self._stop.is_set():
+                    return
+                if m.available(now) and m.fails == 0:
+                    continue  # healthy: nothing to re-probe
+                try:
+                    self._probe_member(m)  # marks up/down itself
+                except Exception:
+                    pass  # probing must never kill the loop
+
     # ------------------------------------------------------------- routing
 
-    def _order(self, start: int) -> List[_Member]:
-        """Members in attempt order: available ones first (rotated so
-        `start` picks the primary), then backed-off ones as a last
-        resort — with every sibling dead, trying a 'down' member beats
-        failing the request (it may just have restarted)."""
-        n = len(self._members)
-        rot = [self._members[(start + i) % n] for i in range(n)]
+    def _order(self, key: Optional[int] = None) -> List[_Member]:
+        """Members in attempt order for ONE request.
+
+        Plain requests (`key=None`): round-robin over non-draining
+        members. Grouped requests: the ring's preference order for
+        `key` — the owner first, then the members those users would
+        land on if the owner left, so failover and post-churn routing
+        agree.
+
+        Within the chosen order, available members come first and
+        backed-off ones ride along as a last resort (with every sibling
+        dead, trying a 'down' member beats failing the request — it may
+        just have restarted). Draining members are last of all: they
+        take no new assignments unless nobody else exists."""
+        members = self._members  # atomic snapshot (replaced, not mutated)
+        if not members:
+            raise RuntimeError("no fleet members admitted")
+        if key is not None:
+            ring = self._ring
+            by_addr = self._by_addr
+            pref = [by_addr[a] for a in ring.preference(key)
+                    if a in by_addr]
+            chosen = set(id(m) for m in pref)
+            order = pref + [m for m in members if id(m) not in chosen]
+        else:
+            routable = [m for m in members if not m.draining]
+            pool = routable or members  # everyone draining: serve anyway
+            n = len(pool)
+            s = next(self._rr) % n
+            order = [pool[(s + i) % n] for i in range(n)]
+            order += [m for m in members if m.draining] if routable else []
         now = time.monotonic()
-        up = [m for m in rot if m.available(now)]
-        down = [m for m in rot if not m.available(now)]
+        up = [m for m in order if m.available(now)]
+        down = [m for m in order if not m.available(now)]
         return up + down
 
     def _group_key(self, batch: Dict[str, np.ndarray]) -> int:
@@ -569,14 +844,17 @@ class Frontend:
         return h & 0x7FFFFFFF
 
     def _call_any(self, op: bytes, body: bytes,
-                  start: Optional[int] = None,
+                  key: Optional[int] = None,
                   timeout: Optional[float] = None) -> Tuple[bytes, bytes]:
         """Send one frame to the first member that answers, in routing
-        order; marks failed members down along the way."""
-        if start is None:
-            start = next(self._rr)
+        order (`key` = grouped ring routing); marks failed members down
+        along the way. With a registry and an empty member set, one
+        forced membership sweep runs first — a frontend that started
+        before its backends admits them the moment their leases land."""
+        if not self._members and self.registry is not None:
+            self.refresh_membership()
         last: Optional[Exception] = None
-        for m in self._order(start):
+        for m in self._order(key):
             try:
                 status, resp = m.call(op, body,
                                       timeout if timeout is not None
@@ -621,11 +899,13 @@ class Frontend:
             flags |= _FLAG_TRACE
             prefix = obs_trace.pack_wire(sp.ctx)
         body = bytes([flags]) + prefix + _pack_arrays(features)
-        start = (self._group_key(features) % len(self._members)
-                 if group_users else next(self._rr))
+        # Grouped requests route on the consistent-hash ring (stickiness
+        # survives churn: ~1/N of users remap per join/leave); plain
+        # requests round-robin.
+        key = self._group_key(features) if group_users else None
         try:
             with sp:
-                status, resp = self._call_any(OP_PRED, body, start=start,
+                status, resp = self._call_any(OP_PRED, body, key=key,
                                               timeout=timeout)
         except Exception:
             self.stats.record_error()
@@ -663,7 +943,7 @@ class Frontend:
         batches = ([example] if not ladder else
                    [{k: np.repeat(v, size, axis=0) for k, v in one.items()}
                     for size in ladder])
-        for m in self._members:
+        for m in list(self._members):
             ok = True
             for batch in batches:
                 body = bytes([flags]) + _pack_arrays(batch)
@@ -710,18 +990,30 @@ class Frontend:
         the merged /healthz body: the WORST member's health dict (the
         `_GroupPredictor` selection, spanning processes) + frontend
         availability counters. Down members contribute a synthetic
-        degraded entry."""
-        if len(self._members) == 1:
-            healths = [self._probe_member(self._members[0])]
+        degraded entry. In registry mode the sweep reconciles
+        membership first, so /healthz always describes the CURRENT
+        fleet, never a retired one."""
+        if self.registry is not None:
+            self.refresh_membership()
+        members = list(self._members)
+        if not members:
+            out = obs_schema.health_payload(
+                "down", error="no fleet members admitted")
+            out["members"] = 0
+            out["reachable"] = 0
+            out["draining"] = 0
+            return out
+        if len(members) == 1:
+            healths = [self._probe_member(members[0])]
         else:
-            slots: List[Optional[Dict]] = [None] * len(self._members)
+            slots: List[Optional[Dict]] = [None] * len(members)
 
             def probe(i, m):
                 slots[i] = self._probe_member(m)
 
             threads = [threading.Thread(target=probe, args=(i, m),
                                         daemon=True)
-                       for i, m in enumerate(self._members)]
+                       for i, m in enumerate(members)]
             for t in threads:
                 t.start()
             for t in threads:
@@ -739,9 +1031,10 @@ class Frontend:
         out = dict(worst)
         if out.get("staleness_seconds") == float("inf"):
             out["staleness_seconds"] = None
-        out["members"] = len(self._members)
+        out["members"] = len(members)
         out["reachable"] = reachable
-        if reachable < len(self._members):
+        out["draining"] = sum(1 for m in members if m.draining)
+        if reachable < len(members):
             out["status"] = "degraded" if reachable else "down"
         return out
 
@@ -754,7 +1047,9 @@ class Frontend:
         members = []
         totals = {"requests": 0, "batches": 0, "rows": 0, "errors": 0}
         model = {}
-        for m in self._members:
+        queue_depth = 0
+        backend_p99 = None
+        for m in list(self._members):
             entry = m.snapshot()
             if m.available(time.monotonic()):
                 try:
@@ -765,6 +1060,12 @@ class Frontend:
                         entry["stats"] = snap
                         for k in totals:
                             totals[k] += snap.get(k, 0)
+                        win = snap.get("window") or {}
+                        queue_depth += int(win.get("queue_depth") or 0)
+                        p99 = win.get("e2e_p99_ms")
+                        if p99 is not None:
+                            backend_p99 = (p99 if backend_p99 is None
+                                           else max(backend_p99, p99))
                         mv = snap.get("model", {})
                         if not model or mv.get("version", -1) > model.get(
                                 "version", -1):
@@ -777,6 +1078,21 @@ class Frontend:
         out["members"] = members
         out["backend_totals"] = totals
         out["model"] = model
+        # The autoscaler's observation (fleet.load_from_stats): windowed
+        # edge-visible e2e p99 (the frontend's own obs ring buffers; the
+        # worst member's window when the edge plane is off) + queue depth
+        # summed over members — PR 11's window_summary machinery, not
+        # lifetime aggregates, so a past spike that scrolled out of the
+        # window never triggers a scale event.
+        edge_p99 = self.stats.window_p99_ms("e2e")
+        out["fleet_load"] = {
+            "e2e_p99_ms": edge_p99 if edge_p99 is not None else backend_p99,
+            "backend_p99_ms": backend_p99,
+            "queue_depth": queue_depth,
+            "members": len(members),
+            "draining": sum(1 for e in members if e.get("draining")),
+            "window_seconds": 60,
+        }
         out["health"] = self._health_sweep()
         return out
 
@@ -826,23 +1142,24 @@ class Frontend:
         if obs_metrics.metrics_enabled():
             parts.append(
                 obs_metrics.default_registry().render_prometheus())
+        mlist = list(self._members)
         slots: List[Optional[Tuple[Optional[Dict], bool]]] = \
-            [None] * len(self._members)
-        if len(self._members) == 1:
-            slots[0] = self._member_metrics(self._members[0])
+            [None] * len(mlist)
+        if len(mlist) == 1:
+            slots[0] = self._member_metrics(mlist[0])
         else:
             def probe(i, m):
                 slots[i] = self._member_metrics(m)
 
             threads = [threading.Thread(target=probe, args=(i, m),
                                         daemon=True)
-                       for i, m in enumerate(self._members)]
+                       for i, m in enumerate(mlist)]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
         up_lines = ["# TYPE deeprec_member_up gauge"]
-        for m, got in zip(self._members, slots):
+        for m, got in zip(mlist, slots):
             snap, stale = got if got is not None else (None, True)
             up_lines.append(
                 'deeprec_member_up{member="%s"} %d'
@@ -855,58 +1172,67 @@ class Frontend:
 
     def close(self) -> None:
         self._stop.set()
-        if self._poller is not None:
-            self._poller.join(timeout=2)
-        for m in self._members:
+        for t in (self._poller, self._membership_thread, self._reprober):
+            if t is not None:
+                t.join(timeout=2)
+        for m in list(self._members):
             m.close()
 
 
 # ------------------------------------------------------- process management
 
 
-def spawn_backends(
-    n: int, *, ckpt: str, model: str = "wdl", model_json: Optional[str] = None,
+def backend_argv(
+    *, ckpt: str, model: str = "wdl", model_json: Optional[str] = None,
     quantize: Optional[str] = None, poll_secs: float = 0.0,
     max_batch: int = 256, max_wait_ms: float = 1.0,
-    env: Optional[Dict[str, str]] = None, ready_timeout: float = 180.0,
-):
-    """Launch `n` backend serving processes on this host and wait for
-    their READY lines. Returns (procs, addrs) — pass `addrs` to
-    `Frontend`. Used by tools/bench_serving.py and the fault-matrix
-    tests; production deployments run the same CLI under their own
-    process supervisor (docs/serving.md)."""
-    import os
-    import subprocess
+    registry: Optional[str] = None, lease_secs: Optional[float] = None,
+    capacity: int = 1, member_name: str = "", port: int = 0,
+) -> List[str]:
+    """The backend CLI argv for one serving process — shared by
+    `spawn_backends`, the Supervisor-driven fleet specs (a respawn with
+    ``port=0`` binds a FRESH port and announces it by lease, which is
+    how a rolling restart re-admits the new generation), and the
+    autoscaler's scale_up."""
     import sys
 
-    procs, addrs = [], []
-    for _ in range(n):
-        argv = [
-            sys.executable, "-m", "deeprec_tpu.serving.frontend",
-            "--backend", "--ckpt", ckpt, "--model", model, "--port", "0",
-            "--max_batch", str(max_batch), "--max_wait_ms", str(max_wait_ms),
-            "--poll_secs", str(poll_secs),
-        ]
-        if model_json:
-            argv += ["--model-json", model_json]
-        if quantize:
-            argv += ["--quantize", quantize]
-        p = subprocess.Popen(
-            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, env={**os.environ, **(env or {})},
-        )
-        procs.append(p)
+    argv = [
+        sys.executable, "-m", "deeprec_tpu.serving.frontend",
+        "--backend", "--ckpt", ckpt, "--model", model, "--port", str(port),
+        "--max_batch", str(max_batch), "--max_wait_ms", str(max_wait_ms),
+        "--poll_secs", str(poll_secs),
+    ]
+    if model_json:
+        argv += ["--model-json", model_json]
+    if quantize:
+        argv += ["--quantize", quantize]
+    if registry:
+        argv += ["--registry", registry]
+        if lease_secs is not None:
+            argv += ["--lease-secs", str(lease_secs)]
+        if capacity != 1:
+            argv += ["--capacity", str(capacity)]
+        if member_name:
+            argv += ["--member-name", member_name]
+    return argv
+
+
+def _wait_ready(procs, marker: str, ready_timeout: float):
+    """Collect `marker` ports from each child's stdout (select-bounded:
+    a wedged child that prints NOTHING must fail after ready_timeout,
+    not block readline() forever). Kills the whole set on any miss."""
+    import os
     import select
 
+    ports = []
     deadline = time.monotonic() + ready_timeout
     for p in procs:
         port = None
         buf = ""
-        # select-bounded reads: a wedged child that prints NOTHING must
-        # fail after ready_timeout, not block readline() forever
         while time.monotonic() < deadline:
             ready, _, _ = select.select(
-                [p.stdout], [], [], max(0.1, min(1.0, deadline - time.monotonic())))
+                [p.stdout], [], [],
+                max(0.1, min(1.0, deadline - time.monotonic())))
             if not ready:
                 if p.poll() is not None:
                     break  # child died without a READY line
@@ -916,9 +1242,13 @@ def spawn_backends(
             if not chunk:
                 break  # EOF
             buf += chunk
-            for line in buf.splitlines():
-                if line.startswith("DEEPREC_BACKEND_READY"):
-                    port = int(line.split("port=")[1].strip())
+            # Only COMPLETE lines parse: a READY line split across two
+            # pipe reads must not yield a truncated port number (or an
+            # IndexError before "port=" arrives) — the partial tail
+            # stays in buf until its newline lands.
+            for line in buf.split("\n")[:-1]:
+                if line.startswith(marker) and "port=" in line:
+                    port = int(line.split("port=")[1].split()[0].strip())
                     break
             if port is not None:
                 break
@@ -926,10 +1256,79 @@ def spawn_backends(
             for q in procs:
                 q.kill()
             raise RuntimeError(
-                f"backend pid {p.pid} never reported READY "
+                f"worker pid {p.pid} never reported {marker} "
                 f"(rc={p.poll()}, output tail: {buf[-500:]!r})")
-        addrs.append(("127.0.0.1", port))
-    return procs, addrs
+        ports.append(port)
+    return ports
+
+
+def spawn_backends(
+    n: int, *, ckpt: str, model: str = "wdl", model_json: Optional[str] = None,
+    quantize: Optional[str] = None, poll_secs: float = 0.0,
+    max_batch: int = 256, max_wait_ms: float = 1.0,
+    registry: Optional[str] = None, lease_secs: Optional[float] = None,
+    capacity: int = 1, member_name: str = "",
+    env: Optional[Dict[str, str]] = None, ready_timeout: float = 180.0,
+):
+    """Launch `n` backend serving processes on this host and wait for
+    their READY lines. Returns (procs, addrs) — pass `addrs` to
+    `Frontend`, or pass `registry` and let the frontend discover them by
+    lease instead. Used by tools/bench_serving.py, tools/bench_fleet.py
+    and the fault-matrix tests; production deployments run the same CLI
+    under their own process supervisor (docs/serving.md)."""
+    import os
+    import subprocess
+
+    procs = []
+    for i in range(n):
+        argv = backend_argv(
+            ckpt=ckpt, model=model, model_json=model_json,
+            quantize=quantize, poll_secs=poll_secs, max_batch=max_batch,
+            max_wait_ms=max_wait_ms, registry=registry,
+            lease_secs=lease_secs, capacity=capacity,
+            member_name=(f"{member_name}-{i}" if member_name else ""))
+        p = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env={**os.environ, **(env or {})},
+        )
+        procs.append(p)
+    ports = _wait_ready(procs, "DEEPREC_BACKEND_READY", ready_timeout)
+    return procs, [("127.0.0.1", port) for port in ports]
+
+
+def spawn_frontends(
+    n: int, *, registry: str, model: str = "wdl",
+    model_json: Optional[str] = None, lease_secs: Optional[float] = None,
+    health_secs: float = 2.0, env: Optional[Dict[str, str]] = None,
+    ready_timeout: float = 180.0,
+):
+    """Launch `n` replicated frontend edge processes sharing one lease
+    registry (each discovers backends independently — no single edge).
+    Returns (procs, addrs) with addrs the HTTP endpoints; hand them (or
+    the registry) to a `fleet.FleetClient`."""
+    import os
+    import subprocess
+    import sys
+
+    procs = []
+    for i in range(n):
+        argv = [
+            sys.executable, "-m", "deeprec_tpu.serving.frontend",
+            "--frontend", "--registry", registry, "--model", model,
+            "--http-port", "0", "--health_secs", str(health_secs),
+            "--member-name", f"edge-{i}",
+        ]
+        if model_json:
+            argv += ["--model-json", model_json]
+        if lease_secs is not None:
+            argv += ["--lease-secs", str(lease_secs)]
+        p = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env={**os.environ, **(env or {})},
+        )
+        procs.append(p)
+    ports = _wait_ready(procs, "DEEPREC_FRONTEND_READY", ready_timeout)
+    return procs, [f"127.0.0.1:{port}" for port in ports]
 
 
 def main(argv=None):
@@ -959,6 +1358,18 @@ def main(argv=None):
                    help="frontend mode: comma-separated host:port list")
     p.add_argument("--http-port", type=int, default=8500)
     p.add_argument("--health_secs", type=float, default=2.0)
+    p.add_argument("--registry", default=None,
+                   help="fleet lease-registry directory (serving/fleet.py):"
+                        " backends announce themselves by lease, frontends"
+                        " discover/admit/retire members at runtime")
+    p.add_argument("--lease-secs", type=float, default=10.0,
+                   help="lease staleness bound (stale = evicted)")
+    p.add_argument("--capacity", type=int, default=1,
+                   help="advertised serving capacity (lease field)")
+    p.add_argument("--member-name", default="",
+                   help="supervisor spec name stamped into the lease (the"
+                        " autoscaler's retire handle)")
+    p.add_argument("--drain-timeout", type=float, default=30.0)
     args = p.parse_args(argv)
 
     kwargs = json.loads(args.model_json) if args.model_json else {}
@@ -966,38 +1377,90 @@ def main(argv=None):
 
     model = build_model(args.model, **kwargs)
 
+    registry = None
+    if args.registry:
+        from deeprec_tpu.serving import fleet as _fleet
+
+        registry = _fleet.FleetRegistry(args.registry,
+                                        lease_secs=args.lease_secs)
+
     if args.backend:
         if not args.ckpt:
             p.error("--ckpt is required in --backend mode")
+        import signal as _signal
+        import sys as _sys
+
+        from deeprec_tpu.online import faults as _faults
         from deeprec_tpu.serving.predictor import ModelServer, Predictor
 
         pred = Predictor(model, args.ckpt, quantize=args.quantize)
         server = ModelServer(pred, max_batch=args.max_batch,
                              max_wait_ms=args.max_wait_ms,
                              poll_updates_secs=args.poll_secs)
-        backend = BackendServer(server, host=args.host,
-                                port=args.port).start()
+        backend = BackendServer(
+            server, host=args.host, port=args.port, registry=registry,
+            capacity=args.capacity, member_name=args.member_name,
+            lease_delay_secs=_faults.env_slow_join_secs()).start()
         print(f"DEEPREC_BACKEND_READY port={backend.port}", flush=True)
+        if backend.stamper is not None:
+            # Fleet member: wait for a drain (drain-request file via the
+            # lease loop, or SIGTERM — the k8s preStop shape), finish
+            # in-flight work, exit with the EXIT_RESCALE choreography's
+            # code so a supervisor respawns rolling restarts for free.
+            _signal.signal(
+                _signal.SIGTERM,
+                lambda sig, frm: backend.stamper.begin_drain(respawn=True))
+            try:
+                backend.stamper.draining.wait()
+            except KeyboardInterrupt:
+                backend.stop()
+                return
+            rc = backend.drain(timeout=args.drain_timeout)
+            print(f"DEEPREC_BACKEND_DRAINED rc={rc}", flush=True)
+            _sys.exit(rc)
         try:
             threading.Event().wait()
         except KeyboardInterrupt:
             backend.stop()
         return
 
+    import sys as _sys
+
     from deeprec_tpu.serving.http_server import HttpServer
 
     addrs = [a for a in args.backends.split(",") if a]
-    if not addrs:
-        p.error("--frontend needs --backends host:port[,host:port...]")
-    fe = Frontend(addrs, model, health_secs=args.health_secs)
+    if not addrs and registry is None:
+        p.error("--frontend needs --backends host:port[,...] and/or "
+                "--registry DIR")
+    fe = Frontend(addrs or None, model, registry=registry,
+                  health_secs=args.health_secs)
     http = HttpServer(fe, port=args.http_port, host=args.host).start()
+    stamper = None
+    if registry is not None:
+        from deeprec_tpu.serving import fleet as _fleet
+
+        # The edge announces itself too (role="frontend"): replicated
+        # frontends are discovered by FleetClient the same way backends
+        # are discovered by frontends — no single edge process.
+        stamper = _fleet.LeaseStamper(
+            registry, f"{args.host}:{http.port}",
+            role=_fleet.ROLE_FRONTEND, name=args.member_name).start()
     print(f"DEEPREC_FRONTEND_READY port={http.port} backends={addrs}",
           flush=True)
     try:
-        threading.Event().wait()
+        if stamper is not None:
+            stamper.draining.wait()
+        else:
+            threading.Event().wait()
     except KeyboardInterrupt:
-        http.stop()
-        fe.close()
+        pass
+    http.stop()
+    fe.close()
+    if stamper is not None:
+        rc = stamper.exit_code()
+        stamper.stop(unregister=True)
+        print(f"DEEPREC_FRONTEND_DRAINED rc={rc}", flush=True)
+        _sys.exit(rc)
 
 
 if __name__ == "__main__":

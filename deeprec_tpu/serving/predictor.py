@@ -38,6 +38,7 @@ from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.optim.sparse import GradientDescent
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.training.checkpoint import CheckpointManager
+from deeprec_tpu.utils import backoff as _backoff
 from deeprec_tpu.training.trainer import Trainer, TrainState
 
 
@@ -723,8 +724,11 @@ def _run_poll_loop(owner, stop: threading.Event, secs: float,
                 owner.update_failures = n
                 # capped exponential backoff, jittered across [0.5, 1.5)x
                 # so N pollers hitting one bad FS don't retry in lockstep
-                delay = min(max_backoff_secs, secs * (2 ** min(n, 10)))
-                delay *= 0.5 + rng.random()
+                # (shared utils/backoff.py policy; the n-th failure waits
+                # secs * 2^n — one doubling up front, since the base
+                # cadence already elapsed before the failure surfaced)
+                delay = _backoff.jittered_backoff(
+                    n + 1, secs, max_backoff_secs, rng, max_exponent=10)
                 log.warning(
                     "model update poll failed (%d consecutive, retry in "
                     "%.1fs): %s", n, delay, e,
@@ -1068,7 +1072,10 @@ class ModelServer:
         return out
 
     def stats_snapshot(self) -> Dict:
-        """Live serving stats + model identity — the `/v1/stats` body."""
+        """Live serving stats + model identity — the `/v1/stats` body.
+        The ``window`` section is the autoscaler's load signal (PR 11
+        ring buffers, NOT lifetime aggregates): e2e p99 over the
+        trailing 60 s plus the coalescing queue's instantaneous depth."""
         out = self.stats.snapshot()
         p = self.predictor
         out["model"] = {
@@ -1076,6 +1083,11 @@ class ModelServer:
             "step": p.step,
             "updates": p.update_count,
             "last_update_ms": p.last_update_ms,
+        }
+        out["window"] = {
+            "e2e_p99_ms": self.stats.window_p99_ms("e2e"),
+            "queue_depth": self._q.qsize(),
+            "window_seconds": 60,
         }
         out["health"] = p.health()
         out["residency"] = p.residency_info()

@@ -1,0 +1,69 @@
+"""The shared capped-exponential-with-jitter policy (utils/backoff.py):
+the one implementation behind TCPStreamReader reconnects, frontend
+member backoff, the serving poll loop, and Supervisor restarts. Pure —
+no test here (or anywhere) sleeps to pin the policy."""
+import random
+
+from deeprec_tpu.utils import backoff
+
+
+def test_backoff_delay_is_exponential_and_capped():
+    """base * 2^(k-1) per consecutive failure, capped — the value pins
+    formerly living on TCPStreamReader.backoff_delay."""
+    assert backoff.backoff_delay(1, 0.5, 8.0) == 0.5
+    assert backoff.backoff_delay(2, 0.5, 8.0) == 1.0
+    assert backoff.backoff_delay(3, 0.5, 8.0) == 2.0
+    assert backoff.backoff_delay(5, 0.5, 8.0) == 8.0   # capped
+    assert backoff.backoff_delay(50, 0.5, 8.0) == 8.0  # no overflow past cap
+    # attempt <= 1 (and even nonsense 0/negative) waits the base
+    assert backoff.backoff_delay(0, 0.5, 8.0) == 0.5
+    assert backoff.backoff_delay(-3, 0.5, 8.0) == 0.5
+
+
+def test_backoff_exponent_clamp_prevents_overflow():
+    """A six-figure attempt counter (a member dead for days) must stay a
+    finite float and still just return the cap."""
+    d = backoff.backoff_delay(10 ** 6, 0.25, 30.0)
+    assert d == 30.0
+
+
+def test_backoff_max_exponent_matches_legacy_call_sites():
+    """The frontend member path clamps the exponent at 8 and the poll
+    loop at 10 (their pre-dedup shapes) — pinned so the knob keeps
+    honoring per-caller clamps."""
+    # frontend shape: min(cap, base * 2^min(k-1, 8))
+    assert backoff.backoff_delay(9, 0.2, 1e9, max_exponent=8) == 0.2 * 2 ** 8
+    assert backoff.backoff_delay(99, 0.2, 1e9, max_exponent=8) == 0.2 * 2 ** 8
+    # poll-loop shape: n-th failure = attempt n+1, exponent min(n, 10)
+    assert backoff.backoff_delay(4, 2.0, 1e9, max_exponent=10) == 2.0 * 2 ** 3
+
+
+def test_jitter_band_is_half_to_three_halves():
+    """Jitter spreads across [0.5, 1.5) * delay for every call site."""
+    rng = random.Random(7)
+    vals = [backoff.jittered(10.0, rng) for _ in range(2000)]
+    assert all(5.0 <= v < 15.0 for v in vals)
+    # actually spreads (not stuck at one end)
+    assert max(vals) - min(vals) > 8.0
+
+
+def test_jittered_backoff_composes():
+    rng = random.Random(3)
+    base, cap = 0.5, 8.0
+    for attempt in (1, 3, 7, 40):
+        d = backoff.backoff_delay(attempt, base, cap)
+        v = backoff.jittered_backoff(attempt, base, cap, random.Random(3))
+        rng2 = random.Random(3)
+        assert v == backoff.jittered(d, rng2)
+
+
+def test_seeded_rng_stable_and_distinct():
+    """Same identity -> same jitter stream; different identity or pid ->
+    a different one (no lockstep across fleet members)."""
+    a1 = backoff.seeded_rng("h", 1).random()
+    a2 = backoff.seeded_rng("h", 1).random()
+    b = backoff.seeded_rng("h", 2).random()
+    c = backoff.seeded_rng("h", 1, pid=1234).random()
+    assert a1 == a2
+    assert a1 != b
+    assert a1 != c

@@ -473,18 +473,20 @@ def test_work_queue_file_coordinated(tmp_path):
     assert not (set(taken[0]) & set(taken[1]))
 
 
-def test_tcp_backoff_delay_is_exponential_and_capped():
-    """Reconnect policy: base * 2^(k-1) per consecutive failure, capped —
-    pinned on the pure delay function so no test ever sleeps for it."""
+def test_tcp_backoff_delay_delegates_to_shared_policy():
+    """Reconnect policy: the reader's backoff_delay IS the shared
+    utils/backoff.py policy applied to (reconnect_secs,
+    reconnect_max_secs) — the value pins themselves moved to
+    tests/test_backoff.py with the dedup; this keeps the delegation
+    honest (a reader-local fork would drift undetected)."""
     from deeprec_tpu.data import TCPStreamReader
+    from deeprec_tpu.utils import backoff
 
     r = TCPStreamReader("127.0.0.1", 1, reconnect_secs=0.5,
                         reconnect_max_secs=8.0)
-    assert r.backoff_delay(1) == 0.5
-    assert r.backoff_delay(2) == 1.0
-    assert r.backoff_delay(3) == 2.0
-    assert r.backoff_delay(5) == 8.0   # capped
-    assert r.backoff_delay(50) == 8.0  # and no overflow past the cap
+    for attempt in (1, 2, 3, 5, 50):
+        assert r.backoff_delay(attempt) == backoff.backoff_delay(
+            attempt, 0.5, 8.0)
 
 
 def test_tcp_reader_counts_reconnect_attempts(tmp_path):

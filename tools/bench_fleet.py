@@ -1,0 +1,449 @@
+"""Fleet bench: sustained rps through membership churn with ZERO failed
+requests — the headline the ROADMAP's multi-host serving item names.
+
+Choreography (serving/fleet.py + online/supervisor.py):
+
+  * backends run as SUPERVISED processes that announce themselves by
+    lease (``--registry``); the frontend discovers/admits/retires them
+    at runtime — no member list is ever configured.
+  * **rolling restart of EVERY backend**: `request_drain(addr,
+    respawn=True)` per member — the backend stamps ``draining``,
+    frontends stop new assignments, in-flight finishes, the process
+    exits EXIT_RESCALE and the Supervisor respawns it for free (the
+    `parallel/elastic.py` choreography applied to serving); the new
+    generation binds a fresh port and admits itself by lease.
+  * **2→4→2 scale event**: the `FleetAutoscaler` (manual target — the
+    deterministic bench arm of the same decision core the load policy
+    drives) spawns two members through `Supervisor.add_spec`, then
+    retires two by drain.
+  * **fault arms**: a torn lease file planted mid-load (sweeps must
+    skip it); full mode adds replicated frontends with a SIGKILLed edge
+    (the FleetClient reconnect contract) and a slow joiner
+    (DEEPREC_FAULT_SLOW_JOIN_SECS: reachable but unannounced — no
+    routing until the lease lands).
+
+Every phase runs under sustained closed-loop client load; ANY failed
+request aborts the bench loudly. Results merge into the bench JSON as
+the ``multi_host`` section (`--out SERVING_BENCH.json` updates the
+committed record in place), gated by ``roofline.py --assert-serving``.
+
+    python tools/bench_fleet.py [--smoke] [--out SERVING_BENCH.json]
+        [--seconds 6] [--clients 4] [--frontends 2]
+
+``--smoke`` (CI): 1 in-process frontend + 2 backends, shorter windows,
+same rolling restart + 2→4→2 + torn-lease coverage.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+LEASE_SECS = 3.0
+
+
+def wait_for(pred, timeout, what, poll=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise TimeoutError(f"timed out after {timeout}s waiting for {what}")
+
+
+class LoadGen:
+    """Closed-loop clients running across ALL phases; phase stats come
+    from slicing the request timeline. Any request failure is recorded
+    and FAILS the bench — a fleet bench that drops requests silently
+    would report flattering rps from a broken tier."""
+
+    def __init__(self, client_fn, n_clients):
+        self._lock = threading.Lock()
+        self.recs = []           # (t_start, latency_s)
+        self.errors = []
+        self._stop = threading.Event()
+        self.clients = [client_fn() for _ in range(n_clients)]
+
+        def worker(client):
+            while not self._stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    client["send"]()
+                except Exception as e:  # any failure = bench failure
+                    with self._lock:
+                        self.errors.append(e)
+                    return
+                with self._lock:
+                    self.recs.append((t0, time.monotonic() - t0))
+
+        self.threads = [threading.Thread(target=worker, args=(c,),
+                                         daemon=True)
+                        for c in self.clients]
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self.threads:
+            t.join(timeout=60)
+        if self.errors:
+            raise RuntimeError(
+                f"{len(self.errors)} client failure(s): "
+                f"{self.errors[0]!r}") from self.errors[0]
+
+    def check(self):
+        with self._lock:
+            if self.errors:
+                raise RuntimeError(
+                    f"client failure mid-phase: {self.errors[0]!r}"
+                ) from self.errors[0]
+
+    def phase_stats(self, t0, t1):
+        with self._lock:
+            lat = sorted(dt for (t, dt) in self.recs if t0 <= t < t1)
+        n = len(lat)
+        dur = max(1e-9, t1 - t0)
+
+        def pct(q):
+            return round(1e3 * lat[min(int(q * n), n - 1)], 2) if n else None
+
+        return {"requests": n, "rps": round(n / dur, 1),
+                "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+                "duration_s": round(dur, 2),
+                "failed_requests": len(self.errors)}
+
+    def reconnects(self):
+        return sum(c.get("reconnects", lambda: 0)() for c in self.clients)
+
+
+def run_bench(args):
+    import numpy as np  # noqa: F401  (payload slicing below)
+
+    from bench_serving import WDL_ARGS, build
+    from deeprec_tpu.online.supervisor import ProcessSpec, Supervisor
+    from deeprec_tpu.serving import fleet
+    from deeprec_tpu.serving.frontend import backend_argv, spawn_frontends
+    from deeprec_tpu.serving.http_server import HttpServer
+    from deeprec_tpu.online import faults
+
+    margs = dict(WDL_ARGS)
+    mj = json.dumps(margs)
+    ckpt = tempfile.mkdtemp(prefix="fleet_ckpt_")
+    model, req, _save_next = build(ckpt, margs=margs)
+    rows = args.rows
+    payload = {k: np.asarray(v)[:rows] for k, v in req.items()}
+
+    reg_dir = tempfile.mkdtemp(prefix="fleet_reg_")
+    reg = fleet.FleetRegistry(reg_dir, lease_secs=LEASE_SECS)
+    child_env = {"JAX_PLATFORMS": "cpu", "DEEPREC_OBS": os.environ.get(
+        "DEEPREC_OBS", "")}
+
+    def bargv(name):
+        return backend_argv(ckpt=ckpt, model="wdl", model_json=mj,
+                            registry=reg_dir, lease_secs=LEASE_SECS,
+                            member_name=name, port=0)
+
+    log_dir = tempfile.mkdtemp(prefix="fleet_logs_")
+
+    def spec(name):
+        return ProcessSpec(
+            name=name, argv=bargv(name), lease_secs=None,
+            env=dict(child_env),
+            stdout=os.path.join(log_dir, f"{name}.log"))
+
+    sup = Supervisor([spec("backend-0"), spec("backend-1")],
+                     poll_secs=0.1, keep_alive=True,
+                     on_event=lambda line: print(f"  {line}", flush=True))
+    out = {"mode": "smoke" if args.smoke else "full",
+           "protocol": {
+               "model": "wdl", "model_args": margs,
+               "rows_per_request": rows, "clients": args.clients,
+               "lease_secs": LEASE_SECS,
+               "frontends": 1 if args.smoke else args.frontends,
+               "host_cores": len(os.sched_getaffinity(0)),
+           }}
+    fe = None
+    http = None
+    fprocs = []
+    gen = None
+    try:
+        sup.start()
+        wait_for(lambda: len(reg.members()) == 2, 120,
+                 "2 backend leases")
+        print(f"fleet: 2 supervised backends leased in {reg_dir}",
+              flush=True)
+
+        # ---- edge tier + clients
+        if args.smoke:
+            from deeprec_tpu.serving import Frontend
+
+            fe = Frontend(None, model, registry=reg, membership_secs=0.2,
+                          reprobe_secs=1.0)
+            fe.warmup(payload)
+            http = HttpServer(fe, port=0).start()
+            edges = [f"127.0.0.1:{http.port}"]
+        else:
+            fprocs, edges = spawn_frontends(
+                args.frontends, registry=reg_dir, model="wdl",
+                model_json=mj, lease_secs=LEASE_SECS,
+                env=dict(child_env))
+        print(f"fleet: edge tier {edges}", flush=True)
+
+        def client_fn():
+            c = fleet.FleetClient(edges, registry=reg if not args.smoke
+                                  else None, timeout=60.0, deadline=120.0)
+            return {"send": lambda: c.predict(payload),
+                    "reconnects": lambda: c.reconnects}
+
+        # prime through the wire so every edge (and through round-robin,
+        # every backend) compiles before the measured windows
+        primer = fleet.FleetClient(edges, timeout=120.0, deadline=240.0)
+        for _ in range(4 * len(edges)):
+            primer.predict(payload)
+
+        gen = LoadGen(client_fn, args.clients).start()
+
+        def phase(seconds=None, until=None, what=""):
+            t0 = time.monotonic()
+            if until is None:
+                time.sleep(seconds)
+            else:
+                wait_for(until, args.phase_timeout, what)
+                if seconds:
+                    time.sleep(seconds)
+            gen.check()
+            return t0, time.monotonic()
+
+        # ---- phase 1: steady state
+        t0, t1 = phase(seconds=args.seconds, what="steady")
+        out["steady"] = gen.phase_stats(t0, t1)
+        print(f"fleet: steady {out['steady']}", flush=True)
+
+        # ---- phase 2: rolling restart of EVERY backend (EXIT_RESCALE)
+        t0 = time.monotonic()
+        rolled = 0
+        fleet_size = len(reg.members())
+        for m in list(reg.members()):
+            old_addr = m.addr
+            before = {x.addr for x in reg.members()}
+            reg.request_drain(old_addr, respawn=True)
+            # drained member unregisters; the supervisor respawns the
+            # spec; the new generation binds a fresh port and leases it
+            wait_for(
+                lambda: old_addr not in
+                {x.addr for x in reg.members()},
+                args.phase_timeout, f"{old_addr} to drain out")
+            wait_for(
+                lambda: len(reg.members()) == fleet_size and
+                {x.addr for x in reg.members()} != before,
+                args.phase_timeout, "replacement lease")
+            rolled += 1
+            gen.check()
+            print(f"fleet: rolled {old_addr} "
+                  f"({rolled}/{fleet_size})", flush=True)
+        # settle a moment of steady traffic on the new generation
+        time.sleep(max(1.0, args.seconds / 3))
+        t1 = time.monotonic()
+        stats = sup.stats()
+        out["rolling_restart"] = {
+            **gen.phase_stats(t0, t1),
+            "restarted": rolled,
+            "fleet_size": fleet_size,
+            "covered_all": rolled == fleet_size,
+            "rescale_respawns": sum(
+                s["rescales"] for s in stats.values()),
+            "unplanned_restarts": sum(
+                s["restarts"] for s in stats.values()),
+        }
+        print(f"fleet: rolling_restart {out['rolling_restart']}",
+              flush=True)
+
+        # ---- phase 3: 2->4->2 scale event through the autoscaler
+        t0 = time.monotonic()
+        scaler = fleet.attach_autoscaler(
+            sup, reg, bargv, name_prefix="backend",
+            env=dict(child_env), min_members=2, max_members=4,
+            cooldown_secs=1.0, sustain=2)
+        path = [len(reg.members(include_draining=False))]
+
+        def drive_target(n):
+            scaler.set_target(n)
+            deadline = time.monotonic() + args.phase_timeout
+            while True:
+                scaler.observe(None)  # one tick (cooldown-paced inside)
+                cur = len(reg.members(include_draining=False))
+                if cur != path[-1]:
+                    path.append(cur)
+                gen.check()
+                # settled: count right, target consumed, drained exited
+                if cur == n and scaler.at_target() and \
+                        len(reg.members()) == n:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet never settled at {n} "
+                        f"(at {cur}, leases {len(reg.members())})")
+                time.sleep(0.2)
+
+        drive_target(4)
+        time.sleep(max(1.0, args.seconds / 3))  # serve a beat at 4
+        drive_target(2)
+        scaler.reap()          # release drained members' specs
+        time.sleep(max(1.0, args.seconds / 3))
+        t1 = time.monotonic()
+        gen.check()
+        # collapse the observed walk into the turning-point path
+        turning = [path[0]]
+        for v in path[1:]:
+            if v != turning[-1]:
+                turning.append(v)
+        out["scale"] = {
+            **gen.phase_stats(t0, t1),
+            "path": turning,
+            "target_max": 4,
+            "actions": [
+                {k: a[k] for k in ("action", "members_before", "why")}
+                for a in scaler.actions],
+        }
+        print(f"fleet: scale {out['scale']}", flush=True)
+
+        # ---- phase 4: fault arms
+        out["faults"] = {}
+        # torn lease mid-load: sweeps skip it, nothing degrades
+        t0 = time.monotonic()
+        planted = faults.torn_lease_write(reg, "10.9.9.9:1", pid=424242)
+        time.sleep(1.0)
+        gen.check()
+        members_now = len(reg.members())
+        t1 = time.monotonic()
+        out["faults"]["torn_lease"] = {
+            **gen.phase_stats(t0, t1),
+            "planted": os.path.basename(planted),
+            "members_visible": members_now,
+            "member_count_unaffected": members_now == 2,
+        }
+        os.unlink(planted)
+
+        if not args.smoke:
+            # frontend SIGKILL: the FleetClient reconnect contract — an
+            # edge death costs reconnects, never a failed request
+            t0 = time.monotonic()
+            victim = fprocs[0]
+            pre_reconnects = gen.reconnects()
+            faults.sigkill_fleet_member(victim)
+            time.sleep(max(2.0, args.seconds / 2))
+            gen.check()
+            t1 = time.monotonic()
+            out["faults"]["frontend_kill"] = {
+                **gen.phase_stats(t0, t1),
+                "reconnects": gen.reconnects() - pre_reconnects,
+                "edges_remaining": len(edges) - 1,
+            }
+            print(f"fleet: frontend_kill "
+                  f"{out['faults']['frontend_kill']}", flush=True)
+
+            # slow joiner: reachable but unannounced — full service
+            # meanwhile, admitted when the lease finally lands
+            t0 = time.monotonic()
+            import subprocess
+
+            slow_env = {**os.environ, **child_env,
+                        faults.SLOW_JOIN_ENV: "4.0"}
+            sj = subprocess.Popen(
+                bargv("backend-slow"), env=slow_env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+            base = len(reg.members())
+            time.sleep(2.0)          # mid-join: must NOT be a member yet
+            mid = len(reg.members())
+            wait_for(lambda: len(reg.members()) > base,
+                     args.phase_timeout, "slow joiner's lease")
+            gen.check()
+            t1 = time.monotonic()
+            out["faults"]["slow_joiner"] = {
+                **gen.phase_stats(t0, t1),
+                "members_before_join": base,
+                "members_mid_join": mid,
+                "join_invisible_until_lease": mid == base,
+            }
+            sj.kill()
+            sj.wait(timeout=30)
+
+        # ---- wrap up
+        gen.stop()
+        gen = None
+        failed = sum(
+            sec.get("failed_requests", 0)
+            for sec in [out["steady"], out["rolling_restart"],
+                        out["scale"], *out["faults"].values()])
+        out["zero_failed_requests"] = failed == 0
+        out["total_requests"] = sum(
+            sec.get("requests", 0)
+            for sec in [out["steady"], out["rolling_restart"],
+                        out["scale"], *out["faults"].values()])
+        return out
+    finally:
+        if gen is not None:
+            gen._stop.set()
+        if http is not None:
+            http.stop()
+        if fe is not None:
+            fe.close()
+        for p in fprocs:
+            p.kill()
+        sup.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="CI tier: 1 in-process frontend + 2 backends, "
+                        "short windows, full churn coverage")
+    p.add_argument("--seconds", type=float, default=None,
+                   help="steady-phase window (default 6, smoke 2)")
+    p.add_argument("--clients", type=int, default=None,
+                   help="closed-loop clients (default 4, smoke 2)")
+    p.add_argument("--rows", type=int, default=8)
+    p.add_argument("--frontends", type=int, default=2,
+                   help="replicated edge processes (full mode)")
+    p.add_argument("--phase-timeout", type=float, default=180.0)
+    p.add_argument("--out", default=None,
+                   help="JSON file to merge the multi_host section into "
+                        "(created if missing)")
+    args = p.parse_args(argv)
+    if args.seconds is None:
+        args.seconds = 2.0 if args.smoke else 6.0
+    if args.clients is None:
+        args.clients = 2 if args.smoke else 4
+
+    t0 = time.time()
+    mh = run_bench(args)
+    mh["bench_seconds"] = round(time.time() - t0, 1)
+    print(json.dumps({"multi_host": mh}, indent=2))
+
+    if not mh["zero_failed_requests"]:
+        print("fleet bench: FAILED REQUESTS DETECTED", file=sys.stderr)
+        return 1
+    if args.out:
+        rec = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                rec = json.load(f)
+        rec["multi_host"] = mh
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(f"fleet bench: merged multi_host into {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -369,6 +369,75 @@ def assert_serving(json_path: str, scale_floor: float,
             f"{gr.get('ungrouped_cps')} at {gr.get('rows_per_request')} "
             f"candidates/request)"
         )
+
+    rc |= _assert_multi_host(rec.get("multi_host"), json_path)
+    return rc
+
+
+def _assert_multi_host(mh, json_path: str) -> int:
+    """The fleet gate (tools/bench_fleet.py `multi_host` section):
+    sustained rps through a rolling restart of EVERY backend and a
+    scale-out/-in event (2→4→2; the smoke tier runs the same walk) with
+    ZERO failed requests anywhere — the ROADMAP's multi-host headline.
+    Structural honesty only: rps floors belong to capable hosts, the
+    zero-failure and coverage contracts hold on any host."""
+    if not mh:
+        print(f"roofline: {json_path} has no 'multi_host' record "
+              "(run tools/bench_fleet.py --out onto this JSON)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    phases = {"steady": mh.get("steady", {}),
+              "rolling_restart": mh.get("rolling_restart", {}),
+              "scale": mh.get("scale", {}),
+              **{f"faults.{k}": v
+                 for k, v in mh.get("faults", {}).items()}}
+    for name, ph in phases.items():
+        if ph.get("failed_requests", 1) != 0:
+            print(f"roofline: fleet gate FAILED — phase {name} recorded "
+                  f"{ph.get('failed_requests')} failed request(s); the "
+                  f"fleet contract is ZERO through every churn event",
+                  file=sys.stderr)
+            rc = 1
+        if name in ("steady", "rolling_restart", "scale") and \
+                not ph.get("rps"):
+            print(f"roofline: fleet gate FAILED — phase {name} sustained "
+                  f"no traffic (rps {ph.get('rps')})", file=sys.stderr)
+            rc = 1
+    roll = phases["rolling_restart"]
+    if not roll.get("covered_all") or roll.get("restarted", 0) < 2:
+        print(f"roofline: fleet gate FAILED — rolling restart covered "
+              f"{roll.get('restarted')}/{roll.get('fleet_size')} backends "
+              f"(must roll EVERY member)", file=sys.stderr)
+        rc = 1
+    if roll.get("unplanned_restarts", 0) != 0:
+        print(f"roofline: fleet gate FAILED — "
+              f"{roll.get('unplanned_restarts')} UNPLANNED supervisor "
+              f"restart(s) during the roll (drain must exit via "
+              f"EXIT_RESCALE, not crash)", file=sys.stderr)
+        rc = 1
+    sc = phases["scale"]
+    path = sc.get("path") or []
+    tmax = sc.get("target_max", 4)
+    if (len(path) < 3 or path[0] != path[-1] or max(path) != tmax
+            or max(path) - path[0] < 2):
+        print(f"roofline: fleet gate FAILED — scale path {path} is not a "
+              f"{path[0] if path else '?'}→{tmax}→"
+              f"{path[0] if path else '?'} round trip", file=sys.stderr)
+        rc = 1
+    if not mh.get("zero_failed_requests"):
+        print("roofline: fleet gate FAILED — zero_failed_requests is "
+              "false", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: fleet ok — rolled {roll.get('restarted')}/"
+            f"{roll.get('fleet_size')} backends at "
+            f"{roll.get('rps')} rps (p99 {roll.get('p99_ms')} ms), "
+            f"scale {'→'.join(str(x) for x in path)} at "
+            f"{sc.get('rps')} rps, {mh.get('total_requests')} requests, "
+            f"0 failed"
+        )
     return rc
 
 
