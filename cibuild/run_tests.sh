@@ -61,6 +61,14 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== freshness bench (CPU smoke: online loop, trainer SIGKILL + supervised restart, zero failed requests) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke
 
+echo "== guard bench (CPU smoke: poison matrix — NaN/extreme/label-flip/replays + exploding-LR window; sentinel detects ≤1 dispatch, rollback+quarantine, canary gate, AUC floor, zero failed requests) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_guard.py --smoke \
+    --out /tmp/deeprec_guard_smoke.json
+
+echo "== model-quality firewall gate (drift fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-guard /tmp/deeprec_guard_smoke.json
+
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
     BENCH_PIPELINE=grid python bench.py --placement --smoke \

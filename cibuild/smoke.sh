@@ -31,4 +31,9 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_fleet.py::test_ring_remap_fraction_on_join_at_most_2_over_n \
   tests/test_fleet.py::test_registry_stale_lease_eviction_and_readmission_race \
   tests/test_fleet.py::test_frontend_drain_excludes_new_assignments_zero_failures \
+  tests/test_guard.py::test_step_flags_matrix \
+  tests/test_guard.py::test_sentinel_is_bitexact_noop_when_untripped \
+  tests/test_guard.py::test_canary_gate_rejects_nan_delta_serving_continues \
+  tests/test_guard_stream.py::test_tcp_reader_skips_oversized_frame_and_counts \
+  tests/test_guard_stream.py::test_line_parser_garbage_matrix \
   "$@"

@@ -18,6 +18,79 @@ CRITEO_COLUMNS = (
 )
 
 
+class RecordErrors:
+    """Structured per-record error counter — the first line of the
+    model-quality firewall (guard/): malformed input is rejected or
+    clamped HERE, counted by kind, instead of propagating NaN/garbage
+    into the trainer where only the step sentinel can still catch it.
+
+    Kinds are a BOUNDED set (DRT007 discipline — they also become the
+    ``kind=`` label of ``deeprec_record_errors``): ``bad_label`` /
+    ``bad_float`` (unparseable text), ``nonfinite_float`` (parsed but
+    inf/NaN), ``bad_id`` (negative/out-of-range id clamped to pad),
+    ``oversized_bag`` (id bag trimmed), ``oversized_frame`` (stream
+    frame skipped by the bounded resync), ``undecodable`` (record
+    dropped entirely)."""
+
+    KINDS = ("bad_label", "bad_float", "nonfinite_float", "bad_id",
+             "oversized_bag", "oversized_frame", "undecodable")
+
+    def __init__(self, metrics: bool = True):
+        self.counts: Dict[str, int] = {}
+        self._metrics = metrics
+
+    def count(self, kind: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        self.counts[kind] = self.counts.get(kind, 0) + int(n)  # noqa: DRT002 — host error counter on host parse results
+        if self._metrics:
+            from deeprec_tpu.obs import metrics as obs_metrics
+
+            if obs_metrics.metrics_enabled():
+                obs_metrics.default_registry().counter(
+                    "deeprec_record_errors",
+                    "malformed input records rejected/clamped by kind",
+                    {"kind": kind},
+                ).inc(n)
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self.counts)
+
+
+def sanitize_batch(batch: Dict[str, np.ndarray],
+                   errors: Optional[RecordErrors] = None,
+                   pad_value: int = -1,
+                   max_id: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Clamp a parsed numpy batch in place of trusting it: non-finite
+    floats become 0 (counted ``nonfinite_float``), negative ids other
+    than the pad value — and ids past ``max_id`` when given — become the
+    pad value (counted ``bad_id``). Label keys clamp non-finite to 0
+    too. Returns the batch (arrays copied only when dirty)."""
+    out = {}
+    for k, v in batch.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            bad = ~np.isfinite(a)
+            if bad.any():
+                if errors is not None:
+                    errors.count("nonfinite_float", int(bad.sum()))
+                a = np.where(bad, np.zeros((), a.dtype), a)
+        elif np.issubdtype(a.dtype, np.integer) and not k.startswith("label"):
+            bad = (a < 0) & (a != pad_value)
+            if max_id is not None:
+                bad = bad | (a > max_id)
+            if bad.any():
+                if errors is not None:
+                    errors.count("bad_id", int(bad.sum()))
+                a = np.where(bad, np.asarray(pad_value, a.dtype), a)
+        out[k] = a
+    return out
+
+
 def _hash_strings(col: "np.ndarray", salt: int) -> np.ndarray:
     """Vectorized string -> int32 id (crc32-based; stable across runs)."""
     out = np.empty(len(col), np.int32)
@@ -93,6 +166,9 @@ class CriteoCSVReader:
         self.num_cat = num_cat
         self.drop_remainder = drop_remainder
         self.byte_range = byte_range
+        # Firewall: every yielded batch passes sanitize_batch (non-finite
+        # floats -> 0, negative ids -> pad), counted here by kind.
+        self.errors = RecordErrors()
         if byte_range is not None and len(self.paths) != 1:
             raise ValueError("byte_range applies to exactly one file")
 
@@ -108,9 +184,13 @@ class CriteoCSVReader:
                 "label": chunk["label"].to_numpy(np.float32)
             }
             for i in range(1, self.num_dense + 1):
-                out[f"I{i}"] = np.nan_to_num(
-                    chunk[f"I{i}"].to_numpy(np.float32)
-                ).reshape(-1, 1)
+                # raw values here; sanitize_batch clamps non-finite to 0
+                # AND counts them (np.nan_to_num hid inf as 3.4e38 — an
+                # extreme-magnitude poison, exactly what the firewall
+                # exists to stop)
+                out[f"I{i}"] = (
+                    chunk[f"I{i}"].to_numpy(np.float32).reshape(-1, 1)
+                )
             for i in range(1, self.num_cat + 1):
                 out[f"C{i}"] = _hash_strings(
                     chunk[f"C{i}"].to_numpy(object), salt=i * 0x9E3779B9 & 0x7FFFFFFF
@@ -182,7 +262,8 @@ class CriteoCSVReader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         native = self._iter_native()
         if native is not None:
-            yield from native
+            for batch in native:
+                yield sanitize_batch(batch, self.errors)
             return
         import contextlib
 
@@ -202,7 +283,8 @@ class CriteoCSVReader:
                     chunksize=self.B * 16,
                     header=None,
                 ):
-                    yield from self._frame_to_batches(df)
+                    for batch in self._frame_to_batches(df):
+                        yield sanitize_batch(batch, self.errors)
 
 
 class ParquetReader:

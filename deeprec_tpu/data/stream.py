@@ -31,9 +31,16 @@ import numpy as np
 from deeprec_tpu.utils import backoff
 
 
-def criteo_line_parser(num_dense: int = 13, num_cat: int = 26) -> Callable:
+def criteo_line_parser(num_dense: int = 13, num_cat: int = 26,
+                       errors=None) -> Callable:
     """Default record parser shared by the stream readers: Criteo TSV lines
-    -> batch dict, with the same id hashing as data/readers.py."""
+    -> batch dict, with the same id hashing as data/readers.py.
+
+    Garbage-tolerant by contract (the firewall's first line): an
+    unparseable label/float clamps to 0, a non-finite value clamps to 0,
+    and every clamp counts into `errors` (data/readers.py RecordErrors)
+    by kind — one bad field must never kill the reader thread that
+    feeds a live training loop."""
 
     def parse(lines):
         from deeprec_tpu.data.readers import _hash_strings
@@ -44,13 +51,33 @@ def criteo_line_parser(num_dense: int = 13, num_cat: int = 26) -> Callable:
         cat_cols = [np.empty(n, object) for _ in range(num_cat)]
         for r, line in enumerate(lines):
             parts = line.split("\t")
-            labels[r] = float(parts[0] or 0)
+            try:
+                labels[r] = float(parts[0] or 0)  # noqa: DRT002 — host text parse, pre-device
+            except (TypeError, ValueError):
+                labels[r] = 0.0
+                if errors is not None:
+                    errors.count("bad_label")
             for i in range(num_dense):
                 v = parts[1 + i] if len(parts) > 1 + i else ""
-                dense[r, i] = float(v) if v else 0.0
+                try:
+                    dense[r, i] = float(v) if v else 0.0  # noqa: DRT002 — host text parse, pre-device
+                except (TypeError, ValueError):
+                    dense[r, i] = 0.0
+                    if errors is not None:
+                        errors.count("bad_float")
             for i in range(num_cat):
                 j = 1 + num_dense + i
                 cat_cols[i][r] = parts[j] if len(parts) > j else ""
+        bad_label = ~np.isfinite(labels)
+        if bad_label.any():
+            labels[bad_label] = 0.0
+            if errors is not None:
+                errors.count("nonfinite_float", int(bad_label.sum()))  # noqa: DRT002 — host numpy count, pre-device
+        bad = ~np.isfinite(dense)
+        if bad.any():
+            dense[bad] = 0.0
+            if errors is not None:
+                errors.count("nonfinite_float", int(bad.sum()))  # noqa: DRT002 — host numpy count, pre-device
         out: Dict[str, np.ndarray] = {"label": labels}
         for i in range(num_dense):
             out[f"I{i+1}"] = dense[:, i : i + 1]
@@ -175,6 +202,16 @@ class TCPStreamReader:
     reconnects use jittered exponential backoff from `reconnect_secs` up
     to `reconnect_max_secs`, and `connect_attempts` / `reconnects` /
     `consecutive_connect_failures` surface the churn to supervisors.
+
+    Frame hygiene (the firewall's first line, docs/fault-tolerance.md
+    "Semantic faults"): a frame larger than `max_record_bytes` with no
+    newline is a wedged/garbage stream segment — it is SKIPPED up to the
+    next newline (bounded resync, counted in `oversized_frames` +
+    `record_errors`) instead of growing the buffer without bound or
+    killing the reader thread; an undecodable record clamps field-wise
+    inside the default parser (`criteo_line_parser(errors=...)`), also
+    counted — one poisoned frame must cost one frame, never a reconnect
+    cycle or the reader.
     """
 
     def __init__(
@@ -188,11 +225,19 @@ class TCPStreamReader:
         reconnect_max_secs: float = 30.0,
         num_dense: int = 13,
         num_cat: int = 26,
+        max_record_bytes: int = 1 << 20,
     ):
+        from deeprec_tpu.data.readers import RecordErrors
+
         self.host = host
         self.port = port
         self.B = batch_size
-        self.parser = parser or criteo_line_parser(num_dense, num_cat)
+        self.record_errors = RecordErrors()
+        self.max_record_bytes = int(max_record_bytes)
+        self.oversized_frames = 0
+        self._skipping = False  # inside an oversized frame, seeking \n
+        self.parser = parser or criteo_line_parser(
+            num_dense, num_cat, errors=self.record_errors)
         self.stop_at_eof = stop_at_eof
         # Reconnect policy: jittered exponential backoff from
         # `reconnect_secs` (the base, kept for back-compat) capped at
@@ -238,9 +283,27 @@ class TCPStreamReader:
         self.consecutive_connect_failures = 0
         return s  # must not look like an EOF after a lull
 
+    def _pop_batch(self, entries, count: int):
+        """Pop `count` real rows off the entry queue, folding EVERY
+        popped entry's bytes (skip markers included) into the offset —
+        skipped frames are consumed stream positions, or a reconnect
+        would replay them forever."""
+        batch_rows = []
+        nbytes = 0
+        while len(batch_rows) < count and entries:
+            payload, nb = entries.pop(0)
+            nbytes += nb
+            if payload is not None:
+                batch_rows.append(payload)
+        self.offset += nbytes
+        return batch_rows
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         buf = b""
-        rows: list = []
+        # [(payload | None, nbytes)] — None marks a skipped (oversized)
+        # frame whose bytes still advance the offset in stream order.
+        entries: list = []
+        nreal = 0
         sock = None
         try:
             while True:
@@ -264,32 +327,71 @@ class TCPStreamReader:
                     sock.close()
                     sock = None
                     if self.stop_at_eof:
-                        break  # keep rows: the final drain yields them
+                        break  # keep entries: the final drain yields them
                     # Drop un-yielded partials: the reconnect replays from
                     # self.offset, which covers exactly the yielded rows —
-                    # keeping buf/rows would deliver them twice and splice
-                    # a corrupt record out of the old partial line.
+                    # keeping buf/entries would deliver them twice and
+                    # splice a corrupt record out of the old partial line.
                     buf = b""
-                    rows = []
+                    entries = []
+                    nreal = 0
+                    self._skipping = False
                     self.reconnects += 1
                     self.consecutive_connect_failures += 1
                     self._backoff_sleep()
                     continue
+                if self._skipping:
+                    # bounded resync: discard until the oversized frame's
+                    # terminating newline, counting the bytes (the frame
+                    # itself was counted when the skip began — it may
+                    # never see its newline before EOF)
+                    nl = data.find(b"\n")
+                    if nl < 0:
+                        entries.append((None, len(data)))
+                        continue
+                    entries.append((None, nl + 1))
+                    self._skipping = False
+                    data = data[nl + 1:]
                 buf += data
                 nl = buf.rfind(b"\n")
                 if nl >= 0:
-                    rows.extend(buf[: nl + 1].split(b"\n")[:-1])
+                    for r in buf[: nl + 1].split(b"\n")[:-1]:
+                        if len(r) > self.max_record_bytes:
+                            # a complete-but-absurd frame: skip it whole
+                            entries.append((None, len(r) + 1))
+                            self.oversized_frames += 1
+                            self.record_errors.count("oversized_frame")
+                            continue
+                        entries.append((r, len(r) + 1))
+                        nreal += 1
                     buf = buf[nl + 1:]
-                while len(rows) >= self.B:
-                    batch_rows, rows = rows[: self.B], rows[self.B:]
-                    self.offset += sum(len(r) + 1 for r in batch_rows)
+                if len(buf) > self.max_record_bytes:
+                    # an unterminated frame larger than any legal record:
+                    # consume what's buffered and skip to the next newline
+                    # (counted NOW — at EOF it may never get one)
+                    entries.append((None, len(buf)))
+                    buf = b""
+                    self._skipping = True
+                    self.oversized_frames += 1
+                    self.record_errors.count("oversized_frame")
+                while nreal >= self.B:
+                    batch_rows = self._pop_batch(entries, self.B)
+                    nreal -= len(batch_rows)
                     yield self.parser(
                         [r.decode(errors="replace") for r in batch_rows]
                     )
             # drain the final partial batch at EOF
-            if rows:
-                self.offset += sum(len(r) + 1 for r in rows)
-                yield self.parser([r.decode(errors="replace") for r in rows])
+            if nreal:
+                batch_rows = self._pop_batch(entries, nreal)
+                yield self.parser(
+                    [r.decode(errors="replace") for r in batch_rows]
+                )
+            # trailing skip markers are consumed stream positions even at
+            # EOF: fold them into the offset so a checkpointed position
+            # never points back into skipped garbage
+            for _, nb in entries:
+                self.offset += nb
+            entries = []
         finally:
             if sock is not None:
                 sock.close()

@@ -1,0 +1,64 @@
+"""Model-quality firewall: semantic-fault defense for the online loop.
+
+PR 7 made the pipeline survive *process* faults and PR 12 *membership*
+faults; this package defends the remaining class — *semantic* faults,
+where every process is healthy but the MODEL goes bad: a poisoned batch
+or an exploding gradient writes NaN/garbage table rows, and the
+zero-stall delta chain then ships them to serving with no stall and no
+error. Production recommenders treat "we silently served a bad model"
+as the worst outage class, worse than downtime (PAPERS: Tensor
+Casting's observation that sparse-path corruption is silent — the dense
+loss can look plausible for many steps).
+
+Four layers, one firewall (docs/fault-tolerance.md "Semantic faults"):
+
+  * ``sentinel``  — on-device per-dispatch step checks (non-finite
+    loss/grad, loss-spike vs an EMA, global grad-norm, updated-row-norm)
+    packed into ONE int32 flags scalar carried through the K-step scan;
+    the trainer reads one dispatch-old scalar per step — zero added host
+    syncs, zero steady-state compiles.
+  * ``quarantine`` — TrainLoop rollback policy: a tripped dispatch
+    restores the last verified checkpoint (PR 7 ``valid_chain()``),
+    replays the non-poisoned window bit-identically, dead-letters the
+    offending batch, and permanently quarantines a batch that trips
+    across ``max_batch_trips`` rollbacks — the crash-loop breaker the
+    Supervisor cannot provide (restart-and-replay hits the same poison
+    forever).
+  * row hygiene — optional per-step row-norm clamp plus an
+    anomaly-eviction pass in ``Trainer.maintain()`` (rows whose norm
+    explodes past a quantile bound are re-initialized and counted).
+  * ``canary``    — the gated delta-publish path: ``Predictor`` evaluates
+    a fixed probe batch on the shadow state BEFORE the snapshot swap; a
+    failing delta is quarantined with the PR 7 rename discipline, the
+    old snapshot keeps serving, and ``health()`` reports
+    ``degraded: quality_gate``.
+
+``tools/bench_guard.py`` measures the whole firewall under injected
+poison (``online/faults.py`` injectors) and ``roofline.py
+--assert-guard`` gates it in CI: serving AUC never crosses the floor,
+ZERO failed requests, detection ≤ 1 dispatch.
+"""
+from deeprec_tpu.guard.canary import QualityGate, QualityGateRejected
+from deeprec_tpu.guard.quarantine import (
+    DeadLetter,
+    GuardPolicy,
+    batch_fingerprint,
+)
+from deeprec_tpu.guard.sentinel import (
+    FLAG_GRAD_NORM,
+    FLAG_LOSS_SPIKE,
+    FLAG_NONFINITE_GRAD,
+    FLAG_NONFINITE_LOSS,
+    FLAG_ROW_NORM,
+    SentinelConfig,
+    flag_kinds,
+    guard_init,
+)
+
+__all__ = [
+    "SentinelConfig", "guard_init", "flag_kinds",
+    "FLAG_NONFINITE_LOSS", "FLAG_NONFINITE_GRAD", "FLAG_GRAD_NORM",
+    "FLAG_LOSS_SPIKE", "FLAG_ROW_NORM",
+    "GuardPolicy", "DeadLetter", "batch_fingerprint",
+    "QualityGate", "QualityGateRejected",
+]

@@ -1,0 +1,150 @@
+"""Poison-batch dead-lettering and the permanent-quarantine breaker.
+
+When the step sentinel trips, ``TrainLoop`` rolls the model back to the
+last verified checkpoint and SKIPS the offending batch — but the batch
+itself must not vanish: operators need the payload for forensics
+(which feature carried the NaN? which upstream job flipped the
+labels?), and the loop needs memory of it, because a restart-and-replay
+supervisor would otherwise feed the same poison forever. That is what
+the dead-letter directory provides:
+
+    <dir>/batch-<fingerprint>.npz      the offending batch's arrays
+    <dir>/batch-<fingerprint>.json     step, flags, tripped kinds, count
+    <dir>/quarantine.json              fingerprint -> trip count + the
+                                       permanent set (atomic tmp+rename,
+                                       same commit discipline as the
+                                       checkpoint manifest)
+
+A batch whose fingerprint trips across ``GuardPolicy.max_batch_trips``
+rollbacks is PERMANENTLY quarantined: the loop drops it before
+dispatch, forever, across process restarts — the crash-loop breaker
+the Supervisor cannot provide (it can only tell "restart fixed it"
+from "it died again"; the guard-trip heartbeat field plus this index
+tells it "the data poisons it").
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def batch_fingerprint(batch: Dict) -> str:
+    """Content fingerprint of one batch: sha1 over the sorted keys and
+    raw array bytes — stable across processes, so a permanently
+    quarantined batch stays quarantined through any restart/replay."""
+    h = hashlib.sha1()
+    for k in sorted(batch):
+        h.update(k.encode())
+        a = np.ascontiguousarray(np.asarray(batch[k]))  # noqa: DRT002 — fingerprints hash the HOST batch before it is ever device_put
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+@dataclass(frozen=True)
+class GuardPolicy:
+    """TrainLoop-side rollback/quarantine policy.
+
+    ``max_batch_trips`` is R from the firewall spec: trips of one batch
+    fingerprint before it is permanently quarantined.
+    ``replay_window`` bounds the in-memory batch buffer used to resume
+    bit-identically after a rollback (it must cover at least one save
+    cadence; batches older than the window cannot be replayed and the
+    rollback degrades to resuming at the restored step)."""
+
+    dead_letter_dir: str
+    max_batch_trips: int = 2
+    replay_window: int = 256
+
+
+class DeadLetter:
+    """The dead-letter directory: payloads, trip counts, permanent set.
+
+    Host-side and rollback-cadence only — nothing here is on the train
+    hot path. The index commits atomically so a crash mid-update leaves
+    the previous intact index, never a torn one."""
+
+    INDEX = "quarantine.json"
+
+    def __init__(self, directory: str, max_batch_trips: int = 2):
+        self.dir = directory
+        self.max_batch_trips = max(1, int(max_batch_trips))
+        os.makedirs(directory, exist_ok=True)
+        self._index: Dict = {"trips": {}, "permanent": []}
+        try:
+            with open(os.path.join(directory, self.INDEX)) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                self._index["trips"].update(loaded.get("trips", {}))
+                self._index["permanent"] = list(loaded.get("permanent", []))
+        except (OSError, ValueError):
+            pass  # fresh dir, or an unreadable index: start conservative
+
+    # ------------------------------------------------------------ queries
+
+    def is_quarantined(self, fingerprint: str) -> bool:
+        return fingerprint in self._index["permanent"]
+
+    def trip_count(self, fingerprint: str) -> int:
+        return int(self._index["trips"].get(fingerprint, 0))
+
+    @property
+    def permanent_count(self) -> int:
+        return len(self._index["permanent"])
+
+    # ------------------------------------------------------------- record
+
+    def record_trip(self, fingerprint: str, step: int, flags: int,
+                    kinds: List[str], batch: Optional[Dict]) -> bool:
+        """Account one sentinel trip against `fingerprint`; write the
+        payload + meta on first sight. Returns True when the batch just
+        crossed ``max_batch_trips`` and is now PERMANENTLY quarantined."""
+        trips = self._index["trips"]
+        trips[fingerprint] = int(trips.get(fingerprint, 0)) + 1  # noqa: DRT002 — JSON-index int at rollback cadence
+        payload = os.path.join(self.dir, f"batch-{fingerprint}.npz")
+        if batch is not None and not os.path.exists(payload):
+            try:
+                np.savez(payload,
+                         **{k: np.asarray(v) for k, v in batch.items()})  # noqa: DRT002 — rollback-cadence dead-letter write of a HOST batch, never the step path
+            except OSError:
+                pass  # forensics are best-effort; the quarantine is not
+        meta = {
+            "fingerprint": fingerprint,
+            "step": int(step),  # noqa: DRT002 — host ints at rollback cadence
+            "flags": int(flags),  # noqa: DRT002 — host ints at rollback cadence
+            "kinds": list(kinds),
+            "trips": trips[fingerprint],
+        }
+        try:
+            with open(os.path.join(
+                    self.dir, f"batch-{fingerprint}.json"), "w") as f:
+                json.dump(meta, f)
+        except OSError:
+            pass
+        newly_permanent = (
+            trips[fingerprint] >= self.max_batch_trips
+            and fingerprint not in self._index["permanent"]
+        )
+        if newly_permanent:
+            self._index["permanent"].append(fingerprint)
+        self._commit()
+        return newly_permanent
+
+    def _commit(self) -> None:
+        path = os.path.join(self.dir, self.INDEX)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._index, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -1,0 +1,88 @@
+"""Row hygiene: maintain()-cadence anomaly eviction.
+
+The step sentinel bounds the rows a SINGLE dispatch writes; this pass
+catches slow contamination — a hot poisoned id whose row drifts to an
+absurd norm over many small steps between checkpoints. At maintain()
+cadence (host-side, never the hot path) every occupied row's L2 norm is
+compared against ``factor ×`` the occupied-population quantile; rows
+past the bound are dropped via the table's rebuild (probe chains heal,
+optimizer slots restart at their init value) so the key re-initializes
+on next sight instead of serving garbage. Non-finite rows always count
+as anomalous regardless of the quantile.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def anomalous_row_mask(table, ts, quantile: float,
+                       factor: float) -> jnp.ndarray:
+    """[C] bool — occupied rows whose L2 norm exceeds ``factor ×`` the
+    occupied-norm ``quantile``, or is non-finite. Device-side; O(C·D)
+    read, maintain cadence only."""
+    from deeprec_tpu.ops.packed import unpack_array
+
+    vals = unpack_array(ts.values, ts.capacity).astype(jnp.float32)
+    norm = jnp.sqrt(jnp.sum(jnp.square(vals), axis=1))
+    occ = table.occupied(ts)
+    bad_finite = occ & ~jnp.isfinite(norm)
+    # quantile over the occupied population only: empty slots are all-zero
+    # rows and would drag the bound to ~0 on a sparse table
+    pop = jnp.where(occ, norm, jnp.nan)
+    q = jnp.nanquantile(pop, jnp.float32(quantile))
+    bound = jnp.where(jnp.isfinite(q), q * jnp.float32(factor), jnp.inf)
+    return bad_finite | (occ & jnp.isfinite(norm) & (norm > bound))
+
+
+def anomaly_evict(table, ts, quantile: float, factor: float,
+                  slot_fills) -> Tuple[object, int]:
+    """Re-initialize anomalous rows of one LOCAL table state. Returns
+    (new_state, evicted_count); a zero count returns the input state
+    untouched (no rebuild paid)."""
+    mask = anomalous_row_mask(table, ts, quantile, factor)
+    n = int(jnp.sum(mask))
+    if n == 0:
+        return ts, 0
+    return table.rebuild(ts, keep=~mask, slot_fills=slot_fills), n
+
+
+def touched_row_norms(table, values, slot_ix) -> jnp.ndarray:
+    """[U] L2 norms of the rows `slot_ix` addresses (invalid ix -> 0) —
+    the per-step sentinel's post-apply read of exactly the rows this
+    dispatch updated, through the table's packed-layout-aware gather."""
+    safe = jnp.where(slot_ix >= 0, slot_ix, 0)
+    rows = table._gather(values, safe, _capacity_of(values, table))
+    rows = rows.astype(jnp.float32)
+    n = jnp.sqrt(jnp.sum(jnp.square(rows), axis=-1))
+    return jnp.where(slot_ix >= 0, n, 0.0)
+
+
+def _capacity_of(values, table) -> int:
+    """Logical capacity of a (possibly packed) values array: rows × pack
+    factor — values is [C // P, P * D]."""
+    rows, width = values.shape[-2], values.shape[-1]
+    return rows * (width // table.cfg.dim)
+
+
+def clamp_rows(table, values, slot_ix, norms, clamp: float,
+               seed) -> jnp.ndarray:
+    """Rescale rows past `clamp` L2 down onto the bound (non-finite
+    norms clamp to zero-scale — a NaN row cannot be rescued by
+    scaling). Writes only the offending rows; everything else is
+    untouched, preserving the bit-exact no-op contract when nothing
+    exceeds the bound."""
+    safe = jnp.where(slot_ix >= 0, slot_ix, 0)
+    rows = table._gather(values, safe, _capacity_of(values, table))
+    rows = rows.astype(jnp.float32)
+    finite = jnp.isfinite(norms) & jnp.all(jnp.isfinite(rows), axis=-1)
+    scale = jnp.where(
+        finite, jnp.float32(clamp) / jnp.maximum(norms, 1e-30), 0.0
+    )
+    over = (slot_ix >= 0) & (~finite | (norms > jnp.float32(clamp)))
+    new_rows = (rows * scale[..., None]).astype(values.dtype)
+    return table._scatter(
+        values, jnp.where(over, slot_ix, -1), new_rows,
+        _capacity_of(values, table), seed=seed,
+    )

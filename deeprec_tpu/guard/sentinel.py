@@ -1,0 +1,160 @@
+"""On-device step sentinel: per-dispatch model-quality flags.
+
+The checks run INSIDE the jitted train step (and inside the K-step
+scan's body), so XLA fuses them with the step's own reductions; the
+result is packed into ONE int32 bitmask scalar per step. The loop reads
+the scalar of the PREVIOUS dispatch (by then already materialized —
+reading it costs no pipeline stall), which is where the "detected ≤ 1
+dispatch after injection" contract of ``tools/bench_guard.py`` comes
+from. No check ever modifies the update math: with the sentinel ON and
+untripped, training is bit-identical to sentinel OFF
+(tests/test_guard.py pins this on table ints and values).
+
+State that must persist across dispatches — the loss EMA the spike
+check compares against — rides OUTSIDE TrainState in a tiny guard
+carry ``{"ema": f32[]}`` threaded through ``Trainer.train_step(...,
+guard=)`` and the scan carry of ``train_steps``; the updated EMA
+returns in the metrics dict (``mets["guard_ema"]``) so the caller hands
+it to the next dispatch without ever pulling it to the host.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+# Flag bits of the packed int32 sentinel scalar. Bounded set — these
+# names are also the `kind=` label values of deeprec_guard_trips_total.
+FLAG_NONFINITE_LOSS = 1
+FLAG_NONFINITE_GRAD = 2
+FLAG_GRAD_NORM = 4
+FLAG_LOSS_SPIKE = 8
+FLAG_ROW_NORM = 16
+
+FLAG_KINDS = (
+    (FLAG_NONFINITE_LOSS, "nonfinite_loss"),
+    (FLAG_NONFINITE_GRAD, "nonfinite_grad"),
+    (FLAG_GRAD_NORM, "grad_norm"),
+    (FLAG_LOSS_SPIKE, "loss_spike"),
+    (FLAG_ROW_NORM, "row_norm"),
+)
+
+
+def flag_kinds(flags: int) -> List[str]:
+    """Decode a host-read flags scalar into its tripped kind names."""
+    return [name for bit, name in FLAG_KINDS if flags & bit]
+
+
+@dataclasses.dataclass(frozen=True)
+class SentinelConfig:
+    """Thresholds of the on-device step sentinel.
+
+    Non-finite loss/grad checks are always on. ``spike_ratio`` trips
+    when the step loss exceeds ``spike_ratio ×`` the running EMA of
+    clean-step losses (the EMA never learns from a tripped step, so a
+    poison burst cannot drag the baseline up). ``grad_norm_max`` bounds
+    the global L2 norm over dense AND embedding grads.
+    ``row_norm_max`` bounds the max L2 norm of the table rows THIS step
+    updated (only touched rows are gathered — never a full-table scan
+    on the hot path). ``row_clamp_norm`` additionally rescales updated
+    rows down to that L2 norm (row hygiene: changes the math, off by
+    default). ``row_evict_quantile``/``row_evict_factor`` configure the
+    maintain()-cadence anomaly eviction: occupied rows whose norm
+    exceeds ``factor ×`` the occupied-norm quantile are re-initialized.
+    Pick a MID quantile (0.9 is the intended shape) — an extreme
+    quantile (0.999+) is dominated by the anomalous rows themselves and
+    inflates its own bound out of reach.
+    """
+
+    spike_ratio: float = 4.0
+    ema_decay: float = 0.9
+    grad_norm_max: Optional[float] = None
+    row_norm_max: Optional[float] = None
+    row_clamp_norm: Optional[float] = None
+    row_evict_quantile: Optional[float] = None
+    row_evict_factor: float = 8.0
+
+
+def guard_init() -> Dict[str, jnp.ndarray]:
+    """Fresh guard carry: EMA < 0 means unseeded (first clean step
+    seeds it with its own loss; the spike check stays off until then)."""
+    return {"ema": jnp.full((), -1.0, jnp.float32)}
+
+
+def _tree_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every float leaf of `tree` is finite."""
+    import jax
+
+    ok = jnp.asarray(True)
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def grad_observations(g_dense, g_embs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(grads_finite bool[], grad_norm_sq f32[]) over dense + embedding
+    grads — one fused reduction tree, no host value."""
+    import jax
+
+    finite = _tree_finite(g_dense) & _tree_finite(g_embs)
+    sq = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves((g_dense, g_embs)):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            sq = sq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return finite, sq
+
+
+def step_flags(
+    cfg: SentinelConfig,
+    loss: jnp.ndarray,
+    grads_finite: jnp.ndarray,
+    grad_norm_sq: jnp.ndarray,
+    row_norm_max: Optional[jnp.ndarray],
+    guard: Dict[str, jnp.ndarray],
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Fold one step's observations into (flags int32[], new guard).
+
+    The EMA only advances on untripped steps; flags is the OR of every
+    tripped check, so the host decodes WHAT tripped from the one scalar
+    it reads per dispatch."""
+    loss = jnp.asarray(loss, jnp.float32)
+    ema = guard["ema"]
+    flags = jnp.zeros((), jnp.int32)
+    loss_ok = jnp.isfinite(loss)
+    flags = flags | jnp.where(loss_ok, 0, FLAG_NONFINITE_LOSS)
+    flags = flags | jnp.where(grads_finite, 0, FLAG_NONFINITE_GRAD)
+    if cfg.grad_norm_max is not None:
+        bound = jnp.float32(cfg.grad_norm_max) ** 2
+        # A non-finite norm must not dodge the bound check via NaN
+        # comparison semantics — the nonfinite-grad bit already fires.
+        flags = flags | jnp.where(grad_norm_sq > bound, FLAG_GRAD_NORM, 0)
+    spike = (ema > 0) & loss_ok & (loss > jnp.float32(cfg.spike_ratio) * ema)
+    flags = flags | jnp.where(spike, FLAG_LOSS_SPIKE, 0)
+    if row_norm_max is not None and cfg.row_norm_max is not None:
+        flags = flags | jnp.where(
+            ~jnp.isfinite(row_norm_max)
+            | (row_norm_max > jnp.float32(cfg.row_norm_max)),
+            FLAG_ROW_NORM, 0,
+        )
+    clean = flags == 0
+    decay = jnp.float32(cfg.ema_decay)
+    new_ema = jnp.where(
+        clean,
+        jnp.where(ema < 0, loss, decay * ema + (1.0 - decay) * loss),
+        ema,
+    )
+    return flags, {"ema": new_ema}
+
+
+def guard_carry(mets: Dict) -> Optional[Dict[str, jnp.ndarray]]:
+    """Rebuild the guard carry for the NEXT dispatch from a step's
+    metrics (device references only — nothing is read to the host).
+    K-step scans stack metric leaves [K]; the last entry is the carry."""
+    ema = mets.get("guard_ema")
+    if ema is None:
+        return None
+    if getattr(ema, "ndim", 0):
+        ema = ema[-1]
+    return {"ema": ema}

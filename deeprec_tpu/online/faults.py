@@ -179,6 +179,105 @@ def sigkill_fleet_member(proc: subprocess.Popen, wait: float = 30.0) -> int:
     return sigkill(proc, wait=wait)
 
 
+# --------------------------------------------------------- data poison
+#
+# The semantic-fault injector set (guard/ firewall, docs/fault-tolerance.md
+# "Semantic faults"): unlike every fault above, nothing crashes — the
+# process stays healthy while the DATA (or the optimizer schedule) poisons
+# the model. Driven by tools/bench_guard.py and tests/test_guard.py.
+
+
+def poison_batch(batch, mode: str, magnitude: float = 1e30,
+                 seed: int = 0) -> dict:
+    """Return a poisoned copy of `batch`:
+
+      * ``nan``        — every dense feature value becomes NaN (a
+        corrupt upstream join / log-shipper bug);
+      * ``extreme``    — dense features take ±`magnitude` (unit bugs,
+        overflowed counters);
+      * ``label_flip`` — labels invert (a polarity bug in the label
+        pipeline: gradients are confidently wrong, loss spikes while
+        every value stays finite — the case only the loss-spike EMA
+        catches).
+    """
+    import numpy as np
+
+    out = {k: np.array(v, copy=True) for k, v in batch.items()}
+    rng = np.random.default_rng(seed)
+    if mode == "nan":
+        for k, v in out.items():
+            if not k.startswith("label") and np.issubdtype(
+                    v.dtype, np.floating):
+                out[k] = np.full_like(v, np.nan)
+    elif mode == "extreme":
+        for k, v in out.items():
+            if not k.startswith("label") and np.issubdtype(
+                    v.dtype, np.floating):
+                out[k] = np.where(rng.random(v.shape) < 0.5,
+                                  magnitude, -magnitude).astype(v.dtype)
+    elif mode == "label_flip":
+        for k, v in out.items():
+            if k.startswith("label"):
+                out[k] = (1.0 - v).astype(v.dtype)
+    else:
+        raise ValueError(f"unknown poison mode {mode!r}")
+    return out
+
+
+class PoisonInjector:
+    """Wrap a batch iterable, poisoning chosen deliveries.
+
+    ``plan`` maps 1-based delivery index -> poison mode; ``repeat_from``
+    (optional) replays the LAST poisoned batch verbatim on every later
+    delivery whose index is in ``repeat_at`` — the stream-replay shape
+    that drives a batch across R rollbacks into permanent quarantine.
+    ``injected`` records (index, mode, fingerprint) for the bench's
+    detection-latency ledger."""
+
+    def __init__(self, source, plan: dict, repeat_at=()):
+        from deeprec_tpu.guard.quarantine import batch_fingerprint
+
+        self._fp = batch_fingerprint
+        self.source = source
+        self.plan = dict(plan)
+        self.repeat_at = set(repeat_at)
+        self.injected = []  # [(delivery index, mode, fingerprint)]
+        self._last_poisoned = None
+
+    def __iter__(self):
+        i = 0
+        for batch in self.source:
+            i += 1
+            if i in self.repeat_at and self._last_poisoned is not None:
+                out = self._last_poisoned
+                self.injected.append((i, "repeat", self._fp(out)))
+                yield out
+                continue
+            mode = self.plan.get(i)
+            if mode is not None:
+                out = poison_batch(batch, mode, seed=i)
+                self._last_poisoned = out
+                self.injected.append((i, mode, self._fp(out)))
+                yield out
+            else:
+                yield batch
+
+
+def exploding_lr(base_lr: float, start: int, length: int,
+                 factor: float = 1e6) -> Callable[[int], float]:
+    """TrainLoop(lr_fn=...) injector: a runaway learning-rate window —
+    steps in [start, start+length) train at ``base_lr * factor`` (a bad
+    schedule push / config typo). The data is clean; only the sentinel's
+    grad/row-norm and non-finite checks can see the damage."""
+
+    def lr_fn(step: int) -> float:
+        if start <= step < start + length:
+            return base_lr * factor
+        return base_lr
+
+    return lr_fn
+
+
 # --------------------------------------------------------- broker outage
 
 

@@ -34,6 +34,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Iterable, Optional
 
 from deeprec_tpu.obs import metrics as obs_metrics
@@ -69,10 +70,49 @@ class TrainLoop:
         on_step: Optional[Callable[[int], None]] = None,
         log_every: int = 0,
         reader=None,
+        guard=None,
+        lr_fn: Optional[Callable[[int], float]] = None,
     ):
         self.trainer = trainer
         self.ckpt = ckpt
         self.batches = batches
+        # Model-quality firewall (guard/): `guard` is a GuardPolicy and
+        # requires the trainer to carry a step sentinel — the loop reads
+        # the sentinel's one-dispatch-old flags scalar each step, rolls
+        # back to the last verified checkpoint on a trip, dead-letters
+        # the poisoned batch, and permanently quarantines repeat
+        # offenders. `lr_fn(step)` optionally overrides the lr per step
+        # (schedules, and the exploding-LR fault injector).
+        self.guard = guard
+        self.lr_fn = lr_fn
+        self.dead_letter = None
+        if guard is not None:
+            if trainer is not None and getattr(trainer, "sentinel",
+                                               None) is None:
+                raise ValueError(
+                    "TrainLoop(guard=) requires Trainer(sentinel="
+                    "SentinelConfig(...)) — the rollback policy consumes "
+                    "the on-device sentinel's flags"
+                )
+            from deeprec_tpu.guard.quarantine import DeadLetter
+
+            self.dead_letter = DeadLetter(
+                guard.dead_letter_dir, guard.max_batch_trips
+            )
+        self.guard_trips = 0
+        self.rollbacks = 0
+        self.batches_skipped = 0
+        self.replay_gaps = 0
+        # [(bad_step, detect_step, flags, kinds, fingerprint)] — the
+        # detection ledger tools/bench_guard.py matches injections
+        # against (detect_step - bad_step is the latency in dispatches;
+        # ≤ 1 by construction of the deferred flags read).
+        self.trip_log: list = []
+        self.last_rollback_ms: Optional[float] = None
+        self.last_verified_step: Optional[int] = None
+        self._guard_carry = None
+        self._pending = None  # (step, batch, fingerprint, flags device ref)
+        self._replay_buf: deque = deque()
         if heartbeat is None:
             # Supervisor contract (launch.py supervise_worker): a spawned
             # worker finds its lease file in DEEPREC_HEARTBEAT_FILE —
@@ -112,6 +152,18 @@ class TrainLoop:
             "deeprec_train_saves", "cadence checkpoint saves")
         self._m_save_failures = reg.counter(
             "deeprec_train_save_failures", "cadence saves that failed")
+        self._reg = reg
+        if guard is not None:
+            self._m_rollbacks = reg.counter(
+                "deeprec_guard_rollbacks",
+                "sentinel-tripped rollbacks to the last verified "
+                "checkpoint")
+            self._m_quarantined = reg.counter(
+                "deeprec_guard_batches_quarantined",
+                "batches permanently quarantined after repeated trips")
+            self._m_last_verified = reg.gauge(
+                "deeprec_guard_last_verified_step",
+                "newest step whose sentinel flags read clean")
         # Whether the chain has (or will durably have — an async full may
         # still be in flight) an anchor; checking latest_full() alone
         # would race the background writer and over-anchor.
@@ -130,6 +182,14 @@ class TrainLoop:
             "saves": self.saves,
             "save_failures": self.save_failures,
         }
+        if self.guard is not None:
+            # The guard-trip field the Supervisor reads to distinguish
+            # "restart fixes it" from "the data poisons it" (a restart
+            # budget cannot — replay hits the same poison forever).
+            extra["guard_trips"] = self.guard_trips
+            extra["rollbacks"] = self.rollbacks
+            extra["batches_quarantined"] = self.dead_letter.permanent_count
+            extra["last_verified_step"] = self.last_verified_step
         if self.reader is not None:
             extra["stream_connect_failures"] = getattr(
                 self.reader, "consecutive_connect_failures", 0
@@ -203,14 +263,204 @@ class TrainLoop:
             self._print(f"SAVE_FAILED {step}")
         return state
 
+    # ----------------------------------------------- model-quality firewall
+
+    def _train_one(self, state, batch, next_step: int):
+        """One dispatched train step, with the lr schedule and the
+        sentinel carry threaded through (device references only)."""
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        kw = {}
+        if self.lr_fn is not None:
+            kw["lr"] = self.lr_fn(next_step)
+        if self.guard is not None:
+            kw["guard"] = self._guard_carry
+        state, mets = self.trainer.train_step(state, jb, **kw)
+        if self.guard is not None:
+            from deeprec_tpu.guard.sentinel import guard_carry
+
+            self._guard_carry = guard_carry(mets)
+        return state, mets
+
+    def _remember(self, step: int, batch, fp: str) -> None:
+        """Append to the bounded replay buffer rollbacks resume from."""
+        self._replay_buf.append((step, batch, fp))
+        while len(self._replay_buf) > self.guard.replay_window:
+            self._replay_buf.popleft()
+
+    def _guard_check(self, state, step: int, batch, fp: str, mets):
+        """Deferred sentinel read: park THIS step's flags, read the
+        PREVIOUS dispatch's — by now materialized on the host side of an
+        already-retired dispatch, so the read never stalls the pipeline
+        (detection latency: exactly one dispatch). Returns the possibly
+        rolled-back (state, step)."""
+        import numpy as np
+
+        prev, self._pending = (
+            self._pending, (step, batch, fp, mets["guard_flags"])
+        )
+        if prev is None:
+            return state, step
+        t, b_t, fp_t, fl = prev
+        flags = int(np.asarray(fl))  # noqa: DRT002 — ONE-DISPATCH-OLD scalar: its dispatch retired while the current one was enqueued, so this read is a materialized-value copy, not a pipeline stall (the sentinel's documented read contract)
+        if flags == 0:
+            self.last_verified_step = t
+            if self.guard is not None:
+                self._m_last_verified.set(t)
+            return state, step
+        return self._guard_rollback(state, step, t, b_t, fp_t, flags)
+
+    def _guard_flush(self, state, step: int):
+        """Drain the deferred check at a loop boundary (end of stream,
+        max_steps): the final dispatch's flags must be read before the
+        final save can be trusted."""
+        import numpy as np
+
+        prev, self._pending = self._pending, None
+        if prev is None:
+            return state, step
+        t, b_t, fp_t, fl = prev
+        flags = int(np.asarray(fl))  # noqa: DRT002 — loop-boundary drain, once per run
+        if flags == 0:
+            self.last_verified_step = t
+            self._m_last_verified.set(t)
+            return state, step
+        return self._guard_rollback(state, step, t, b_t, fp_t, flags)
+
+    def _record_trip(self, fp: str, step: int, flags: int, batch,
+                     detect_step: Optional[int] = None) -> None:
+        from deeprec_tpu.guard.sentinel import flag_kinds
+
+        kinds = flag_kinds(flags)
+        self.trip_log.append(
+            (step, detect_step if detect_step is not None else step,
+             flags, kinds, fp)
+        )
+        self.guard_trips += 1
+        for kind in kinds:  # bounded label set: the five sentinel bits
+            self._reg.counter(
+                "deeprec_guard_trips",
+                "step-sentinel trips by tripped check", {"kind": kind},
+            ).inc()
+        permanent = self.dead_letter.record_trip(fp, step, flags, kinds,
+                                                 batch)
+        self._print(f"GUARD_TRIP {step} {flags} {','.join(kinds)}")
+        if permanent:
+            self._m_quarantined.inc()
+            self._print(f"GUARD_QUARANTINE {fp}")
+        _log.warning("guard: sentinel tripped at step %d (%s)%s", step,
+                     ",".join(kinds),
+                     " — batch permanently quarantined" if permanent else "")
+
+    def _restore_verified(self):
+        """Restore the chain tip (valid_chain semantics); a chain with
+        nothing left restarts from step 0 — loud, never wedged.
+
+        MODEL state only: `CheckpointManager.restore` also rewinds any
+        registered dataset readers to the checkpoint's positions, but the
+        rollback replays its window from the in-memory buffer — a
+        rewound reader would re-deliver the same batches and the window
+        would train TWICE (and a TCP reader's offset would undercount,
+        replaying trained data across the next reconnect). Reader
+        positions are pinned across the restore so the live stream
+        resumes exactly where it was."""
+        self.rollbacks += 1
+        self._m_rollbacks.inc()
+        # Detach registered readers for the duration: restore() must not
+        # touch their positions at all (not even transiently — a reader
+        # polling from another thread could read the rewound offset).
+        readers = self.ckpt.datasets
+        self.ckpt.datasets = {}
+        try:
+            return self.ckpt.restore()
+        except FileNotFoundError:
+            _log.warning("guard: no intact checkpoint predates the poison "
+                         "— restarting from a fresh init")
+            return self.trainer.init(0)
+        finally:
+            self.ckpt.datasets = readers
+
+    def _guard_rollback(self, state, step: int, bad_step: int, bad_batch,
+                        bad_fp: str, flags: int):
+        """The semantic-fault recovery: dead-letter the batch, drop every
+        chain link that may carry its update, restore the last verified
+        checkpoint, and replay the buffered non-poisoned window — the
+        result is bit-identical to a clean run minus the skipped batch
+        (tests/test_guard.py pins it on table contents)."""
+        import numpy as np
+
+        t0 = time.perf_counter()
+        self._record_trip(bad_fp, bad_step, flags, bad_batch,
+                          detect_step=step)
+        self._pending = None
+        self._guard_carry = None
+        # Saves at or past the poisoned step captured poisoned state —
+        # quarantine them (PR 7 rename discipline; _effective_kind then
+        # escalates the next save to full, re-anchoring the chain).
+        try:
+            self.ckpt.wait()
+        except RuntimeError:
+            pass  # a lost async save is already escalated to full
+        for kind in ("full", "incr"):
+            for s in self.ckpt._list(kind):
+                if s >= bad_step:
+                    self.ckpt.quarantine(
+                        os.path.join(self.ckpt.dir, f"{kind}-{s}"),
+                        f"guard rollback past poisoned step {bad_step}",
+                    )
+        self._anchored = self.ckpt.latest_full() is not None
+        state = self._restore_verified()
+        s0 = int(state.step)  # noqa: DRT002 — rollback cadence, not the step loop
+        # Replay the buffered window minus the poisoned batch. A tripped
+        # REPLAYED batch is dead-lettered, dropped from the queue, and
+        # the pass restarts from the same restored anchor (no saves run
+        # during replay, so the anchor is stable); the queue shrinks by
+        # one per trip, so this terminates.
+        queue = [(b, f) for (s, b, f) in self._replay_buf
+                 if s0 < s <= step and s != bad_step]
+        expect = max(
+            0, step - s0 - (1 if s0 < bad_step <= step else 0)
+        )
+        if len(queue) < expect:
+            self.replay_gaps += 1
+            _log.warning(
+                "guard: replay buffer covers %d of %d rolled-back steps "
+                "(GuardPolicy.replay_window too small for the save "
+                "cadence) — resuming with a gap", len(queue), expect)
+        while True:
+            tripped = False
+            cur = int(state.step)  # noqa: DRT002 — rollback cadence, not the step loop
+            self._guard_carry = None
+            for qi, (b, f) in enumerate(queue):
+                state, mets = self._train_one(state, b, cur + 1)
+                cur += 1
+                fl = int(np.asarray(mets["guard_flags"]))  # noqa: DRT002 — replay is the cold recovery path: synchronous checks ARE the point here
+                if fl:
+                    self._record_trip(f, cur, fl, b)
+                    queue = queue[:qi] + queue[qi + 1:]
+                    state = self._restore_verified()
+                    tripped = True
+                    break
+            if not tripped:
+                break
+        new_step = int(state.step)  # noqa: DRT002 — rollback cadence, not the step loop
+        self._replay_buf = deque(
+            (s0 + i + 1, b, f) for i, (b, f) in enumerate(queue)
+        )
+        self.last_rollback_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self.last_verified_step = new_step
+        self._m_last_verified.set(new_step)
+        self._print(f"GUARD_ROLLBACK {bad_step} -> {new_step}")
+        self._beat(new_step, status="degraded")
+        return state, new_step
+
     # ---------------------------------------------------------------- run
 
     def run(self, state=None):
         """Returns (final_state, exit_code): 0 done, EXIT_RESCALE when a
         scaling plan was acked (caller exits with it; the supervisor
         respawns the new generation)."""
-        import jax.numpy as jnp
-
         if state is None:
             state = self.restore_or_init()
         # Host-side step mirror: train_step advances the device counter by
@@ -221,14 +471,29 @@ class TrainLoop:
         # the next, forfeiting the async-dispatch overlap.
         step = int(state.step)
         self._beat(step, status="running")
+        guard_on = self.guard is not None
         for batch in self.batches:
             if self.max_steps is not None and step >= self.max_steps:
                 break  # a resumed worker may already be at the target
-            state, mets = self.trainer.train_step(
-                state, {k: jnp.asarray(v) for k, v in batch.items()}
-            )
+            fp = None
+            if guard_on:
+                from deeprec_tpu.guard.quarantine import batch_fingerprint
+
+                fp = batch_fingerprint(batch)
+                if self.dead_letter.is_quarantined(fp):
+                    # The crash-loop breaker: a permanently quarantined
+                    # batch never reaches the trainer again, across any
+                    # number of restarts and stream replays.
+                    self.batches_skipped += 1
+                    self._print(f"GUARD_SKIP {fp}")
+                    continue
+            state, mets = self._train_one(state, batch, step + 1)
             step += 1
             self._m_steps.inc()
+            if guard_on:
+                self._remember(step, batch, fp)
+                state, step = self._guard_check(state, step, batch, fp,
+                                                mets)
             if self.log_every and step % self.log_every == 0:
                 self._print(f"STEP {step} {float(mets['loss']):.5f}")  # noqa: DRT002 — log-cadence-gated sync, deliberate
             if step % self.save_every == 0:
@@ -254,6 +519,10 @@ class TrainLoop:
                 self.on_step(step)
             if self.max_steps is not None and step >= self.max_steps:
                 break
+        if guard_on:
+            # The final dispatch's flags are still pending — read them
+            # before trusting the final save with its state.
+            state, step = self._guard_flush(state, step)
         # Drain the writer and flush rows dirtied since the last cadence
         # save, so a clean exit leaves a chain as fresh as training got.
         try:
@@ -323,6 +592,7 @@ class ServeLoop:
         stores: Optional[Dict] = None,
         max_backoff_secs: float = 10.0,
         wait_for_checkpoint_secs: float = 0.0,
+        quality_gate=None,
     ):
         from deeprec_tpu.serving.http_server import HttpServer
         from deeprec_tpu.serving.predictor import ModelServer, Predictor
@@ -330,7 +600,7 @@ class ServeLoop:
         if wait_for_checkpoint_secs > 0:
             wait_for_full_checkpoint(ckpt_dir, wait_for_checkpoint_secs)
         self.predictor = Predictor(model, ckpt_dir, stores=stores,
-                                   device=device)
+                                   device=device, quality_gate=quality_gate)
         self.server = ModelServer(self.predictor, max_batch=max_batch,
                                   max_wait_ms=max_wait_ms)
         self.http = None

@@ -427,8 +427,10 @@ class Supervisor:
         out = {}
         for name, st in list(self._states.items()):
             spec = specs.get(name)
-            hb_age = (Heartbeat.age(spec.heartbeat_path)
-                      if spec is not None and spec.heartbeat_path else None)
+            hb = (Heartbeat.read(spec.heartbeat_path)
+                  if spec is not None and spec.heartbeat_path else None)
+            hb_age = (max(0.0, _now() - hb["time"])
+                      if hb and "time" in hb else None)
             out[name] = {
                 "restarts": st.restarts,
                 "wedge_kills": st.wedge_kills,
@@ -444,6 +446,17 @@ class Supervisor:
                     max(0, spec.max_restarts - st.consecutive_failures)
                     if spec is not None else None),
             }
+            if hb is not None and "guard_trips" in hb:
+                # Quality-firewall view (TrainLoop guard heartbeat
+                # fields): a worker with restarts AND rising guard_trips
+                # is poisoned by its DATA — a restart budget cannot fix
+                # that; the permanent batch quarantine does.
+                out[name]["guard_trips"] = hb.get("guard_trips")
+                out[name]["rollbacks"] = hb.get("rollbacks")
+                out[name]["batches_quarantined"] = hb.get(
+                    "batches_quarantined")
+                out[name]["last_verified_step"] = hb.get(
+                    "last_verified_step")
             if reg is not None:
                 lab = {"worker": name}
                 reg.gauge("deeprec_supervisor_restarts",

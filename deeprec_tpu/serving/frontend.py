@@ -1034,6 +1034,12 @@ class Frontend:
         out["members"] = len(members)
         out["reachable"] = reachable
         out["draining"] = sum(1 for m in members if m.draining)
+        # Quality-firewall rollup: gate rejections SUM across the fleet
+        # (the worst-member dict above already carries that member's own
+        # degraded_reason when its gate is holding freshness back).
+        qg = [h.get("quality_gate_rejections") for h in healths]
+        if any(v is not None for v in qg):
+            out["quality_gate_rejections"] = sum(int(v or 0) for v in qg)  # noqa: DRT002 — summing JSON ints from member health bodies, host-side
         if reachable < len(members):
             out["status"] = "degraded" if reachable else "down"
         return out

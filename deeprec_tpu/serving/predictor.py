@@ -81,7 +81,15 @@ def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]
     Rules: id features pad/trim ragged bags to the feature's declared
     max_len with its pad value (one compiled shape per feature, not one per
     organic list length); dense features become [B, W] float32; all
-    features must agree on the row count."""
+    features must agree on the row count.
+
+    Firewall rules (guard/ — malformed input must never reach the
+    model): non-finite dense values REJECT the request (the client sent
+    NaN/inf — scoring it would serve garbage stamped with a healthy
+    model version); negative ids other than the pad value CLAMP to pad
+    (treated as missing — id spaces are non-negative by construction,
+    so a negative id is an upstream encoding bug, not a key). Both are
+    counted per-feature into ``predictor.record_errors``."""
     if not isinstance(feats, dict) or not feats:
         raise BadRequest("missing 'features' object")
     dtypes = predictor.feature_dtypes
@@ -99,6 +107,9 @@ def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]
                 f = specs[k]
                 L = f.max_len
                 if L and isinstance(v, list) and v and isinstance(v[0], list):
+                    over = sum(max(0, len(r) - L) for r in v)
+                    if over:  # bag ids past max_len are dropped, counted
+                        predictor.count_record_error("oversized_bag", over)
                     arr = pad_ragged(v, L, f.pad_value, want)
                 else:
                     arr = np.asarray(v).astype(want)
@@ -122,6 +133,20 @@ def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]
             # CLIENT's fault, so surface it as a request error, not a crash
             raise BadRequest(f"feature {k!r}: cannot coerce to {want}: {e}",
                              feature=k) from e
+        if want.kind in "iu":
+            f = specs[k]
+            bad = (arr < 0) & (arr != f.pad_value)
+            if bad.any():
+                predictor.count_record_error("bad_id", int(bad.sum()))
+                arr = np.where(bad, np.asarray(f.pad_value, arr.dtype), arr)
+        else:
+            nf = ~np.isfinite(arr)
+            if nf.any():
+                predictor.count_record_error("nonfinite_float",
+                                             int(nf.sum()))
+                raise BadRequest(
+                    f"feature {k!r}: {int(nf.sum())} non-finite value(s)",
+                    feature=k)
         batch[k] = arr
     rows = {k: a.shape[0] for k, a in batch.items()}
     if len(set(rows.values())) > 1:
@@ -199,7 +224,8 @@ class Predictor:
     }
 
     def __init__(self, model, ckpt_dir: str, stores: Optional[Dict] = None,
-                 device=None, restore_chunk="auto", quantize=None):
+                 device=None, restore_chunk="auto", quantize=None,
+                 quality_gate=None):
         self.model = model
         # Serving needs no optimizer; slot-less sparse opt keeps restore lean
         # (checkpointed slot arrays are skipped when the template has none).
@@ -269,6 +295,10 @@ class Predictor:
         # and tools/bench_freshness.py pins it against its own
         # probe-measured freshness lag.
         self.last_apply_lag_seconds: Optional[float] = None
+        # Per-record input-error counters (parse_features firewall:
+        # clamped bad ids, rejected non-finite dense) — mirrored into
+        # deeprec_record_errors{kind}; kinds are a bounded set.
+        self.record_errors: Dict[str, int] = {}
         # Test seam: called after the next state is fully built and
         # warmed, immediately before the snapshot swap — lets tests gate
         # the publish on an event (torn-read pinning) without wall-clock.
@@ -280,11 +310,28 @@ class Predictor:
         )
         self._forward_step = jax.jit(self._forward_impl)
         self._lookup_step = jax.jit(self._lookup_views)
+        # Pre-swap canary (guard/canary.py QualityGate): every update —
+        # delta replay or full reload — evaluates the gate's probe batch
+        # on the SHADOW state before the snapshot swap; a failing update
+        # is quarantined (PR 7 rename discipline) and the old snapshot
+        # keeps serving, with health() reporting degraded:quality_gate.
+        self.quality_gate = quality_gate
+        self._gate_blocked = False
+        self._m_gate_rejections = None
+        if quality_gate is not None and obs_metrics.metrics_enabled():
+            self._m_gate_rejections = obs_metrics.default_registry().counter(
+                "deeprec_quality_gate_rejections",
+                "model updates rejected by the pre-swap canary")
         self.reload()
         # Compile the delta-replay programs NOW (chunked import + prune
         # rebuild): the first poll_updates under live traffic must be
         # cache-hit dispatch, not a GIL-held trace next to requests.
         self._ck.warm_replay(self._snap.state, self._restore_chunk)
+        if quality_gate is not None:
+            # Prime the gate: compiles the probe shape once (later gate
+            # passes are cache-hit dispatch — zero steady-state compiles)
+            # and stamps the boot snapshot's predictions as reference.
+            quality_gate.set_reference(self._gate_probs(self._snap.state))
 
     # ------------------------------------------------------------- updates
 
@@ -298,9 +345,12 @@ class Predictor:
         """Monotonic model version: bumps on every published update."""
         return self._snap.version
 
-    def reload(self) -> None:
+    def reload(self) -> bool:
         """Full reload from the latest checkpoint chain (FullModelUpdate).
-        Builds the fresh state entirely off the serving path, then swaps."""
+        Builds the fresh state entirely off the serving path, gates it
+        through the pre-swap canary, then swaps. Returns whether a new
+        snapshot published (False: the quality gate rejected it and the
+        old snapshot keeps serving)."""
         with self._lock:
             # List BEFORE restoring: a delta landing mid-restore then stays
             # un-applied and is picked up by the next poll (replaying a delta
@@ -309,7 +359,57 @@ class Predictor:
             state = self._ck.restore(chunk=self._restore_chunk)
             if self._device is not None:
                 state = jax.device_put(state, self._device)
+            reason = self._gate_reason(state)
+            if reason is not None:
+                self._gate_reject(sorted(dirs - self._applied), reason)
+                return False
             self._publish(state, dirs)
+            self._gate_blocked = False
+            return True
+
+    # ----------------------------------------------- pre-swap quality gate
+
+    def _gate_probs(self, state: TrainState):
+        """Probe-batch predictions on an arbitrary state — one fixed
+        shape, compiled once at attach time (no store read-through: the
+        canary judges the MODEL, per-row store corrections don't move
+        under a delta)."""
+        jb = {k: jnp.asarray(v) for k, v in self.quality_gate.probe.items()}
+        return jax.tree.map(np.asarray, self._predict_step(state, jb))  # noqa: DRT002 — update-cadence canary eval, never the predict path
+
+    def _gate_reason(self, state: TrainState) -> Optional[str]:
+        """None when the shadow state passes the canary (its probe
+        predictions then become the next reference); else the rejection
+        reason. The gate only arms once a snapshot is serving — at boot
+        there is nothing older to keep serving."""
+        from deeprec_tpu.guard.canary import QualityGateRejected
+
+        gate = self.quality_gate
+        if gate is None or self._snap is None:
+            return None
+        probs = self._gate_probs(state)
+        try:
+            gate.check(probs)
+        except QualityGateRejected as e:
+            return e.reason
+        gate.set_reference(probs)
+        return None
+
+    def _gate_reject(self, dirnames, reason: str) -> None:
+        """Quarantine the update's dirs (rename discipline — the
+        trainer's next save re-anchors past them) and surface the
+        degraded-by-choice state: old snapshot serves, health says why."""
+        for d in dirnames:
+            self._ck.quarantine(
+                os.path.join(self._ck.dir, d), f"quality gate: {reason}")
+        self._gate_blocked = True
+        if self._m_gate_rejections is not None:
+            self._m_gate_rejections.inc()
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "quality gate rejected update (%s): quarantined %s — serving "
+            "the previous snapshot", reason, list(dirnames))
 
     def _publish(self, state: TrainState, applied: set) -> None:
         """Warm-then-swap: run the jitted predict for every registered
@@ -410,7 +510,8 @@ class Predictor:
         if not new:
             return False
         if any(d.startswith("full-") for d in new):
-            self.reload()
+            if not self.reload():
+                return False  # gate-rejected: old snapshot keeps serving
             self._stamp_apply_lag(new)
         else:
             state = self._snap.state
@@ -438,12 +539,32 @@ class Predictor:
                 return False
             if self._device is not None:
                 state = jax.device_put(state, self._device)
+            reason = self._gate_reason(state)
+            if reason is not None:
+                # The pre-swap canary failed the replayed delta(s): the
+                # shadow state is discarded, the replayed dirs leave the
+                # chain namespace, the live snapshot is untouched —
+                # freshness sacrificed by choice, visibly (health()).
+                self._gate_reject(replayed, reason)
+                return False
             self._publish(state, applied)
+            self._gate_blocked = False
             self._stamp_apply_lag(replayed)
         self.update_count += 1
         self.last_update_time = time.monotonic()
         self.last_update_ms = round((time.perf_counter() - t0) * 1e3, 3)
         return True
+
+    def count_record_error(self, kind: str, n: int = 1) -> None:
+        """Account one parse_features clamp/reject (bounded kind set —
+        the serving half of data/readers.py RecordErrors)."""
+        self.record_errors[kind] = self.record_errors.get(kind, 0) + n
+        if obs_metrics.metrics_enabled():
+            obs_metrics.default_registry().counter(
+                "deeprec_record_errors",
+                "malformed input records rejected/clamped by kind",
+                {"kind": kind},
+            ).inc(n)
 
     def health(self) -> Dict:
         """Liveness/freshness summary for watchdogs — the `/healthz` body
@@ -454,10 +575,23 @@ class Predictor:
 
         The payload is the unified obs schema (obs/schema.py) — the one
         shape the frontend sweep and the online-loop heartbeat also
-        emit; every historical key is a canonical member of it."""
+        emit; every historical key is a canonical member of it. A
+        quality-gate rejection that is still holding freshness back
+        reports ``degraded`` with ``degraded_reason: quality_gate`` —
+        stale by CHOICE, never silently."""
         now = time.monotonic()
+        status = "ok" if self.consecutive_poll_failures == 0 else "degraded"
+        extra = {}
+        if self.quality_gate is not None:
+            extra["quality_gate_rejections"] = self.quality_gate.rejections
+            if self.quality_gate.last_rejection is not None:
+                extra["last_quality_rejection"] = (
+                    self.quality_gate.last_rejection)
+            if self._gate_blocked and status == "ok":
+                status = "degraded"
+                extra["degraded_reason"] = "quality_gate"
         return obs_schema.health_payload(
-            "ok" if self.consecutive_poll_failures == 0 else "degraded",
+            status,
             model_version=self.version,
             step=self.step,
             staleness_seconds=round(now - self.last_poll_ok_time, 3),
@@ -466,6 +600,7 @@ class Predictor:
             last_good_version=self.last_good_version,
             quarantined=self._ck.quarantine_count,
             train_to_serve_lag_seconds=self.last_apply_lag_seconds,
+            **extra,
         )
 
     # ------------------------------------------------------------- predict

@@ -66,6 +66,12 @@ class PipelineCarry:
     batch: Dict[str, jnp.ndarray]  # the prefetched batch (ids/dense/labels)
     views: Dict[str, Any]  # feature -> (embeddings, inverse, mask)
     bundle_res: Dict[str, Any]  # bundle -> lookup result for the backward
+    # Step-sentinel carry ({"ema": f32[]} — guard/sentinel.py) threaded
+    # through the pipelined scan exactly like the lookahead. None (an
+    # empty pytree node) when the trainer has no sentinel, so existing
+    # carriers (parallel/async_stage.py AsyncState) are structurally
+    # unchanged.
+    guard: Any = None
 
 
 # `pipeline_mode`: how the K-step device loop schedules the embedding
@@ -191,11 +197,27 @@ class Trainer:
         unique_budget=None,
         pipeline_mode: str = "off",
         pipeline_chunks: int = 4,
+        sentinel=None,
     ):
         self.model = model
         self.sparse_opt = sparse_opt
         self.dense_opt = dense_opt or optax.adam(1e-3)
         self.grad_averaging = grad_averaging
+        # Step sentinel (guard/sentinel.py SentinelConfig): per-dispatch
+        # model-quality flags fused into the jitted step and the K-step
+        # scan body — one int32 scalar out per step, bit-exact no-op on
+        # the update math while untripped. Base Trainer only: the
+        # sharded step impls are separate programs (ShardedTrainer never
+        # forwards the kwarg).
+        if sentinel is not None:
+            from deeprec_tpu.guard.sentinel import SentinelConfig
+
+            if not isinstance(sentinel, SentinelConfig):
+                raise TypeError(
+                    "sentinel must be a guard.SentinelConfig, got "
+                    f"{type(sentinel).__name__}"
+                )
+        self.sentinel = sentinel
         # In-step pipelining of the K-step device loop (train_steps): see
         # PIPELINE_MODES. Single-device trainers gain the restructured
         # scan (route/resolve hoisted over the dense compute); sharded
@@ -620,13 +642,96 @@ class Trainer:
             mets["accuracy"] = M.accuracy(probs, batch["label"])
         else:
             mets["accuracy"] = jnp.zeros(())
+        if self.sentinel is not None:
+            with jax.named_scope("phase_sentinel"):
+                tables, mets["_sentinel"] = self._sentinel_observe(
+                    tables, bundle_res, loss, g_dense, g_embs, step
+                )
         return tables, g_dense, mets
 
-    def _step_impl(self, state: TrainState, batch, lr):
+    # ------------------------------------------------------- step sentinel
+
+    def _sentinel_observe(self, tables, bundle_res, loss, g_dense, g_embs,
+                          step):
+        """Device half of the step sentinel: fused reductions over the
+        step's loss/grads plus a post-apply gather of exactly the rows
+        this step updated (guard/rows.py — never a full-table scan).
+        Returns (tables, obs dict); tables change only under the
+        optional row clamp. Everything is a scalar reduction XLA fuses
+        with the step — no host value, no extra dispatch."""
+        from deeprec_tpu.guard import rows as guard_rows
+        from deeprec_tpu.guard import sentinel as guard_sentinel
+
+        cfg = self.sentinel
+        finite, norm_sq = guard_sentinel.grad_observations(g_dense, g_embs)
+        obs = {
+            "loss": jnp.asarray(loss, jnp.float32),
+            "grads_finite": finite,
+            "grad_norm_sq": norm_sq,
+        }
+        want_rows = (
+            cfg.row_norm_max is not None or cfg.row_clamp_norm is not None
+        ) and not hasattr(self, "num_shards")
+        if not want_rows:
+            return tables, obs
+        clamp = cfg.row_clamp_norm
+        row_max = jnp.zeros((), jnp.float32)
+        for bname, b in self.bundles.items():
+            ts = tables[bname]
+            if b.stacked:
+                six = bundle_res[bname].slot_ix  # [T, U]
+
+                def one(vals, ix, b=b):
+                    n = guard_rows.touched_row_norms(b.table, vals, ix)
+                    if clamp is not None:
+                        vals = guard_rows.clamp_rows(
+                            b.table, vals, ix, n, clamp, step
+                        )
+                    return vals, jnp.max(n)
+
+                new_vals, maxes = jax.vmap(one)(ts.values, six)
+                if clamp is not None:
+                    tables[bname] = ts = ts.replace(values=new_vals)
+                row_max = jnp.maximum(row_max, jnp.max(maxes))
+            else:
+                for f in b.features:
+                    ts = tables[bname]
+                    six = bundle_res[bname][f.name].slot_ix
+                    n = guard_rows.touched_row_norms(b.table, ts.values, six)
+                    if clamp is not None:
+                        tables[bname] = ts.replace(
+                            values=guard_rows.clamp_rows(
+                                b.table, ts.values, six, n, clamp, step
+                            )
+                        )
+                    row_max = jnp.maximum(row_max, jnp.max(n))
+        obs["row_max"] = row_max
+        return tables, obs
+
+    def _sentinel_fold(self, mets, guard):
+        """Combine a step's sentinel observations (popped from mets)
+        with the guard carry into the per-dispatch flags scalar +
+        advanced EMA, both riding out through mets."""
+        from deeprec_tpu.guard import sentinel as guard_sentinel
+
+        obs = mets.pop("_sentinel")
+        if guard is None:
+            guard = guard_sentinel.guard_init()
+        flags, guard = guard_sentinel.step_flags(
+            self.sentinel, obs["loss"], obs["grads_finite"],
+            obs["grad_norm_sq"], obs.get("row_max"), guard,
+        )
+        mets["guard_flags"] = flags
+        mets["guard_ema"] = guard["ema"]
+        return mets, guard
+
+    def _step_impl(self, state: TrainState, batch, lr, guard=None):
         step = state.step
         tables, g_dense, mets = self._micro_step(
             dict(state.tables), state.dense, batch, step, lr
         )
+        if self.sentinel is not None:
+            mets, guard = self._sentinel_fold(mets, guard)
         updates, opt_state = self.dense_opt.update(g_dense, state.opt_state,
                                                    state.dense)
         dense = optax.apply_updates(state.dense, updates)
@@ -634,7 +739,7 @@ class Trainer:
             step=step + 1, tables=tables, dense=dense, opt_state=opt_state
         ), mets
 
-    def _accum_impl(self, state: TrainState, batch, lr):
+    def _accum_impl(self, state: TrainState, batch, lr, guard=None):
         """Gradient micro-batching — the Auto-Micro-Batch analog
         (reference graph_execution_state.cc:635 PipelineGraph duplicates the
         compute graph N×; here it's a lax.scan over micro-batches): sparse
@@ -659,11 +764,25 @@ class Trainer:
         updates, opt_state = self.dense_opt.update(g_mean, state.opt_state,
                                                    state.dense)
         dense = optax.apply_updates(state.dense, updates)
+        sen = mets.pop("_sentinel", None)  # [A]-stacked micro observations
+        mets = jax.tree.map(jnp.mean, mets)
+        if self.sentinel is not None and sen is not None:
+            # The dispatch is the sentinel unit: micro-batch observations
+            # reduce to one step-level record (ANY bad micro grad poisons
+            # the step; norms take the worst micro-batch).
+            mets["_sentinel"] = {
+                "loss": jnp.mean(sen["loss"]),
+                "grads_finite": jnp.all(sen["grads_finite"]),
+                "grad_norm_sq": jnp.max(sen["grad_norm_sq"]),
+            }
+            if "row_max" in sen:
+                mets["_sentinel"]["row_max"] = jnp.max(sen["row_max"])
+            mets, guard = self._sentinel_fold(mets, guard)
         return TrainState(
             step=step + 1, tables=tables, dense=dense, opt_state=opt_state
-        ), jax.tree.map(jnp.mean, mets)
+        ), mets
 
-    def _steps_impl(self, state: TrainState, batches, lr):
+    def _steps_impl(self, state: TrainState, batches, lr, guard=None):
         """Multi-step device loop — K full train steps per dispatch.
 
         DeepRec amortizes per-step host overhead with graph-level pipeline
@@ -675,18 +794,35 @@ class Trainer:
         and every hash-table TableState — so insertion, eviction counters,
         frequency/admission and version stamping behave exactly as K
         sequential `train_step` calls (tests/test_train_steps.py pins the
-        equivalence, exact on table ints)."""
+        equivalence, exact on table ints). With a sentinel configured the
+        guard carry (loss EMA) rides the scan carry and the per-step
+        flags stack [K] in the metrics — the host still reads ONE array
+        per dispatch."""
         if self.pipeline_mode != "off":
-            return self._steps_pipelined(state, batches, lr)
+            return self._steps_pipelined(state, batches, lr, guard)
+        if self.sentinel is None:
 
-        def body(state, batch):
-            return self._step_impl(state, batch, lr)
+            def body(state, batch):
+                return self._step_impl(state, batch, lr)
 
-        return jax.lax.scan(body, state, batches)
+            return jax.lax.scan(body, state, batches)
+        from deeprec_tpu.guard.sentinel import guard_init
+
+        def body(carry, batch):
+            st, g = carry
+            st, mets = self._step_impl(st, batch, lr, g)
+            return (st, {"ema": mets["guard_ema"]}), mets
+
+        (state, _), mets = jax.lax.scan(
+            body, (state, guard if guard is not None else guard_init()),
+            batches,
+        )
+        return state, mets
 
     # ------------------------------------------------- pipelined K-step scan
 
-    def _pipe_prologue(self, state: TrainState, batch0) -> PipelineCarry:
+    def _pipe_prologue(self, state: TrainState, batch0,
+                       guard=None) -> PipelineCarry:
         """Fill the pipeline: full split-phase lookup of the window's
         first batch (identical program to the sequential lookup)."""
         tables = dict(state.tables)
@@ -696,7 +832,7 @@ class Trainer:
         return PipelineCarry(
             inner=TrainState(step=state.step, tables=tables,
                              dense=state.dense, opt_state=state.opt_state),
-            batch=batch0, views=views, bundle_res=res,
+            batch=batch0, views=views, bundle_res=res, guard=guard,
         )
 
     def _pipe_step(self, carry: PipelineCarry, batch_next, lr):
@@ -747,6 +883,21 @@ class Trainer:
             )(state.dense, embs)
         with jax.named_scope("phase_sparse_apply"):
             tables = self._apply_all(tables, carry.bundle_res, g_embs, step, lr)
+        mets = {"loss": loss}
+        if not isinstance(out, dict):
+            probs = jax.nn.sigmoid(out)
+            mets["accuracy"] = M.accuracy(probs, prev_batch["label"])
+        else:
+            mets["accuracy"] = jnp.zeros(())
+        guard = carry.guard
+        if self.sentinel is not None:
+            # Sentinel over batch t: the apply above wrote batch t's rows,
+            # so the row pass reads them BEFORE finish(t+1)'s gather.
+            with jax.named_scope("phase_sentinel"):
+                tables, mets["_sentinel"] = self._sentinel_observe(
+                    tables, carry.bundle_res, loss, g_dense, g_embs, step
+                )
+            mets, guard = self._sentinel_fold(mets, guard)
         if batch_next is not None:
             with jax.named_scope("phase_finish_exchange"):
                 views_n, res_n = self._finish_all(
@@ -758,30 +909,28 @@ class Trainer:
             g_dense, state.opt_state, state.dense
         )
         dense = optax.apply_updates(state.dense, updates)
-        mets = {"loss": loss}
-        if not isinstance(out, dict):
-            probs = jax.nn.sigmoid(out)
-            mets["accuracy"] = M.accuracy(probs, prev_batch["label"])
-        else:
-            mets["accuracy"] = jnp.zeros(())
         new_state = TrainState(
             step=step + 1, tables=tables, dense=dense, opt_state=opt_state
         )
         return PipelineCarry(
             inner=new_state, batch=batch_next, views=views_n,
-            bundle_res=res_n,
+            bundle_res=res_n, guard=guard,
         ), mets
 
-    def _steps_pipelined(self, state: TrainState, batches, lr):
+    def _steps_pipelined(self, state: TrainState, batches, lr, guard=None):
         """K-step device loop with the one-batch lookahead rotated through
         the scan carry (pipeline_mode != "off"): prologue looks up batch
         0, each scan iteration consumes the carried lookup and prefetches
         the next batch's, the peeled epilogue consumes the last. Bit-
         identical to the sequential scan — tests/test_pipeline_overlap.py
         pins exactness on table ints, values and losses."""
+        if self.sentinel is not None and guard is None:
+            from deeprec_tpu.guard.sentinel import guard_init
+
+            guard = guard_init()
         batch0 = jax.tree.map(lambda x: x[0], batches)
         rest = jax.tree.map(lambda x: x[1:], batches)
-        carry = self._pipe_prologue(state, batch0)
+        carry = self._pipe_prologue(state, batch0, guard)
 
         def body(carry, batch_next):
             return self._pipe_step(carry, batch_next, lr)
@@ -870,13 +1019,25 @@ class Trainer:
 
     # --------------------------------------------------------------- public
 
-    def train_step(self, state: TrainState, batch, lr: Optional[float] = None):
+    def _guard_or_init(self, guard):
+        from deeprec_tpu.guard.sentinel import guard_init
+
+        return guard if guard is not None else guard_init()
+
+    def train_step(self, state: TrainState, batch, lr: Optional[float] = None,
+                   guard=None):
         # lr always rides as a traced scalar so schedules never recompile.
+        # `guard` is the sentinel carry from the PREVIOUS dispatch's mets
+        # (guard/sentinel.guard_carry) — a device reference, never read
+        # host-side here; omitted entirely when no sentinel is configured
+        # so sentinel-less trainers trace the exact legacy signature.
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
-        return self._train_step(state, batch, lr)
+        if self.sentinel is None:
+            return self._train_step(state, batch, lr)
+        return self._train_step(state, batch, lr, self._guard_or_init(guard))
 
     def train_steps(self, state: TrainState, batches,
-                    lr: Optional[float] = None):
+                    lr: Optional[float] = None, guard=None):
         """Run K train steps in ONE device dispatch (`lax.scan`).
 
         `batches` is either a list/tuple of K same-shape batch dicts
@@ -895,10 +1056,13 @@ class Trainer:
         if isinstance(batches, (list, tuple)):
             batches = stack_batches(batches)
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
-        return self._train_steps(state, batches, lr)
+        if self.sentinel is None:
+            return self._train_steps(state, batches, lr)
+        return self._train_steps(state, batches, lr,
+                                 self._guard_or_init(guard))
 
     def train_step_accum(self, state: TrainState, batch, accum_steps: int,
-                         lr: Optional[float] = None):
+                         lr: Optional[float] = None, guard=None):
         """Micro-batched step: batch leaves [A*B, ...] are split into A
         micro-batches; sparse tables update per micro-batch, dense params
         once — DeepRec's micro_batch_num semantics with scan instead of graph
@@ -908,7 +1072,11 @@ class Trainer:
                              *x.shape[1:])
 
         lr = jnp.asarray(self.sparse_opt.lr if lr is None else lr, jnp.float32)
-        return self._train_step_accum(state, jax.tree.map(split, batch), lr)
+        if self.sentinel is None:
+            return self._train_step_accum(state, jax.tree.map(split, batch),
+                                          lr)
+        return self._train_step_accum(state, jax.tree.map(split, batch), lr,
+                                      self._guard_or_init(guard))
 
     def eval_step(self, state: TrainState, batch):
         return self._eval_step(state, batch)
@@ -1202,10 +1370,40 @@ class Trainer:
                 jax.tree.map(lambda a, i=i: a[i] if i else a, ts)
                 for i in idxs
             ]
+            # Row hygiene (guard/rows.py): rows whose norm exploded past
+            # the quantile bound re-initialize HERE, before occupancy /
+            # growth read the state — a hot poisoned id must not
+            # contaminate the table between checkpoints, and must never
+            # trigger a growth it doesn't deserve.
+            rows_reinit = 0
+            sen = getattr(self, "sentinel", None)
+            if sen is not None and sen.row_evict_quantile is not None:
+                from deeprec_tpu.guard import rows as guard_rows
+
+                fills = self._slot_fills(b)
+                for mi, m in enumerate(members):
+                    members[mi], n_bad = guard_rows.anomaly_evict(
+                        b.table, m, sen.row_evict_quantile,
+                        sen.row_evict_factor, fills,
+                    )
+                    rows_reinit += n_bad
+                if rows_reinit:
+                    ts = self._restack(members, lead)
+                    from deeprec_tpu.obs import metrics as _obs_metrics
+
+                    if _obs_metrics.metrics_enabled():
+                        _obs_metrics.default_registry().counter(
+                            "deeprec_guard_rows_reinit",
+                            "anomalous table rows re-initialized by "
+                            "maintain() row hygiene",
+                            {"table": bname},
+                        ).inc(rows_reinit)
             occ = max(int(b.table.size(m)) for m in members) / C
             fails_each = [int(m.insert_fails) for m in members]
             fails = sum(fails_each)
             rep = {"occupancy": occ, "insert_fails": fails, "capacity": C}
+            if rows_reinit:
+                rep["rows_reinit"] = rows_reinit
             rep.update(dedup_report.get(bname, {}))
             if bname in placement_report:
                 rep["placement"] = placement_report[bname]

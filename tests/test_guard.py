@@ -1,0 +1,463 @@
+"""Model-quality firewall (deeprec_tpu/guard): the step sentinel's trip
+matrix and bit-exact no-op contract, TrainLoop rollback resuming
+bit-identically minus the skipped batch, permanent quarantine after R
+trips, the pre-swap canary rejecting a NaN-poisoned delta while serving
+continues, maintain() row hygiene, and the zero-steady-state-compile
+contract with the sentinel enabled."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.guard import (
+    FLAG_GRAD_NORM,
+    FLAG_LOSS_SPIKE,
+    FLAG_NONFINITE_GRAD,
+    FLAG_NONFINITE_LOSS,
+    FLAG_ROW_NORM,
+    GuardPolicy,
+    QualityGate,
+    SentinelConfig,
+    batch_fingerprint,
+)
+from deeprec_tpu.guard.canary import np_auc
+from deeprec_tpu.guard.quarantine import DeadLetter
+from deeprec_tpu.guard.sentinel import (
+    flag_kinds,
+    guard_carry,
+    guard_init,
+    step_flags,
+)
+from deeprec_tpu.models import WDL
+from deeprec_tpu.online import faults
+from deeprec_tpu.online.loop import TrainLoop
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.training.checkpoint import CheckpointManager
+
+SEN = SentinelConfig(spike_ratio=4.0, grad_norm_max=1e4, row_norm_max=100.0,
+                     row_evict_quantile=0.9, row_evict_factor=8.0)
+
+
+def _mk_trainer(sentinel=True):
+    model = WDL(emb_dim=4, capacity=1 << 10, hidden=(16,), num_cat=2,
+                num_dense=2)
+    return Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3),
+                   sentinel=SEN if sentinel else None), model
+
+
+def _batches(n, seed=7, B=64):
+    gen = SyntheticCriteo(batch_size=B, num_cat=2, num_dense=2, vocab=300,
+                          seed=seed)
+    return [gen.batch() for _ in range(n)]
+
+
+# ------------------------------------------------------ sentinel (unit)
+
+
+def test_step_flags_matrix():
+    """Every sentinel bit, driven through the pure fold — the full trip
+    matrix without paying a compile per threshold combination."""
+    cfg = SentinelConfig(spike_ratio=2.0, ema_decay=0.5, grad_norm_max=10.0,
+                         row_norm_max=5.0)
+    g = guard_init()
+    ok = jnp.asarray(True)
+    # clean step seeds the EMA
+    f, g = step_flags(cfg, jnp.float32(1.0), ok, jnp.float32(4.0),
+                      jnp.float32(1.0), g)
+    assert int(f) == 0 and float(g["ema"]) == 1.0
+    # non-finite loss
+    f, g2 = step_flags(cfg, jnp.float32(np.nan), ok, jnp.float32(4.0),
+                       jnp.float32(1.0), g)
+    assert int(f) & FLAG_NONFINITE_LOSS
+    assert float(g2["ema"]) == 1.0  # tripped steps never advance the EMA
+    # non-finite grads
+    f, _ = step_flags(cfg, jnp.float32(1.0), jnp.asarray(False),
+                      jnp.float32(4.0), jnp.float32(1.0), g)
+    assert int(f) & FLAG_NONFINITE_GRAD
+    # grad-norm bound (norm_sq > max^2)
+    f, _ = step_flags(cfg, jnp.float32(1.0), ok, jnp.float32(101.0 ** 2),
+                      jnp.float32(1.0), g)
+    assert int(f) & FLAG_GRAD_NORM
+    # loss spike vs the seeded EMA
+    f, _ = step_flags(cfg, jnp.float32(2.5), ok, jnp.float32(4.0),
+                      jnp.float32(1.0), g)
+    assert int(f) & FLAG_LOSS_SPIKE
+    # row-norm bound, and NaN rows count as over-bound
+    f, _ = step_flags(cfg, jnp.float32(1.0), ok, jnp.float32(4.0),
+                      jnp.float32(6.0), g)
+    assert int(f) & FLAG_ROW_NORM
+    f, _ = step_flags(cfg, jnp.float32(1.0), ok, jnp.float32(4.0),
+                      jnp.float32(np.nan), g)
+    assert int(f) & FLAG_ROW_NORM
+    assert flag_kinds(FLAG_NONFINITE_LOSS | FLAG_ROW_NORM) == [
+        "nonfinite_loss", "row_norm"]
+
+
+def test_sentinel_is_bitexact_noop_when_untripped():
+    """Sentinel ON vs OFF over the same clean batches: identical state
+    bit for bit (the sentinel observes, it never touches the math), and
+    a NaN batch trips the expected bits on the next fold."""
+    tr, _ = _mk_trainer(sentinel=True)
+    tr0, _ = _mk_trainer(sentinel=False)
+    s, s0 = tr.init(0), tr0.init(0)
+    g = None
+    for b in _batches(3):
+        jb = {k: jnp.asarray(v) for k, v in b.items()}
+        s, m = tr.train_step(s, jb, guard=g)
+        g = guard_carry(m)
+        s0, _ = tr0.train_step(s0, jb)
+        assert int(m["guard_flags"]) == 0
+    for bn in s.tables:
+        for a, b_ in zip(jax.tree.leaves(s.tables[bn]),
+                         jax.tree.leaves(s0.tables[bn])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    for a, b_ in zip(jax.tree.leaves(s.dense), jax.tree.leaves(s0.dense)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    bad = faults.poison_batch(_batches(1)[0], "nan")
+    _, m = tr.train_step(s, {k: jnp.asarray(v) for k, v in bad.items()},
+                         guard=g)
+    flags = int(m["guard_flags"])
+    assert flags & FLAG_NONFINITE_LOSS and flags & FLAG_NONFINITE_GRAD
+
+
+def test_sentinel_flags_ride_the_kstep_scan():
+    from deeprec_tpu.training.trainer import stack_batches
+
+    tr, _ = _mk_trainer(sentinel=True)
+    st = tr.init(0)
+    bs = _batches(3, seed=11)
+    bs[1] = faults.poison_batch(bs[1], "nan")
+    st, mets = tr.train_steps(
+        st, stack_batches([{k: jnp.asarray(v) for k, v in b.items()}
+                           for b in bs]))
+    flags = np.asarray(mets["guard_flags"])
+    assert flags.shape == (3,)
+    assert flags[0] == 0 and flags[1] != 0
+
+
+# -------------------------------------------------- rollback + quarantine
+
+
+def _logical_rows(tr, st, bn):
+    """{(member, key): (value row, freq, version)} — restore re-probes
+    keys in a different order than live insertion, so equality is on
+    CONTENT, not physical slot layout."""
+    from deeprec_tpu.embedding.table import empty_key
+    from deeprec_tpu.ops.packed import unpack_array
+
+    ts = st.tables[bn]
+    keys = np.asarray(ts.keys)
+    C = keys.shape[-1]
+    sent = empty_key(tr.bundles[bn].table.cfg)
+    out = {}
+    members = range(keys.shape[0]) if keys.ndim == 2 else [None]
+    for m in members:
+        k = keys[m] if m is not None else keys
+        v = np.asarray(unpack_array(
+            ts.values[m] if m is not None else ts.values, C))
+        f = np.asarray(ts.meta[m, 0] if m is not None else ts.meta[0])
+        ver = np.asarray(ts.meta[m, 1] if m is not None else ts.meta[1])
+        for i in np.nonzero(k != sent)[0]:
+            out[(m, int(k[i]))] = (tuple(v[i]), int(f[i]), int(ver[i]))
+    return out
+
+
+def test_rollback_resumes_bit_identically_minus_poisoned_batch(tmp_path):
+    """THE recovery contract: a guarded run over a poisoned stream ends
+    with exactly the model a clean run over the same stream minus the
+    poisoned batch produces — logical table content and dense params
+    identical, the poisoned save quarantined, the batch dead-lettered."""
+    clean = _batches(14, seed=7)
+    poisoned = list(clean)
+    poisoned[6] = faults.poison_batch(clean[6], "nan")
+
+    tr, _ = _mk_trainer(sentinel=True)
+    ck = CheckpointManager(str(tmp_path / "ckA"), tr)
+    loop = TrainLoop(tr, ck, iter(poisoned), save_every=4, full_every=2,
+                     guard=GuardPolicy(dead_letter_dir=str(tmp_path / "dl"),
+                                       max_batch_trips=2),
+                     max_steps=14)
+    stA, code = loop.run()
+    assert code == 0
+    assert loop.guard_trips == 1 and loop.rollbacks == 1
+    assert loop.last_rollback_ms is not None
+    assert loop.trip_log[0][1] - loop.trip_log[0][0] <= 1  # ≤ 1 dispatch
+    # dead letter holds payload + meta
+    fp = batch_fingerprint(poisoned[6])
+    assert (tmp_path / "dl" / f"batch-{fp}.npz").exists()
+    assert (tmp_path / "dl" / f"batch-{fp}.json").exists()
+
+    tr2, _ = _mk_trainer(sentinel=False)
+    ckB = CheckpointManager(str(tmp_path / "ckB"), tr2)
+    stB, _ = TrainLoop(tr2, ckB, iter(clean[:6] + clean[7:]), save_every=4,
+                       full_every=2, max_steps=13).run()
+    assert int(stA.step) == int(stB.step) == 13
+    for bn in stA.tables:
+        assert _logical_rows(tr, stA, bn) == _logical_rows(tr2, stB, bn)
+    for a, b in zip(jax.tree.leaves(stA.dense), jax.tree.leaves(stB.dense)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_permanent_quarantine_after_R_trips(tmp_path):
+    """The crash-loop breaker: a batch redelivered across R rollbacks is
+    permanently quarantined — later deliveries are skipped before
+    dispatch, and the quarantine survives a fresh loop (restart)."""
+    clean = _batches(10, seed=3)
+    bad = faults.poison_batch(clean[2], "nan")
+    stream = clean[:2] + [bad] + clean[3:5] + [bad] + clean[5:7] + [bad] + \
+        clean[7:]
+    tr, _ = _mk_trainer(sentinel=True)
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    loop = TrainLoop(tr, ck, iter(stream), save_every=3, full_every=2,
+                     guard=GuardPolicy(dead_letter_dir=str(tmp_path / "dl"),
+                                       max_batch_trips=2))
+    loop.run()
+    fp = batch_fingerprint(bad)
+    assert loop.dead_letter.trip_count(fp) == 2
+    assert loop.dead_letter.is_quarantined(fp)
+    assert loop.dead_letter.permanent_count == 1
+    assert loop.batches_skipped == 1  # third delivery never dispatched
+    # the index survives a restart: a fresh DeadLetter refuses the batch
+    dl2 = DeadLetter(str(tmp_path / "dl"), 2)
+    assert dl2.is_quarantined(fp)
+
+
+def test_rollback_pins_stream_reader_positions(tmp_path):
+    """A rollback must restore MODEL state only: rewinding a registered
+    dataset reader would re-deliver the window the rollback already
+    replays from memory — the batches would train twice and the stream
+    offset would undercount (replaying trained data across the next
+    reconnect/restart)."""
+
+    class _Reader:
+        def __init__(self):
+            self.offset = 0
+            self.rewinds = 0
+
+        def save(self):
+            return {"offset": self.offset}
+
+        def restore(self, st):
+            if int(st["offset"]) < self.offset:
+                self.rewinds += 1
+            self.offset = int(st["offset"])
+
+    reader = _Reader()
+    clean = _batches(10, seed=15)
+    stream = list(clean)
+    stream[5] = faults.poison_batch(clean[5], "nan")
+    tr, _ = _mk_trainer(sentinel=True)
+    ck = CheckpointManager(str(tmp_path / "ck"), tr,
+                           datasets={"stream": reader})
+    loop = TrainLoop(tr, ck, iter(stream), save_every=3, full_every=2,
+                     guard=GuardPolicy(dead_letter_dir=str(tmp_path / "dl"),
+                                       max_batch_trips=2))
+    # the reader position advances monotonically with delivered batches
+    loop.on_step = lambda step: setattr(reader, "offset", 1000 + step)
+    loop.run()
+    assert loop.rollbacks == 1
+    # checkpointed positions lag the live offset; the rollback restore
+    # must never hand one back to the reader (not even transiently)
+    assert reader.rewinds == 0
+    assert ck.datasets == {"stream": reader}  # re-attached after
+
+
+def test_guard_requires_sentinel():
+    tr, _ = _mk_trainer(sentinel=False)
+    with pytest.raises(ValueError, match="sentinel"):
+        TrainLoop(tr, None, [], guard=GuardPolicy(dead_letter_dir="/tmp/x"))
+
+
+# ------------------------------------------------------- maintain hygiene
+
+
+def test_maintain_reinitializes_exploded_rows():
+    """Row hygiene: a row whose norm exploded past the quantile bound is
+    re-initialized at maintain() cadence and counted."""
+    tr, _ = _mk_trainer(sentinel=True)
+    st = tr.init(0)
+    for b in _batches(3, seed=5):
+        st, _ = tr.train_step(st, {k: jnp.asarray(v) for k, v in b.items()})
+    bn = next(iter(st.tables))
+    ts = st.tables[bn]
+    # blow one occupied row up to an absurd norm (vmapped member 0)
+    keys0 = np.asarray(ts.keys)[0]
+    from deeprec_tpu.embedding.table import empty_key
+
+    slot = int(np.nonzero(keys0 != empty_key(tr.bundles[bn].table.cfg))[0][0])
+    vals = ts.values.at[0, slot].set(1e9)
+    st = st.replace(tables={**st.tables, bn: ts.replace(values=vals)})
+    st2, report = tr.maintain(st)
+    assert report[bn].get("rows_reinit", 0) >= 1
+    norms = np.linalg.norm(np.asarray(st2.tables[bn].values[0]), axis=-1)
+    assert norms.max() < 1e6
+
+
+# ----------------------------------------------------------- quality gate
+
+
+@pytest.fixture()
+def serving_chain(tmp_path):
+    tr, model = _mk_trainer(sentinel=False)
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    st = tr.init(0)
+    batches = _batches(4, seed=4)
+    for b in batches[:3]:
+        st, _ = tr.train_step(st, {k: jnp.asarray(v) for k, v in b.items()})
+    st, _ = ck.save(st)
+    probe = dict(batches[3])
+    labels = probe.pop("label")
+    return tr, model, ck, st, probe, labels
+
+
+def test_canary_gate_rejects_nan_delta_serving_continues(serving_chain,
+                                                         tmp_path):
+    """A NaN-poisoned delta must be rejected BEFORE the swap: the old
+    snapshot keeps serving (finite answers, zero failed requests), the
+    delta is quarantined, health reports degraded:quality_gate, and a
+    later honest update clears the degradation."""
+    from deeprec_tpu.serving.predictor import ModelServer, Predictor
+
+    tr, model, ck, st, probe, labels = serving_chain
+    gate = QualityGate(probe=probe, labels=labels, auc_floor=0.0,
+                       max_shift=0.25)
+    p = Predictor(model, str(tmp_path / "ck"), quality_gate=gate)
+    server = ModelServer(p, max_batch=64)
+    try:
+        before, v0 = server.request_versioned(probe)
+        assert np.all(np.isfinite(np.asarray(before)))
+
+        bad = faults.poison_batch(_batches(1, seed=9)[0], "nan")
+        st_bad, _ = tr.train_step(
+            jax.tree.map(jnp.copy, st),
+            {k: jnp.asarray(v) for k, v in bad.items()})
+        ck.save_incremental(st_bad)
+        assert p.poll_updates() is False  # rejected, not applied
+        assert gate.rejections == 1
+        assert gate.last_rejection["reason"] == "nonfinite_predictions"
+        h = p.health()
+        assert h["status"] == "degraded"
+        assert h["degraded_reason"] == "quality_gate"
+        assert h["quality_gate_rejections"] == 1
+        # zero failed requests, answers unchanged and finite
+        after, v1 = server.request_versioned(probe)
+        assert v1 == v0
+        np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+        assert any("quarantined" in d
+                   for d in os.listdir(tmp_path / "ck"))
+        # an honest update publishes and clears the degradation (the
+        # trainer's next save self-escalated to full over the gap)
+        good = _batches(1, seed=10)[0]
+        st2, _ = tr.train_step(
+            jax.tree.map(jnp.copy, st),
+            {k: jnp.asarray(v) for k, v in good.items()})
+        _, path = ck.save_incremental(st2)
+        assert os.path.basename(path).startswith("full-")
+        assert p.poll_updates() is True
+        assert p.health()["status"] == "ok"
+        assert p.version > v0
+    finally:
+        server.close()
+
+
+def test_gate_rejects_distribution_shift(serving_chain, tmp_path):
+    """The relative bound: a finite but violently shifted delta (here a
+    huge-LR step) fails max_shift even though nothing is NaN."""
+    from deeprec_tpu.serving.predictor import Predictor
+
+    tr, model, ck, st, probe, labels = serving_chain
+    gate = QualityGate(probe=probe, max_shift=0.05)
+    p = Predictor(model, str(tmp_path / "ck"), quality_gate=gate)
+    v0 = p.version
+    b = _batches(1, seed=12)[0]
+    st_bad, _ = tr.train_step(
+        jax.tree.map(jnp.copy, st),
+        {k: jnp.asarray(v) for k, v in b.items()}, lr=50.0)
+    ck.save_incremental(st_bad)
+    assert p.poll_updates() is False
+    assert gate.rejections == 1
+    assert gate.last_rejection["reason"] == "prediction_shift"
+    assert p.version == v0
+
+
+def test_np_auc_agrees_with_ranks():
+    probs = np.asarray([0.1, 0.4, 0.35, 0.8])
+    labels = np.asarray([0.0, 0.0, 1.0, 1.0])
+    assert abs(np_auc(probs, labels) - 0.75) < 1e-9
+    assert np_auc(np.asarray([0.5, 0.5]), np.asarray([1.0, 1.0])) == 0.5
+
+
+# ----------------------------------------------------------- obs wiring
+
+
+def test_guard_metrics_and_heartbeat_wiring(tmp_path):
+    """Guard events land in the process-wide obs plane (rendered through
+    the same snapshot every /metrics surface serves) and in the
+    heartbeat the Supervisor reads its guard-trip field from."""
+    from deeprec_tpu.obs import metrics as obs_metrics
+    from deeprec_tpu.online.supervisor import Heartbeat, ProcessSpec, Supervisor
+
+    clean = _batches(6, seed=21)
+    stream = list(clean)
+    stream[3] = faults.poison_batch(clean[3], "nan")
+    tr, _ = _mk_trainer(sentinel=True)
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    hb_path = str(tmp_path / "w.hb")
+    loop = TrainLoop(tr, ck, iter(stream), save_every=3, full_every=2,
+                     heartbeat=Heartbeat(hb_path),
+                     guard=GuardPolicy(dead_letter_dir=str(tmp_path / "dl"),
+                                       max_batch_trips=1))
+    loop.run()
+    text = obs_metrics.render_snapshot(
+        obs_metrics.default_registry().snapshot())
+    # counters render with the Prometheus _total suffix appended
+    assert 'deeprec_guard_trips_total{kind="nonfinite_loss"}' in text
+    assert "deeprec_guard_rollbacks_total" in text
+    assert "deeprec_guard_batches_quarantined_total" in text
+    assert "deeprec_guard_last_verified_step" in text
+    beat = Heartbeat.read(hb_path)
+    assert beat["guard_trips"] == 1
+    assert beat["rollbacks"] == 1
+    assert beat["batches_quarantined"] == 1
+    assert beat["last_verified_step"] == loop.last_verified_step
+    # the Supervisor surfaces the guard-trip field per worker
+    import sys as _sys
+
+    spec = ProcessSpec(name="w", argv=[_sys.executable, "-c", "pass"],
+                       heartbeat_path=hb_path, lease_secs=None)
+    sup = Supervisor([spec], on_event=lambda m: None)
+    st = sup.stats()["w"]
+    assert st["guard_trips"] == 1 and st["batches_quarantined"] == 1
+
+
+# ------------------------------------------------- steady-state compiles
+
+
+def test_trace_guard_zero_compiles_with_sentinel_on():
+    """The sentinel adds zero steady-state compiles: after warmup, both
+    the single-step and K-step guarded paths are pure cache-hit."""
+    from deeprec_tpu.analysis import trace_guard as _tg
+    from deeprec_tpu.training.trainer import stack_batches
+
+    tr, _ = _mk_trainer(sentinel=True)
+    st = tr.init(0)
+    bs = [{k: jnp.asarray(v) for k, v in b.items()}
+          for b in _batches(6, seed=13)]
+    st, m = tr.train_step(st, bs[0])
+    g = guard_carry(m)
+    st, m = tr.train_step(st, bs[1], guard=g)
+    g = guard_carry(m)
+    stacked = stack_batches(bs[2:4])
+    st, mets = tr.train_steps(st, stacked, guard=g)
+    g = guard_carry(mets)
+    jax.block_until_ready(mets["loss"])
+    with _tg(max_compiles=0):
+        st, m = tr.train_step(st, bs[4], guard=g)
+        g = guard_carry(m)
+        st, mets = tr.train_steps(st, stack_batches(bs[4:6]), guard=g)
+        jax.block_until_ready(mets["loss"])

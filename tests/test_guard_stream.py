@@ -1,0 +1,270 @@
+"""Input-firewall satellites: garbage-record tolerance in the line
+parser / CSV reader / serving feature parsing, and the TCP stream
+reader's bounded resync over oversized/undecodable frames. Host-side —
+no jax except the serving parse test."""
+import numpy as np
+import pytest
+
+from deeprec_tpu.data.readers import RecordErrors, sanitize_batch
+from deeprec_tpu.data.stream import (
+    FileStreamServer,
+    TCPStreamReader,
+    criteo_line_parser,
+)
+
+ND, NC = 2, 2
+
+
+def _line(label="1", dense=("1.5", "2.0"), cats=("tokA", "tokB")):
+    return "\t".join([label, *dense, *cats])
+
+
+# --------------------------------------------------------- parser matrix
+
+
+def test_line_parser_garbage_matrix():
+    """One bad field clamps THAT field (counted by kind); the rest of
+    the record and the batch parse normally — a garbage record must
+    never kill the reader thread that feeds a live loop."""
+    errors = RecordErrors(metrics=False)
+    parse = criteo_line_parser(ND, NC, errors=errors)
+    batch = parse([
+        _line(),                                  # clean
+        _line(label="garbage"),                   # unparseable label
+        _line(dense=("not_a_float", "3.0")),      # unparseable float
+        _line(dense=("inf", "nan")),              # parse fine, non-finite
+        "",                                       # empty record
+        "\t".join(["1"] + ["9.0"] * 50),          # overlong record
+    ])
+    assert batch["label"].shape == (6,)
+    assert batch["label"][1] == 0.0
+    assert batch["I1"][2, 0] == 0.0 and batch["I2"][2, 0] == 3.0
+    assert batch["I1"][3, 0] == 0.0 and batch["I2"][3, 0] == 0.0
+    assert np.all(np.isfinite(batch["I1"])) and np.all(
+        np.isfinite(batch["I2"]))
+    assert errors.counts["bad_label"] == 1
+    assert errors.counts["bad_float"] == 1
+    assert errors.counts["nonfinite_float"] == 2
+    assert errors.total == 4
+
+
+def test_sanitize_batch_clamps_and_counts():
+    errors = RecordErrors(metrics=False)
+    batch = {
+        "label": np.asarray([1.0, np.nan], np.float32),
+        "I1": np.asarray([[np.inf], [2.0]], np.float32),
+        "C1": np.asarray([5, -7], np.int32),
+        "C2": np.asarray([-1, 3], np.int32),  # -1 IS the pad: untouched
+    }
+    out = sanitize_batch(batch, errors, pad_value=-1, max_id=1000)
+    assert out["label"][1] == 0.0 and out["I1"][0, 0] == 0.0
+    assert out["C1"][1] == -1 and out["C2"][0] == -1
+    assert errors.counts["nonfinite_float"] == 2
+    assert errors.counts["bad_id"] == 1
+    big = sanitize_batch({"C1": np.asarray([2000], np.int32)},
+                         errors, max_id=1000)
+    assert big["C1"][0] == -1
+    assert errors.counts["bad_id"] == 2
+
+
+def test_csv_reader_garbage_matrix(tmp_path):
+    from deeprec_tpu.data.readers import CriteoCSVReader
+
+    path = str(tmp_path / "garbage.tsv")
+    rows = [_line() for _ in range(6)]
+    rows[2] = _line(dense=("inf", "2.0"))
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    reader = CriteoCSVReader([path], batch_size=6, num_dense=ND, num_cat=NC)
+    batch = next(iter(reader))
+    assert np.all(np.isfinite(batch["I1"]))
+    assert batch["I1"][2, 0] == 0.0  # inf clamped, not 3.4e38
+    assert reader.errors.counts.get("nonfinite_float", 0) >= 1
+
+
+# ------------------------------------------------------ TCP frame resync
+
+
+def _serve_file(tmp_path, content: bytes):
+    path = str(tmp_path / "stream.txt")
+    with open(path, "wb") as f:
+        f.write(content)
+    srv = FileStreamServer(path, follow=False).start()
+    return srv, path
+
+
+def test_tcp_reader_skips_oversized_frame_and_counts(tmp_path):
+    """A frame past max_record_bytes is skipped whole (bounded resync):
+    valid rows on both sides still arrive, the skip is counted, and the
+    offset covers every consumed byte — a reconnect never replays or
+    wedges on the garbage."""
+    good = [_line(dense=(f"{i}.0", "1.0")).encode() for i in range(8)]
+    giant = b"X" * 5000  # newline-terminated but absurd
+    content = b"\n".join(good[:4] + [giant] + good[4:]) + b"\n"
+    srv, _ = _serve_file(tmp_path, content)
+    try:
+        r = TCPStreamReader("127.0.0.1", srv.port, batch_size=4,
+                            num_dense=ND, num_cat=NC, stop_at_eof=True,
+                            max_record_bytes=2048)
+        batches = list(r)
+        rows = sum(b["label"].shape[0] for b in batches)
+        assert rows == 8  # every valid row, none duplicated
+        assert r.oversized_frames == 1
+        assert r.record_errors.counts["oversized_frame"] == 1
+        assert r.offset == len(content)  # skipped bytes are consumed
+        dense = np.concatenate([b["I1"][:, 0] for b in batches])
+        assert sorted(dense.tolist()) == [float(i) for i in range(8)]
+    finally:
+        srv.stop()
+
+
+def test_tcp_reader_oversized_unterminated_frame_resyncs(tmp_path):
+    """The torn-frame case: garbage larger than max_record_bytes with
+    its newline far beyond the first reads — the reader discards as it
+    goes (bounded memory) and resumes at the next record boundary."""
+    good = [_line().encode() for _ in range(4)]
+    giant = b"Y" * 100_000
+    content = b"\n".join(good[:2] + [giant] + good[2:]) + b"\n"
+    srv, _ = _serve_file(tmp_path, content)
+    try:
+        r = TCPStreamReader("127.0.0.1", srv.port, batch_size=2,
+                            num_dense=ND, num_cat=NC, stop_at_eof=True,
+                            max_record_bytes=1024)
+        batches = list(r)
+        assert sum(b["label"].shape[0] for b in batches) == 4
+        assert r.oversized_frames == 1
+        assert r.offset == len(content)
+    finally:
+        srv.stop()
+
+
+def test_tcp_reader_oversized_tail_at_eof_counts_and_consumes(tmp_path):
+    """Garbage past max_record_bytes at the very END of the stream (no
+    terminating newline, ever): the frame is still counted, and the
+    drained reader's offset covers every byte — a checkpointed position
+    never points back into the skipped garbage."""
+    good = [_line(dense=(f"{i}.0", "1.0")).encode() for i in range(3)]
+    content = b"\n".join(good) + b"\n" + b"Q" * 50_000  # unterminated tail
+    srv, _ = _serve_file(tmp_path, content)
+    try:
+        r = TCPStreamReader("127.0.0.1", srv.port, batch_size=2,
+                            num_dense=ND, num_cat=NC, stop_at_eof=True,
+                            max_record_bytes=1024)
+        batches = list(r)
+        assert sum(b["label"].shape[0] for b in batches) == 3
+        assert r.oversized_frames == 1
+        assert r.record_errors.counts["oversized_frame"] == 1
+        assert r.offset == len(content)
+    finally:
+        srv.stop()
+
+
+def test_tcp_reader_undecodable_record_counted_not_fatal(tmp_path):
+    """Undecodable text inside a normal-sized frame clamps field-wise in
+    the (sanitizing) default parser — the reader thread survives and the
+    batch still has its full row count."""
+    rows = [_line().encode(), "1\tbad\tworse\t\x00\t\x01".encode(),
+            _line().encode(), _line().encode()]
+    content = b"\n".join(rows) + b"\n"
+    srv, _ = _serve_file(tmp_path, content)
+    try:
+        r = TCPStreamReader("127.0.0.1", srv.port, batch_size=4,
+                            num_dense=ND, num_cat=NC, stop_at_eof=True)
+        batches = list(r)
+        assert sum(b["label"].shape[0] for b in batches) == 4
+        assert r.record_errors.total >= 1
+        for b in batches:
+            assert np.all(np.isfinite(b["I1"]))
+    finally:
+        srv.stop()
+
+
+def test_tcp_reader_offsets_resume_past_skipped_frames(tmp_path):
+    """Crash/restore across a skipped frame: a second reader restoring
+    the first one's offset sees only the not-yet-delivered rows."""
+    good = [_line(dense=(f"{i}.0", "1.0")).encode() for i in range(6)]
+    giant = b"Z" * 4000
+    content = b"\n".join(good[:2] + [giant] + good[2:]) + b"\n"
+    srv, _ = _serve_file(tmp_path, content)
+    try:
+        r1 = TCPStreamReader("127.0.0.1", srv.port, batch_size=2,
+                             num_dense=ND, num_cat=NC, stop_at_eof=True,
+                             max_record_bytes=1024)
+        it = iter(r1)
+        first = next(it)  # rows 0, 1
+        assert first["I1"][:, 0].tolist() == [0.0, 1.0]
+        saved = r1.save()
+
+        r2 = TCPStreamReader("127.0.0.1", srv.port, batch_size=2,
+                             num_dense=ND, num_cat=NC, stop_at_eof=True,
+                             max_record_bytes=1024)
+        r2.restore(saved)
+        rest = np.concatenate([b["I1"][:, 0] for b in r2])
+        # exactly-once: rows 2..5, each delivered once, giant skipped
+        assert sorted(rest.tolist()) == [2.0, 3.0, 4.0, 5.0]
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- serving feature parse
+
+
+def test_parse_features_firewall(tmp_path):
+    """Serving-side first line: non-finite dense REJECTS the request
+    (counted), negative ids CLAMP to the pad value (counted) — garbage
+    never reaches the model with a healthy version stamp."""
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.serving.predictor import (
+        BadRequest,
+        Predictor,
+        parse_features,
+    )
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = WDL(emb_dim=4, capacity=1 << 9, hidden=(8,), num_cat=2,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(5e-3))
+    ck = CheckpointManager(str(tmp_path / "ck"), tr)
+    st = tr.init(0)
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=8, num_cat=2, num_dense=2, vocab=50,
+                          seed=0)
+    b = gen.batch()
+    st, _ = tr.train_step(st, {k: jnp.asarray(v) for k, v in b.items()})
+    ck.save(st)
+    p = Predictor(model, str(tmp_path / "ck"))
+
+    feats = {k: v.tolist() for k, v in b.items() if k != "label"}
+    ok = parse_features(p, feats)
+    assert ok["I1"].shape == (8, 1)
+
+    nan_feats = dict(feats)
+    nan_feats["I1"] = [float("nan")] * 8
+    with pytest.raises(BadRequest, match="non-finite"):
+        parse_features(p, nan_feats)
+    assert p.record_errors["nonfinite_float"] == 8
+
+    neg_feats = dict(feats)
+    neg_feats["C1"] = [-5] * 8
+    out = parse_features(p, neg_feats)
+    assert np.all(out["C1"] == -1)  # clamped to the pad value
+    assert p.record_errors["bad_id"] == 8
+    # oversized bags trim to max_len, counted — only when the feature
+    # declares a max_len (WDL's scalar bags don't), so pin the counter
+    # through a ragged feature if one exists, else skip quietly
+    seq = [f for f in p._trainer.sparse_specs if f.max_len]
+    if seq:
+        f0 = seq[0]
+        bag_feats = dict(feats)
+        bag_feats[f0.name] = [[1] * (f0.max_len + 3)] * 8
+        parse_features(p, bag_feats)
+        assert p.record_errors["oversized_bag"] == 24
+    # and a clamped request still predicts finite probabilities
+    probs = p.predict(out)
+    assert np.all(np.isfinite(np.asarray(probs)))

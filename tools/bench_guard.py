@@ -1,0 +1,361 @@
+"""Model-quality firewall bench: the guard/ stack measured end to end
+under injected semantic poison.
+
+Topology (one process, the CI shape of the firewall story):
+
+    SyntheticCriteo ── PoisonInjector (NaN / extreme / label-flip /
+                       stream-replayed repeats) + exploding-LR window
+                              │
+    TrainLoop(guard=GuardPolicy, Trainer(sentinel=SentinelConfig))
+          │  sentinel trip -> rollback to verified chain + dead-letter
+          ▼
+    checksummed checkpoint chain (poisoned saves quarantined)
+          │
+    ServeLoop(quality_gate=QualityGate)  <── closed-loop scorer
+          │  pre-swap canary rejects what slips through
+          ▼
+    GUARD_BENCH.json  (gated by roofline.py --assert-guard)
+
+The headline gate: under the full poison matrix the SERVED model's AUC
+on a held-out labeled eval set never crosses the recorded floor, ZERO
+requests fail, every injected poison batch is detected within one
+dispatch of its delivery, and rollback+resume completes within the
+recorded wall time.
+
+Run:  python tools/bench_guard.py [--out GUARD_BENCH.json]
+      --smoke : shorter walk, same full poison matrix + asserts (CI:
+                cibuild/run_tests.sh).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+NUM_CAT, NUM_DENSE, EMB_DIM, CAPACITY = 2, 2, 8, 1 << 12
+BATCH = 256
+
+
+def build_model():
+    from deeprec_tpu.models import WDL
+
+    return WDL(emb_dim=EMB_DIM, capacity=CAPACITY, hidden=(32,),
+               num_cat=NUM_CAT, num_dense=NUM_DENSE)
+
+
+def build_trainer(sentinel=True):
+    import optax
+
+    from deeprec_tpu.guard import SentinelConfig
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    sen = SentinelConfig(
+        spike_ratio=1.5, ema_decay=0.9, grad_norm_max=5e3,
+        row_norm_max=50.0, row_evict_quantile=0.9,
+    ) if sentinel else None
+    return Trainer(build_model(), Adagrad(lr=0.1),
+                   optax.adam(5e-3), sentinel=sen)
+
+
+def batch_source(seed, n, sharp=4.0):
+    """Synthetic-Criteo batches with SHARPENED labels: the same hidden
+    id/dense structure, logits scaled by `sharp` before the label draw —
+    the model reaches a real AUC (~0.86) and a clean loss floor, so a
+    flipped-label batch produces an unmistakable (~2.7×) loss spike
+    against the clean-step EMA (the stock generator's label noise keeps
+    loss near ln 2, where a flip barely registers — detectability is
+    what this bench measures, so the signal must exist)."""
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=BATCH, num_cat=NUM_CAT,
+                          num_dense=NUM_DENSE, vocab=500, seed=seed)
+    rng = np.random.default_rng(seed ^ 0xA5)
+    out = []
+    for _ in range(n):
+        b = gen.batch()
+        logit = np.zeros(BATCH, np.float32)
+        for c in range(NUM_CAT):
+            logit += gen.id_weight[c, b[f"C{c+1}"] - c * gen.vocab] * 0.3
+        dense = np.concatenate(
+            [b[f"I{i+1}"] for i in range(NUM_DENSE)], axis=1)
+        logit += (np.log1p(dense) @ gen.dense_weight) * 0.3
+        logit = (logit - logit.mean()) * sharp
+        b["label"] = (
+            rng.random(BATCH) < 1.0 / (1.0 + np.exp(-logit))
+        ).astype(np.float32)
+        out.append(b)
+    return out
+
+
+class Scorer(threading.Thread):
+    """Closed-loop load: score the held-out eval set against the served
+    model continuously; a request error anywhere fails the bench."""
+
+    def __init__(self, serve, eval_feats, eval_labels):
+        super().__init__(daemon=True, name="guard-scorer")
+        self.serve = serve
+        self.feats = eval_feats
+        self.labels = eval_labels
+        self.requests = 0
+        self.failed = 0
+        self.errors = []
+        self.aucs = []  # (t, auc, model_version)
+        self._halt = threading.Event()
+
+    def round(self):
+        from deeprec_tpu.guard.canary import np_auc
+
+        probs = []
+        ver = None
+        n = len(self.labels)
+        for off in range(0, n, BATCH):
+            req = {k: v[off:off + BATCH] for k, v in self.feats.items()}
+            self.requests += 1
+            try:
+                out, ver = self.serve.request_versioned(req, timeout=60.0)
+            except Exception as e:  # ANY failure fails the gate
+                self.failed += 1
+                self.errors.append(repr(e))
+                return None
+            probs.append(np.asarray(out))  # noqa: DRT002 — bench scorer thread: replies are host results already
+        auc = np_auc(np.concatenate(probs), self.labels)
+        self.aucs.append((time.monotonic(), auc, ver))
+        return auc
+
+    def run(self):
+        while not self._halt.is_set():
+            self.round()
+            self._halt.wait(0.1)
+
+    def stop(self):
+        self._halt.set()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--out", default=os.path.join(REPO, "GUARD_BENCH.json"))
+    p.add_argument("--dir", default=None, help="work dir (default: tmp)")
+    p.add_argument("--auc-margin", type=float, default=0.05,
+                   help="floor = baseline serving AUC - margin")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    work = args.dir or tempfile.mkdtemp(prefix="deeprec_guard_")
+    ck_dir = os.path.join(work, "ck")
+    dl_dir = os.path.join(work, "deadletter")
+
+    import jax.numpy as jnp
+
+    from deeprec_tpu.guard import GuardPolicy, QualityGate
+    from deeprec_tpu.online import faults
+    from deeprec_tpu.online.loop import ServeLoop, TrainLoop
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    warm_steps = 30 if args.smoke else 80
+    poison_len = 40 if args.smoke else 90
+
+    trainer = build_trainer()
+    ck = CheckpointManager(ck_dir, trainer)
+
+    # ---- phase 1: clean warmup (anchor + a model worth defending)
+    t0 = time.monotonic()
+    warm = batch_source(seed=1, n=warm_steps)
+    TrainLoop(trainer, ck, iter(warm), save_every=10, full_every=2,
+              guard=GuardPolicy(dead_letter_dir=dl_dir, max_batch_trips=2),
+              max_steps=warm_steps).run()
+    print(f"warmup: {warm_steps} steps in {time.monotonic() - t0:.1f}s",
+          flush=True)
+
+    # held-out eval set + gate probe (labels ride outside the request)
+    hold = batch_source(seed=99, n=2)
+    eval_feats = {
+        k: np.concatenate([b[k] for b in hold])
+        for k in hold[0] if k != "label"
+    }
+    eval_labels = np.concatenate([b["label"] for b in hold])
+    probe = {k: v[:BATCH] for k, v in eval_feats.items()}
+    gate = QualityGate(probe=probe, labels=eval_labels[:BATCH],
+                       auc_floor=0.5, max_shift=0.2)
+
+    # ---- phase 2: serving + closed-loop scorer
+    serve = ServeLoop(build_model(), ck_dir, poll_secs=0.2,
+                      quality_gate=gate)
+    scorer = Scorer(serve, eval_feats, eval_labels)
+    baseline = scorer.round()
+    if baseline is None:
+        print("FATAL: baseline scoring failed", file=sys.stderr)
+        return 1
+    floor = round(max(0.5, baseline - args.auc_margin), 4)
+    print(f"baseline serving AUC {baseline:.4f}, floor {floor}", flush=True)
+    scorer.start()
+
+    # ---- phase 3: poisoned stream (guarded trainer keeps training)
+    stream = batch_source(seed=2, n=poison_len)
+    plan = {6: "nan", 18: "extreme", 26: "label_flip"}
+    repeats = {10, 14}  # stream-replays of the NaN batch -> permanent
+    injector = faults.PoisonInjector(iter(stream), plan, repeat_at=repeats)
+    lr_window = {"until": 0.0}
+    base_lr = 0.1
+
+    def lr_fn(step):
+        # exploding-LR window, wall-clock-bounded (a config push that a
+        # human reverts): armed once mid-run by the step hook below
+        if time.monotonic() < lr_window["until"]:
+            return base_lr * 1e5
+        return base_lr
+
+    armed = {"done": False}
+
+    def on_step(step):
+        if not armed["done"] and step >= warm_steps + 30:
+            lr_window["until"] = time.monotonic() + 1.0
+            armed["done"] = True
+
+    loop = TrainLoop(
+        trainer, ck, injector, save_every=8, full_every=3,
+        guard=GuardPolicy(dead_letter_dir=dl_dir, max_batch_trips=2,
+                          replay_window=128),
+        lr_fn=lr_fn, on_step=on_step, log_every=0,
+    )
+    t_train0 = time.monotonic()
+    loop.run()
+    train_secs = time.monotonic() - t_train0
+
+    # ---- phase 4: a poisoned delta slips past the trainer (shadow
+    # trainer WITHOUT a sentinel writes it) — the serving canary must
+    # reject it while requests keep succeeding.
+    shadow = build_trainer(sentinel=False)
+    ck_shadow = CheckpointManager(ck_dir, shadow)
+    st = ck_shadow.restore()
+    bad = faults.poison_batch(stream[-1], "nan")
+    st, _ = shadow.train_step(
+        st, {k: jnp.asarray(v) for k, v in bad.items()})
+    ck_shadow.save_incremental(st)
+    deadline = time.monotonic() + 30.0
+    while gate.rejections == 0 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    gate_health = serve.health()
+    # let the scorer observe the post-rejection world for a moment
+    time.sleep(1.0 if args.smoke else 3.0)
+    scorer.stop()
+    scorer.join(timeout=30)
+    serve.close()
+
+    # ---- ledger
+    trips_by_fp = {}
+    for bad_step, detect_step, flags, kinds, fp in loop.trip_log:
+        trips_by_fp.setdefault(fp, []).append(
+            {"step": bad_step, "detect_step": detect_step,
+             "lag_dispatches": max(0, detect_step - bad_step),
+             "kinds": kinds})
+    events = []
+    for idx, mode, fp in injector.injected:
+        hits = trips_by_fp.get(fp, [])
+        events.append({
+            "delivery": idx, "mode": mode, "fingerprint": fp,
+            "detected": bool(hits) or loop.dead_letter.is_quarantined(fp),
+            "detection_dispatches": (
+                max(h["lag_dispatches"] for h in hits) if hits else 0),
+            "trips": len(hits),
+        })
+    lr_trips = [
+        {"step": s, "kinds": k}
+        for (s, _, _, k, fp) in loop.trip_log
+        if fp not in {f for _, _, f in injector.injected}
+    ]
+    min_auc = min((a for _, a, _ in scorer.aucs), default=None)
+    record = {
+        "guard": {
+            "smoke": bool(args.smoke),
+            "steps": {"warmup": warm_steps, "poison_stream": poison_len},
+            "events": events,
+            "lr_window_trips": lr_trips,
+            "trips_total": loop.guard_trips,
+            "rollbacks": loop.rollbacks,
+            "batches_skipped": loop.batches_skipped,
+            "batches_quarantined": loop.dead_letter.permanent_count,
+            "replay_gaps": loop.replay_gaps,
+            "rollback_ms_last": loop.last_rollback_ms,
+            "train_phase_secs": round(train_secs, 2),
+            "auc": {"baseline": round(baseline, 4), "floor": floor,
+                    "min_served": (round(min_auc, 4)
+                                   if min_auc is not None else None),
+                    "rounds": len(scorer.aucs)},
+            "requests": scorer.requests,
+            "failed_requests": scorer.failed,
+            "request_errors": scorer.errors[:5],
+            "quality_gate": {
+                "rejections": gate.rejections,
+                "last": gate.last_rejection,
+                "health_status": gate_health.get("status"),
+                "degraded_reason": gate_health.get("degraded_reason"),
+            },
+        }
+    }
+
+    # merge into --out (the bench JSON may carry other sections)
+    existing = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+        except ValueError:
+            existing = {}
+    existing.update(record)
+    with open(args.out, "w") as f:
+        json.dump(existing, f, indent=1)
+    print(json.dumps(record["guard"], indent=1))
+
+    # ---- hard asserts (the bench IS the gate's producer; fail loudly)
+    rc = 0
+    if scorer.failed:
+        print(f"FAIL: {scorer.failed} failed request(s): "
+              f"{scorer.errors[:3]}", file=sys.stderr)
+        rc = 1
+    undetected = [e for e in events if not e["detected"]]
+    if undetected:
+        print(f"FAIL: undetected poison deliveries: {undetected}",
+              file=sys.stderr)
+        rc = 1
+    slow = [e for e in events if e["detection_dispatches"] > 1]
+    if slow:
+        print(f"FAIL: detection slower than 1 dispatch: {slow}",
+              file=sys.stderr)
+        rc = 1
+    if min_auc is not None and min_auc < floor:
+        print(f"FAIL: served AUC {min_auc:.4f} crossed the floor {floor}",
+              file=sys.stderr)
+        rc = 1
+    if loop.dead_letter.permanent_count < 1:
+        print("FAIL: the replayed poison batch was never permanently "
+              "quarantined", file=sys.stderr)
+        rc = 1
+    if gate.rejections < 1:
+        print("FAIL: the quality gate never rejected the poisoned delta",
+              file=sys.stderr)
+        rc = 1
+    if gate_health.get("status") != "degraded" or \
+            gate_health.get("degraded_reason") != "quality_gate":
+        print(f"FAIL: health after gate rejection was {gate_health}",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"guard bench OK: {loop.guard_trips} trips, "
+              f"{loop.rollbacks} rollbacks, "
+              f"{loop.dead_letter.permanent_count} quarantined, "
+              f"min served AUC {min_auc:.4f} ≥ {floor}, "
+              f"{scorer.requests} requests, 0 failed")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

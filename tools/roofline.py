@@ -496,6 +496,91 @@ def assert_obs(json_path: str, tol: float) -> int:
     return rc
 
 
+def assert_guard(json_path: str, detect_budget: int,
+                 recovery_ms: float) -> int:
+    """CI gate for the model-quality firewall (tools/bench_guard.py
+    'guard' section): under the injected poison matrix (NaN features,
+    extreme magnitudes, label flips, stream-replayed repeats, an
+    exploding-LR window) the served model's AUC must never cross the
+    recorded floor, ZERO requests may fail, every poison delivery must
+    be detected within `detect_budget` dispatches, the replayed batch
+    must end permanently quarantined, the pre-swap canary must have
+    rejected the out-of-band poisoned delta (health degraded:
+    quality_gate), and the last rollback+replay must complete within
+    `recovery_ms`."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    g = rec.get("guard")
+    if not g:
+        print(f"roofline: {json_path} has no 'guard' record "
+              "(run tools/bench_guard.py --out onto this JSON)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    if g.get("failed_requests", 1) != 0:
+        print(f"roofline: guard gate FAILED — {g.get('failed_requests')} "
+              f"failed request(s) under poison "
+              f"({g.get('request_errors')}); the firewall contract is "
+              f"ZERO", file=sys.stderr)
+        rc = 1
+    events = g.get("events") or []
+    if not events:
+        print("roofline: guard gate FAILED — no poison deliveries "
+              "recorded", file=sys.stderr)
+        rc = 1
+    for ev in events:
+        if not ev.get("detected"):
+            print(f"roofline: guard gate FAILED — poison delivery "
+                  f"{ev.get('delivery')} ({ev.get('mode')}) was never "
+                  f"detected", file=sys.stderr)
+            rc = 1
+        elif ev.get("detection_dispatches", 0) > detect_budget:
+            print(f"roofline: guard gate FAILED — delivery "
+                  f"{ev.get('delivery')} detected after "
+                  f"{ev['detection_dispatches']} dispatches (budget "
+                  f"{detect_budget})", file=sys.stderr)
+            rc = 1
+    auc = g.get("auc", {})
+    if auc.get("min_served") is None or auc.get("floor") is None or \
+            auc["min_served"] < auc["floor"]:
+        print(f"roofline: guard gate FAILED — served AUC crossed the "
+              f"floor ({auc})", file=sys.stderr)
+        rc = 1
+    if g.get("batches_quarantined", 0) < 1:
+        print("roofline: guard gate FAILED — no batch reached permanent "
+              "quarantine despite stream replays", file=sys.stderr)
+        rc = 1
+    if g.get("rollbacks", 0) < 1:
+        print("roofline: guard gate FAILED — no rollback recorded",
+              file=sys.stderr)
+        rc = 1
+    rb = g.get("rollback_ms_last")
+    if rb is None or rb > recovery_ms:
+        print(f"roofline: guard gate FAILED — rollback+replay took "
+              f"{rb} ms (bound {recovery_ms:.0f} ms)", file=sys.stderr)
+        rc = 1
+    qg = g.get("quality_gate", {})
+    if qg.get("rejections", 0) < 1 or \
+            qg.get("degraded_reason") != "quality_gate":
+        print(f"roofline: guard gate FAILED — the pre-swap canary did "
+              f"not reject the poisoned delta visibly ({qg})",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: guard gate ok — {len(events)} poison deliveries "
+            f"all detected ≤ {detect_budget} dispatch(es), "
+            f"{g.get('rollbacks')} rollback(s) "
+            f"(last {rb} ms), {g.get('batches_quarantined')} permanently "
+            f"quarantined, min served AUC {auc.get('min_served')} ≥ floor "
+            f"{auc.get('floor')}, {g.get('requests')} requests / 0 failed, "
+            f"{qg.get('rejections')} canary rejection(s)"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -567,6 +652,22 @@ def main(argv=None):
     p.add_argument("--obs-tol", type=float, default=0.02,
                    help="allowed obs-plane overhead as a fraction of the "
                         "measured step/request time (default 0.02)")
+    p.add_argument("--assert-guard", metavar="GUARD_JSON", default=None,
+                   help="don't run the step: validate the model-quality "
+                        "firewall record written by tools/bench_guard.py "
+                        "(every injected poison detected within "
+                        "--guard-detect-budget dispatches, served AUC "
+                        "never under the recorded floor, zero failed "
+                        "requests, permanent quarantine + canary "
+                        "rejection observed; CI smoke gate)")
+    p.add_argument("--guard-detect-budget", type=int, default=1,
+                   help="max dispatches between a poison delivery and its "
+                        "sentinel trip (default 1 — the deferred-read "
+                        "contract)")
+    p.add_argument("--guard-recovery-ms", type=float, default=120000.0,
+                   help="bound on the recorded rollback+replay wall time "
+                        "(default 120 s — generous for single-core CI; "
+                        "capable hosts should pin it down)")
     p.add_argument("--serving-quant-ratio", type=float, default=0.55,
                    help="int8 residency bytes bound as a fraction of fp32 "
                         "(default 0.55 — int8 + per-row scale must at "
@@ -589,6 +690,9 @@ def main(argv=None):
                                 args.serving_quant_ratio))
     if args.assert_obs:
         sys.exit(assert_obs(args.assert_obs, args.obs_tol))
+    if args.assert_guard:
+        sys.exit(assert_guard(args.assert_guard, args.guard_detect_budget,
+                              args.guard_recovery_ms))
 
     import jax
     import jax.numpy as jnp
