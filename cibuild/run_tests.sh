@@ -58,6 +58,14 @@ echo "== obs overhead gate, serving arm (telemetry plane ≤2% + /metrics parses
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-obs /tmp/deeprec_serving_smoke.json
 
+echo "== retrieval bench (CPU smoke: 1M-item blocked top-k sweep, int8 + fp32 residency, recall vs exact scan, gather baseline, delta-fold freshness, trace guard) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_retrieval.py --smoke \
+    --out /tmp/deeprec_retrieval_smoke.json
+
+echo "== full-corpus retrieval gate (recall/speedup/freshness/residency/compile drift fails the smoke) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-retrieval /tmp/deeprec_retrieval_smoke.json
+
 echo "== freshness bench (CPU smoke: online loop, trainer SIGKILL + supervised restart, zero failed requests) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_freshness.py --smoke
 

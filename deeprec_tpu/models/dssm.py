@@ -83,6 +83,15 @@ class DSSM:
         """Item tower over [N, F*D] stacked item features."""
         return self._normalize(nn.mlp_apply(params["item"], item_embs))
 
+    def item_tower_params(self, params):
+        """The dense subtree `item_vectors` reads — the retrieval
+        engine's corpus-staleness fingerprint (serving/retrieval.py): a
+        delta that leaves this subtree untouched (sparse-only online
+        updates) folds targeted; one that moves it re-encodes the whole
+        corpus. `temp` is excluded — it scales every score uniformly and
+        cannot reorder a top-k."""
+        return params["item"]
+
     def apply_with_user(self, params, user_vec, inputs):
         """Forward given precomputed user vectors (the serving-side
         sample-aware-compression hook: the predictor runs `user_vector`

@@ -1,0 +1,116 @@
+"""Blocked streaming top-k over a device-resident corpus matrix.
+
+The full-corpus retrieval hot path (serving/retrieval.py) scores ONE
+user batch against EVERY resident item vector. Materializing the whole
+[B, C] score matrix is the naive shape — at C = 10M items it is 40 MB
+per user row and the scores are read exactly once. Instead the sweep is
+blocked: the corpus lives as [C/Bk, Bk, H] pow2-padded blocks, each
+block contributes a [B, Bk] score tile, and a [B, k] top-k carry is
+merged per block with `lax.top_k` — the score matrix never exists, peak
+residency is one tile + the carry, and the HBM traffic of a sweep is
+exactly one read of the corpus (ops/traffic.py `retrieval_sweep_bytes`
+models it; the bench asserts measured == modeled).
+
+Tie handling is DETERMINISTIC and block-size independent: equal scores
+resolve to the LOWEST corpus row index. `lax.top_k` breaks value ties
+by position; the carry is kept sorted (score desc, row asc) and always
+precedes the current block's rows — which are themselves in ascending
+row order — in the merge buffer, so the position tie-break IS the
+ascending-row-index tie-break, inductively across blocks. The fleet
+merge (frontend) re-establishes the same order across shards with a
+host-side lexsort on (-score, item id).
+
+int8 corpora ride the PR 10 residency story: rows store int8 codes plus
+a per-row fp32 scale, and because the score is a dot product the
+dequantization moves OUT of the row axis — score = (u · q_row) * scale —
+so the sweep reads 1 byte/element and pays one [Bk] multiply per block
+instead of dequantizing [Bk, H] rows.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Score assigned to padding / invalid corpus rows: they can never win a
+# merge against any finite score, and surviving -inf entries mark "fewer
+# than k valid rows" (the caller maps them to item id -1).
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def blocked_topk(
+    user: jnp.ndarray,
+    corpus: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+    *,
+    block_rows: int,
+    scale: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k rows of `corpus` by dot-product score for each user vector.
+
+    user    [B, H] float32 — query vectors (the user tower's output).
+    corpus  [Cp, H] int8/bf16/f32 — resident item matrix, Cp a multiple
+            of `block_rows` (pow2-padded; padding rows are invalid).
+    valid   [Cp] bool — live corpus rows; invalid rows score -inf.
+    k       static — results per user row.
+    scale   [Cp] f32 or None — per-row dequant scale (int8 residency):
+            score = (user · row) * scale[row].
+
+    Returns (scores [B, k] f32 desc-sorted, rows [B, k] int32 corpus row
+    indices; -1 where fewer than k valid rows exist). Ties are broken by
+    the lowest row index, independent of `block_rows`.
+    """
+    B = user.shape[0]
+    Cp, H = corpus.shape
+    if Cp % block_rows:
+        raise ValueError(
+            f"corpus rows {Cp} not a multiple of block_rows {block_rows}")
+    nb = Cp // block_rows
+    user = jnp.asarray(user, jnp.float32)
+    init = (
+        jnp.full((B, k), NEG_INF, jnp.float32),
+        jnp.full((B, k), -1, jnp.int32),
+    )
+    if nb == 0:
+        return init
+
+    blocks = corpus.reshape(nb, block_rows, H)
+    vblocks = valid.reshape(nb, block_rows)
+    base = (jnp.arange(nb, dtype=jnp.int32) * block_rows)
+    xs = (blocks, vblocks, base)
+    if scale is not None:
+        xs = xs + (scale.astype(jnp.float32).reshape(nb, block_rows),)
+
+    def body(carry, x):
+        vals, rows = carry
+        if scale is not None:
+            blk, vld, b0, s = x
+        else:
+            blk, vld, b0 = x
+            s = None
+        # One tile of scores: the int8/bf16 block is widened in-register;
+        # HBM only ever read the storage dtype.
+        tile = jax.lax.dot_general(
+            user, blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, Bk]
+        if s is not None:
+            tile = tile * s[None, :]
+        tile = jnp.where(vld[None, :], tile, NEG_INF)
+        gidx = (b0 + jnp.arange(block_rows, dtype=jnp.int32))[None, :]
+        # Merge buffer: carry FIRST (earlier/lower rows among ties, by
+        # the invariant), block rows after in ascending order — so
+        # top_k's position tie-break keeps lowest-row-wins exact.
+        mv = jnp.concatenate([vals, tile], axis=1)
+        mi = jnp.concatenate(
+            [rows, jnp.broadcast_to(gidx, tile.shape)], axis=1)
+        top_v, pos = jax.lax.top_k(mv, k)
+        top_i = jnp.take_along_axis(mi, pos, axis=1)
+        return (top_v, top_i), None
+
+    (vals, rows), _ = jax.lax.scan(body, init, xs)
+    rows = jnp.where(vals > NEG_INF, rows, -1)
+    return vals, rows

@@ -215,6 +215,45 @@ def serving_residency_bytes(
     return float(b)
 
 
+# ------------------------------------------------------- retrieval sweep
+
+
+def retrieval_sweep_bytes(
+    *, corpus_rows: int, dim: int, value_dtype: str = "int8",
+    block_rows: int = 4096,
+) -> float:
+    """HBM bytes ONE full-corpus retrieval sweep reads
+    (serving/retrieval.py + ops/topk.py): the resident item matrix at
+    its storage dtype, the per-row dequant scale (int8 residency only),
+    and the validity mask. `corpus_rows` is the POW2-PADDED resident
+    capacity (a multiple of `block_rows` — the blocked sweep reads whole
+    blocks, padding included; padding rows score -inf and cost their
+    bytes, which is why the engine keeps the block count pow2-tight).
+
+      float32  : C * D * 4  +  C        (values + valid mask)
+      bfloat16 : C * D * 2  +  C
+      int8     : C * D * 1  +  C * 4  +  C   (+ per-row fp32 scale)
+
+    The [B, k] top-k carry and the per-block score tile live on-chip and
+    are excluded — the sweep's defining property is that the full [C]
+    score vector never touches HBM. `RetrievalEngine.sweep_info()`
+    measures the same quantity off the actual device arrays and
+    `roofline.py --assert-retrieval` pins measured == modeled (shape
+    math, not an estimate — the serving-residency discipline)."""
+    vb = {"float32": 4, "bfloat16": 2, "int8": 1}
+    if value_dtype not in vb:
+        raise ValueError(f"unknown residency dtype {value_dtype!r}")
+    if block_rows <= 0 or corpus_rows % block_rows:
+        raise ValueError(
+            f"corpus_rows {corpus_rows} must be a positive multiple of "
+            f"block_rows {block_rows}")
+    b = float(corpus_rows) * float(dim) * vb[value_dtype]
+    if value_dtype == "int8":
+        b += float(corpus_rows) * 4  # per-row fp32 dequant scale
+    b += float(corpus_rows)  # validity mask (1 byte/row)
+    return float(b)
+
+
 # ---------------------------------------------------------- pipelining model
 
 

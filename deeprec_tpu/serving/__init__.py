@@ -13,6 +13,11 @@ from deeprec_tpu.serving.fleet import (
     LeaseStamper,
 )
 from deeprec_tpu.serving.http_server import HttpServer
+from deeprec_tpu.serving.retrieval import (
+    RetrievalEngine,
+    RetrievalResult,
+    RetrievalServer,
+)
 from deeprec_tpu.serving.stats import ServingStats
 from deeprec_tpu.serving.remote_store import RemoteKVClient, RemoteKVServer
 from deeprec_tpu.serving.resp_store import RedisFeatureStore, RespConnection

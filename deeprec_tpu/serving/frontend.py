@@ -73,6 +73,13 @@ OP_STAT = b"STAT"
 OP_POLL = b"POLL"
 OP_INFO = b"INFO"
 OP_METR = b"METR"  # obs metrics snapshot (JSON) — the /metrics merge op
+# Full-corpus retrieval (serving/retrieval.py): RETR sweeps this
+# backend's corpus SHARD (body = u8 flags + u32 k + npz user features;
+# reply npz ids/scores/version/scanned), RITM ingests items (body =
+# npz '__ids__' + item features; every member receives the broadcast
+# and keeps only the rows that hash to its shard).
+OP_RETR = b"RETR"
+OP_RITM = b"RITM"
 _OK = b"OK  "
 _ERR = b"ERR "
 
@@ -260,6 +267,41 @@ class BackendServer:
             fn = getattr(self.server, "metrics_snapshot", None)
             snap = fn() if fn is not None else {"metrics": {}}
             return _OK, json.dumps(snap).encode()
+        if op == OP_RETR:
+            if len(body) < 5:
+                raise BadRequest("short RETR body")
+            if getattr(self.server, "retrieval", None) is None:
+                raise BadRequest("retrieval not enabled on this backend")
+            k = struct.unpack("<I", body[1:5])[0]
+            batch = _unpack_arrays(body[5:])
+            if not batch:
+                raise BadRequest("missing retrieval features")
+            with self._conn_lock:
+                self._inflight += 1
+            try:
+                res = self.server.retrieve_versioned(batch, int(k))
+            finally:
+                with self._conn_lock:
+                    self._inflight -= 1
+            return _OK, _pack_arrays({
+                "ids": res.ids, "scores": res.scores,
+                "__version__": np.int64(res.version),
+                "scanned": np.int64(res.scanned),
+            })
+        if op == OP_RITM:
+            rs = getattr(self.server, "retrieval", None)
+            if rs is None:
+                raise BadRequest("retrieval not enabled on this backend")
+            arrays = _unpack_arrays(body)
+            ids = arrays.pop("__ids__", None)
+            if ids is None:
+                raise BadRequest("RITM body missing '__ids__'")
+            accepted = rs.engine.upsert_items(ids, arrays)
+            return _OK, json.dumps({
+                "accepted": int(accepted),
+                "corpus_rows": rs.engine.corpus_rows(),
+                "shard": [rs.engine.shard_index, rs.engine.num_shards],
+            }).encode()
         raise BadRequest(f"unknown op {op!r}")
 
     def inflight(self) -> int:
@@ -506,6 +548,12 @@ class _FrontendPredictor:
     def __init__(self, fe: "Frontend", model):
         self._fe = fe
         self.model = model
+        # parse_features clamp accounting (negative ids, oversized bags,
+        # non-finite dense): the edge parses BEFORE routing, so the
+        # frontend keeps its own counters — without this method the
+        # clamp path would AttributeError mid-parse and abort requests
+        # the firewall is documented to clamp-and-serve.
+        self.record_errors: Dict[str, int] = {}
         self._trainer = None
         if model is not None:
             import optax
@@ -531,6 +579,17 @@ class _FrontendPredictor:
         for f in self._trainer.dense_specs:
             out[f.name] = np.dtype(np.float32)
         return out
+
+    def count_record_error(self, kind: str, n: int = 1) -> None:
+        """Same contract as Predictor.count_record_error (the parser
+        calls it on every clamp) — counted into this edge's own series."""
+        self.record_errors[kind] = self.record_errors.get(kind, 0) + n
+        if obs_metrics.metrics_enabled():
+            obs_metrics.default_registry().counter(
+                "deeprec_record_errors",
+                "malformed input records rejected/clamped by kind",
+                {"kind": kind},
+            ).inc(n)
 
     def health(self) -> Dict:
         """Worst-member health + the frontend's availability view: 'ok'
@@ -660,6 +719,16 @@ class Frontend:
                 lambda: sum(1 for m in self._members if m.draining),
                 "members draining (in-flight only, no new assignments)")
         self.update_failures = 0  # _run_poll_loop accounting
+        # Retrieval fan-out accounting: requests through the merge and
+        # how many were served PARTIAL (one or more shards missing —
+        # degraded-not-failed; surfaced through health()).
+        self._retr_requests = 0
+        self._retr_partials = 0
+        self._m_retr_partials = (
+            r.counter("deeprec_retrieval_partial_responses",
+                      "fleet retrievals served with one or more shards "
+                      "missing")
+            if r is not None else None)
         self.predictor = _FrontendPredictor(self, model)
         self._rr = itertools.count()
         self._stop = threading.Event()
@@ -927,6 +996,145 @@ class Frontend:
         self.stats.record_stage("e2e", time.monotonic() - t0)
         return probs, version
 
+    # ----------------------------------------------------------- retrieval
+
+    def retrieve_versioned(self, features: Dict[str, np.ndarray], k: int,
+                           timeout: Optional[float] = None):
+        """Full-corpus top-k across the fleet: fan one RETR frame to
+        EVERY routable member in parallel (each owns a corpus shard) and
+        lexsort-merge the per-shard answers at the edge (score desc, item
+        id asc — deterministic regardless of shard count or answer
+        order).
+
+        Degraded-not-failed: a member that dies mid-query is marked down
+        and its shard's candidates are simply missing from the merge —
+        the reply is served from the surviving shards with
+        ``partial=True``, counted in `retrieval_partials`, and visible in
+        `health()` (the down member degrades the sweep). Only a fleet
+        with ZERO answering members fails the request.
+
+        DRAINING members stay in the fan-out: corpus shards are
+        disjoint, so excluding a drainer would silently drop 1/N of the
+        catalog for the whole drain window — drain means "no new STICKY
+        assignments", and a stateless sweep of the shard it still holds
+        is exactly the in-flight work the drain protocol finishes."""
+        from deeprec_tpu.serving.retrieval import (
+            RetrievalResult,
+            merge_shard_topk,
+        )
+
+        t0 = time.monotonic()
+        if not self._members and self.registry is not None:
+            self.refresh_membership()
+        members = list(self._members)
+        if not members:
+            raise RuntimeError("no fleet members admitted")
+        # Honor failure backoff like every other routing path: a
+        # blackholed member would stall the whole merge for a connect
+        # timeout on EVERY request — skipping it yields the same
+        # partial answer without the latency cliff. With everyone
+        # backed off, try them all anyway (last resort beats failing).
+        now = time.monotonic()
+        routable = [m for m in members if m.available(now)] or members
+        body = bytes([0]) + struct.pack("<I", int(k)) + \
+            _pack_arrays(features)
+        slots: List[Optional[Dict]] = [None] * len(routable)
+
+        def sweep(i, m):
+            try:
+                status, resp = m.call(
+                    OP_RETR, body,
+                    timeout if timeout is not None else self.timeout)
+            except (OSError, ConnectionError):
+                m.mark_down()
+                return
+            if status != _OK:
+                err = json.loads(resp)
+                slots[i] = {"error": err}
+                return
+            m.mark_up()
+            slots[i] = {"arrays": _unpack_arrays(resp)}
+
+        if len(routable) == 1:
+            sweep(0, routable[0])
+        else:
+            threads = [threading.Thread(target=sweep, args=(i, m),
+                                        daemon=True)
+                       for i, m in enumerate(routable)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        answers = [s["arrays"] for s in slots if s and "arrays" in s]
+        errors = [s["error"] for s in slots if s and "error" in s]
+        self._retr_requests += 1
+        if not answers:
+            self.stats.record_error()
+            if errors and errors[0].get("kind") == "bad_request":
+                e = dict(errors[0])
+                e.pop("kind", None)
+                raise BadRequest(e.pop("error", "bad request"), **e)
+            raise RuntimeError(
+                f"retrieval failed on all {len(routable)} members "
+                f"({errors or 'unreachable'})")
+        # partial is judged against the FULL member set: a member skipped
+        # for backoff is exactly as missing from the merge as one that
+        # failed mid-call — its shard's coverage is absent either way
+        partial = len(answers) < len(members)
+        if partial:
+            self._retr_partials += 1
+            if self._m_retr_partials is not None:
+                self._m_retr_partials.inc()
+        ids, scores = merge_shard_topk(
+            [a["ids"] for a in answers],
+            [a["scores"] for a in answers], int(k))
+        version = max(int(a["__version__"]) for a in answers)  # noqa: DRT002 — version scalars decoded from wire replies, already host-side
+        scanned = sum(int(a.get("scanned", 0)) for a in answers)  # noqa: DRT002 — wire reply ints, host-side
+        self.stats.record_retrieval(1, scanned)
+        self.stats.record_stage("retrieval", time.monotonic() - t0)
+        return RetrievalResult(ids=ids, scores=scores, version=version,
+                               partial=partial, scanned=scanned)
+
+    def ingest_items(self, ids, features: Dict[str, np.ndarray],
+                     timeout: Optional[float] = None) -> Dict[str, int]:
+        """Broadcast one item batch to EVERY member (draining included —
+        ingest is data plane, not load): each backend keeps the rows that
+        hash to its corpus shard, so the broadcast partitions itself.
+        Returns {addr: accepted} for the members that answered; a member
+        that is down simply misses the batch (its shard serves stale
+        coverage until re-ingest — the degraded contract)."""
+        body = _pack_arrays({"__ids__": np.asarray(ids, np.int64),
+                             **features})
+        members = list(self._members)
+        out: Dict[str, int] = {}
+        lock = threading.Lock()
+
+        def push(m):
+            try:
+                status, resp = m.call(
+                    OP_RITM, body,
+                    timeout if timeout is not None else self.timeout)
+            except (OSError, ConnectionError):
+                m.mark_down()
+                return
+            if status == _OK:
+                with lock:
+                    out[m.addr] = json.loads(resp).get("accepted", 0)
+
+        if len(members) == 1:
+            push(members[0])
+        else:
+            # parallel like the RETR fan-out: each member's upload +
+            # chunked re-encode overlaps, so fleet ingest costs
+            # max(member time), not the serial sum
+            threads = [threading.Thread(target=push, args=(m,),
+                                        daemon=True) for m in members]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        return out
+
     def warmup(self, example: Dict[str, np.ndarray],
                group_users: bool = False,
                ladder: Optional[Sequence[int]] = None) -> int:
@@ -1042,6 +1250,26 @@ class Frontend:
             out["quality_gate_rejections"] = sum(int(v or 0) for v in qg)  # noqa: DRT002 — summing JSON ints from member health bodies, host-side
         if reachable < len(members):
             out["status"] = "degraded" if reachable else "down"
+        if self._retr_requests:
+            # Retrieval coverage view: a dead member already degrades the
+            # status above; the partial counter says how many sweeps
+            # actually served with shards missing (degraded-not-failed).
+            out["retrieval_requests"] = self._retr_requests
+            out["retrieval_partials"] = self._retr_partials
+        # Empty-shard detection: a retrieval backend that restarted lost
+        # its in-process corpus and answers sweeps with nothing — which
+        # no per-request signal catches (it IS a successful answer). One
+        # shard at 0 rows while a sibling holds items = silently missing
+        # catalog coverage, surfaced here as degraded.
+        shard_rows = [h.get("retrieval_corpus_rows") for h in healths
+                      if h["status"] != "down"
+                      and h.get("retrieval_corpus_rows") is not None]
+        if shard_rows and max(shard_rows) > 0 and min(shard_rows) == 0:
+            out["retrieval_empty_shards"] = sum(
+                1 for r in shard_rows if r == 0)
+            if out["status"] == "ok":
+                out["status"] = "degraded"
+                out["degraded_reason"] = "retrieval_shard_empty"
         return out
 
     def stats_snapshot(self) -> Dict:
@@ -1080,7 +1308,9 @@ class Frontend:
                     m.mark_down()
             members.append(entry)
         out["frontend"] = {"routed": out.pop("requests"),
-                           "errors": out["errors"]}
+                           "errors": out["errors"],
+                           "retrieval_requests": self._retr_requests,
+                           "retrieval_partials": self._retr_partials}
         out["members"] = members
         out["backend_totals"] = totals
         out["model"] = model
@@ -1194,6 +1424,8 @@ def backend_argv(
     max_batch: int = 256, max_wait_ms: float = 1.0,
     registry: Optional[str] = None, lease_secs: Optional[float] = None,
     capacity: int = 1, member_name: str = "", port: int = 0,
+    retrieval_shard: Optional[str] = None,
+    retrieval_quantize: str = "int8",
 ) -> List[str]:
     """The backend CLI argv for one serving process — shared by
     `spawn_backends`, the Supervisor-driven fleet specs (a respawn with
@@ -1212,6 +1444,9 @@ def backend_argv(
         argv += ["--model-json", model_json]
     if quantize:
         argv += ["--quantize", quantize]
+    if retrieval_shard:
+        argv += ["--retrieval", "--retrieval-shard", retrieval_shard,
+                 "--retrieval-quantize", retrieval_quantize]
     if registry:
         argv += ["--registry", registry]
         if lease_secs is not None:
@@ -1275,13 +1510,16 @@ def spawn_backends(
     registry: Optional[str] = None, lease_secs: Optional[float] = None,
     capacity: int = 1, member_name: str = "",
     env: Optional[Dict[str, str]] = None, ready_timeout: float = 180.0,
+    retrieval: bool = False, retrieval_quantize: str = "int8",
 ):
     """Launch `n` backend serving processes on this host and wait for
     their READY lines. Returns (procs, addrs) — pass `addrs` to
     `Frontend`, or pass `registry` and let the frontend discover them by
     lease instead. Used by tools/bench_serving.py, tools/bench_fleet.py
     and the fault-matrix tests; production deployments run the same CLI
-    under their own process supervisor (docs/serving.md)."""
+    under their own process supervisor (docs/serving.md).
+    `retrieval=True` additionally enables the full-corpus retrieval lane
+    with backend i owning corpus shard i of n."""
     import os
     import subprocess
 
@@ -1292,7 +1530,9 @@ def spawn_backends(
             quantize=quantize, poll_secs=poll_secs, max_batch=max_batch,
             max_wait_ms=max_wait_ms, registry=registry,
             lease_secs=lease_secs, capacity=capacity,
-            member_name=(f"{member_name}-{i}" if member_name else ""))
+            member_name=(f"{member_name}-{i}" if member_name else ""),
+            retrieval_shard=(f"{i}/{n}" if retrieval else None),
+            retrieval_quantize=retrieval_quantize)
         p = subprocess.Popen(
             argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env={**os.environ, **(env or {})},
@@ -1376,6 +1616,20 @@ def main(argv=None):
                    help="supervisor spec name stamped into the lease (the"
                         " autoscaler's retire handle)")
     p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--retrieval", action="store_true",
+                   help="backend mode: enable the full-corpus retrieval "
+                        "lane (two-tower models only; this backend owns "
+                        "the corpus shard of --retrieval-shard)")
+    p.add_argument("--retrieval-quantize", default="int8",
+                   choices=["fp32", "bf16", "int8"],
+                   help="corpus matrix residency (serving/retrieval.py)")
+    p.add_argument("--retrieval-block", type=int, default=4096,
+                   help="pow2 rows per corpus sweep block")
+    p.add_argument("--retrieval-chunk", type=int, default=1024,
+                   help="fixed encode-chunk rows (one static XLA shape)")
+    p.add_argument("--retrieval-shard", default="0/1",
+                   help="'i/n': this backend owns corpus shard i of n "
+                        "(items hash-partition across the fleet)")
     args = p.parse_args(argv)
 
     kwargs = json.loads(args.model_json) if args.model_json else {}
@@ -1403,6 +1657,16 @@ def main(argv=None):
         server = ModelServer(pred, max_batch=args.max_batch,
                              max_wait_ms=args.max_wait_ms,
                              poll_updates_secs=args.poll_secs)
+        if args.retrieval:
+            from deeprec_tpu.serving.retrieval import RetrievalEngine
+
+            si, sn = args.retrieval_shard.split("/")
+            engine = RetrievalEngine(
+                pred, quantize=args.retrieval_quantize,
+                block_rows=args.retrieval_block,
+                chunk=args.retrieval_chunk,
+                shard_index=int(si), num_shards=int(sn))  # noqa: DRT002 — parsing a shard-spec config string, not a device value
+            server.attach_retrieval(engine)
         backend = BackendServer(
             server, host=args.host, port=args.port, registry=registry,
             capacity=args.capacity, member_name=args.member_name,

@@ -15,6 +15,13 @@ Protocol:
   GET  /v1/stats     -> per-stage latency histograms (queue/pad/device/
                         post/e2e), batch shape stats, model update counters
   POST /v1/reload    -> {"updated": bool}   (poll full/delta updates now)
+  POST /v1/retrieve  {"features": {<user features>}, "k": 100}
+                  -> {"items": [[id,...]], "scores": [[...]],
+                      "model_version": V, "partial": false,
+                      "candidates_scanned": N}
+                     (full-corpus top-k, serving/retrieval.py; item
+                      features are the resident corpus — absent ones are
+                      pad-filled before parsing)
   GET  /healthz      -> 200 {"status": "ok", "staleness_seconds": ...,
                         "consecutive_poll_failures": 0, ...} — 503 with the
                         same body once the update poller is failing
@@ -154,7 +161,7 @@ class _Handler(BaseHTTPRequestHandler):
         """(server, verb) for a POST path: the single-model back-compat
         routes (/v1/predict, /v1/reload) hit the default model; the
         TF-Serving shape (/v1/models/<name>:predict|:reload) names one."""
-        if self.path in ("/v1/predict", "/v1/reload"):
+        if self.path in ("/v1/predict", "/v1/reload", "/v1/retrieve"):
             return self.model_server, self.path.rsplit("/", 1)[-1]
         if self.path.startswith("/v1/models/") and ":" in self.path:
             name, verb = self.path[len("/v1/models/"):].rsplit(":", 1)
@@ -217,6 +224,50 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # corrupt/partial checkpoint: report it
                 return self._send(500, {"error": str(e)})
             return self._send(200, {"updated": updated})
+        if verb == "retrieve":
+            # Full-corpus top-k (serving/retrieval.py): the request
+            # carries USER features only — absent item features are
+            # filled with pads before parsing (the item side is the
+            # resident corpus). Answered by the local retrieval lane or
+            # the fleet fan-out merge, whichever backs this server.
+            rv = getattr(server, "retrieve_versioned", None)
+            if rv is None:
+                return self._send(501, {"error":
+                                        "retrieval not supported here"})
+            if not isinstance(payload, dict):
+                return self._send(400, {"error":
+                                        "body must be a JSON object"})
+            from deeprec_tpu.serving.retrieval import (
+                fill_missing_item_features,
+            )
+
+            try:
+                k = int(payload.get("k", 10))
+                feats = fill_missing_item_features(
+                    server.predictor, payload.get("features"))
+                batch = parse_features(server.predictor, feats)
+            except BadRequest as e:
+                return self._send(400, e.details)
+            except (TypeError, ValueError) as e:
+                return self._send(400, {"error": str(e)})
+            try:
+                res = rv(batch, k)
+            except BadRequest as e:
+                return self._send(400, e.details)
+            except Exception as e:  # request-level failure, keep serving
+                return self._send(500, {"error": str(e)})
+            return self._send(200, {
+                "items": res.ids.tolist(),
+                # -inf marks "fewer than k valid items" (item id -1);
+                # serialize it as null — json.dumps would emit
+                # `-Infinity`, which is not RFC 8259 JSON and strict
+                # client parsers reject the whole body
+                "scores": [[round(float(s), 6) if np.isfinite(s) else None
+                            for s in row] for row in res.scores],
+                "model_version": res.version,
+                "partial": bool(res.partial),
+                "candidates_scanned": int(res.scanned),
+            })
         if verb != "predict":
             return self._send(404, {"error": f"unknown verb {verb!r}"})
         if not isinstance(payload, dict):

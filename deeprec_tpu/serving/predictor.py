@@ -317,6 +317,11 @@ class Predictor:
         # keeps serving, with health() reporting degraded:quality_gate.
         self.quality_gate = quality_gate
         self._gate_blocked = False
+        # Retrieval attachment (serving/retrieval.py): when an engine is
+        # attached, every published model update notifies it so delta
+        # replay folds changed item rows into the resident corpus matrix
+        # within the SAME poll round (freshness contract).
+        self._retrieval = None
         self._m_gate_rejections = None
         if quality_gate is not None and obs_metrics.metrics_enabled():
             self._m_gate_rejections = obs_metrics.default_registry().counter(
@@ -365,7 +370,15 @@ class Predictor:
                 return False
             self._publish(state, dirs)
             self._gate_blocked = False
+            if self._retrieval is not None:
+                # full reload: every resident item vector may have moved
+                self._retrieval.on_model_update(None, full=True)
             return True
+
+    def attach_retrieval(self, engine) -> None:
+        """Register a RetrievalEngine for model-update notifications
+        (called by the engine's own constructor)."""
+        self._retrieval = engine
 
     # ----------------------------------------------- pre-swap quality gate
 
@@ -549,6 +562,11 @@ class Predictor:
                 return False
             self._publish(state, applied)
             self._gate_blocked = False
+            if self._retrieval is not None:
+                # Fold the replayed deltas' changed item rows into the
+                # corpus inside the SAME poll round: a newly trained item
+                # is retrievable the moment this poll returns.
+                self._retrieval.on_model_update(replayed, full=False)
             self._stamp_apply_lag(replayed)
         self.update_count += 1
         self.last_update_time = time.monotonic()
@@ -582,6 +600,14 @@ class Predictor:
         now = time.monotonic()
         status = "ok" if self.consecutive_poll_failures == 0 else "degraded"
         extra = {}
+        if self._retrieval is not None:
+            # Shard-coverage signal for the fleet sweep: a retrieval
+            # backend that respawned with an EMPTY corpus (in-process
+            # mirrors die with the process; nothing re-ingests on
+            # rejoin) answers RETR "successfully" with nothing — the
+            # frontend compares this count across members and degrades
+            # when one shard is empty while siblings hold items.
+            extra["retrieval_corpus_rows"] = self._retrieval.corpus_rows()
         if self.quality_gate is not None:
             extra["quality_gate_rejections"] = self.quality_gate.rejections
             if self.quality_gate.last_rejection is not None:
@@ -954,6 +980,7 @@ class ModelServer:
                 "checkpoint")
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
+        self.retrieval = None  # RetrievalServer once attach_retrieval ran
         self._poller = None
         if poll_updates_secs > 0:
             self._poller = threading.Thread(
@@ -1178,6 +1205,24 @@ class ModelServer:
                      trace_ctx))
         return reply
 
+    def attach_retrieval(self, engine, **kwargs) -> "object":
+        """Wire a full-corpus RetrievalEngine behind this server's stats:
+        builds the coalescing RetrievalServer for the lane (one corpus
+        sweep per coalesced user batch) and exposes
+        `retrieve_versioned`. Returns the RetrievalServer."""
+        from deeprec_tpu.serving.retrieval import RetrievalServer
+
+        self.retrieval = RetrievalServer(engine, stats=self.stats, **kwargs)
+        return self.retrieval
+
+    def retrieve_versioned(self, features: Dict[str, np.ndarray], k: int,
+                           timeout: float = 30.0):
+        """Full-corpus top-k for each user row (serving/retrieval.py) —
+        the retrieval lane's analog of request_versioned."""
+        if self.retrieval is None:
+            raise BadRequest("retrieval not enabled on this server")
+        return self.retrieval.request_versioned(features, k, timeout=timeout)
+
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0,
                 group_users: bool = False):
         """Blocking predict for one (mini-)request — the process() call."""
@@ -1226,6 +1271,8 @@ class ModelServer:
         }
         out["health"] = p.health()
         out["residency"] = p.residency_info()
+        if self.retrieval is not None:
+            out["retrieval_corpus"] = self.retrieval.engine.sweep_info()
         return out
 
     def metrics_snapshot(self) -> Dict:
@@ -1237,6 +1284,8 @@ class ModelServer:
 
     def close(self):
         self._stop.set()
+        if self.retrieval is not None:
+            self.retrieval.close()
         self._worker.join(timeout=2)
 
 

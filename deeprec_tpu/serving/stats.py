@@ -11,6 +11,9 @@ steps:
   * ``device`` — the jitted predict (dispatch + device compute + D2H)
   * ``post``   — per-request slicing and reply delivery
   * ``e2e``    — enqueue to reply received (the client-visible latency)
+  * ``retrieval`` — full-corpus top-k requests end to end (the retrieval
+                 lane: user tower + blocked corpus sweep + merge; see
+                 serving/retrieval.py)
 
 One ``ServingStats`` may be shared by several ``ModelServer`` members
 (a ``ServerGroup`` passes one instance to every member), so the numbers
@@ -37,7 +40,7 @@ from deeprec_tpu.analysis.annotations import guarded_by
 from deeprec_tpu.obs import metrics as obs_metrics
 from deeprec_tpu.training.profiler import LatencyHistogram
 
-STAGES = ("queue", "pad", "device", "post", "e2e")
+STAGES = ("queue", "pad", "device", "post", "e2e", "retrieval")
 
 _COUNTERS = ("requests", "batches", "rows", "errors")
 
@@ -59,6 +62,8 @@ class ServingStats:
         self.batches = 0
         self.rows = 0
         self.errors = 0
+        self.retrieval_requests = 0
+        self.candidates_scanned = 0
 
     def _make_metrics(self) -> None:
         r = self.registry
@@ -77,10 +82,23 @@ class ServingStats:
                              f"serving front {k} total")
                 for k in _COUNTERS
             }
+            # Retrieval-lane counters (serving/retrieval.py): requests
+            # through the lane and candidate rows scanned for them (a
+            # request scanning a C-row corpus for B user rows counts
+            # B*C). Unlabeled — DRT007 cardinality contract.
+            self._retr_counters = {
+                "requests": r.counter(
+                    "deeprec_retrieval_requests",
+                    "full-corpus retrieval requests served"),
+                "candidates": r.counter(
+                    "deeprec_retrieval_candidates_scanned",
+                    "corpus candidate rows scanned by retrieval sweeps"),
+            }
         else:
             self.stage = {s: LatencyHistogram() for s in STAGES}
             self.batch_rows = LatencyHistogram(lo=1.0, hi=1 << 20)
             self._counters = None
+            self._retr_counters = None
 
     # ----------------------------------------------------------- recording
 
@@ -104,6 +122,17 @@ class ServingStats:
             self.errors += n
         if self._counters is not None:
             self._counters["errors"].inc(n)
+
+    def record_retrieval(self, n_requests: int, candidates: int) -> None:
+        """Account one coalesced retrieval dispatch: `n_requests` rode the
+        sweep, which scanned `candidates` corpus rows in total."""
+        with self._lock:
+            self.retrieval_requests += n_requests
+            self.candidates_scanned += candidates
+        c = self._retr_counters
+        if c is not None:
+            c["requests"].inc(n_requests)
+            c["candidates"].inc(candidates)
 
     # ----------------------------------------------------------- reporting
 
@@ -130,6 +159,12 @@ class ServingStats:
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
         out["stages"] = {s: h.summary() for s, h in self.stage.items()}
+        with self._lock:
+            if self.retrieval_requests:
+                out["retrieval"] = {
+                    "requests": self.retrieval_requests,
+                    "candidates_scanned": self.candidates_scanned,
+                }
         rows = self.batch_rows.summary()
         out["batch_rows"] = {
             "count": rows["count"],
@@ -154,4 +189,5 @@ class ServingStats:
                 self.registry.reset()
             self._make_metrics()
             self.requests = self.batches = self.rows = self.errors = 0
+            self.retrieval_requests = self.candidates_scanned = 0
             self._t0 = time.monotonic()

@@ -1,0 +1,389 @@
+"""Full-corpus retrieval engine (serving/retrieval.py + ops/topk.py):
+blocked top-k exactness vs argsort, deterministic tie handling across
+block sizes, k/corpus edge cases, int8 recall floors vs exact fp32
+scan, delta-replay corpus folding (targeted + zero steady-state
+compiles), and corpus growth."""
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticTwoTower
+from deeprec_tpu.models import DSSM
+from deeprec_tpu.ops.topk import blocked_topk
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.serving import Predictor, RetrievalEngine
+from deeprec_tpu.serving.predictor import parse_features
+from deeprec_tpu.serving.retrieval import (
+    fill_missing_item_features,
+    merge_shard_topk,
+)
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.training.checkpoint import CheckpointManager
+
+VOCAB = 200
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def exact_topk_np(scores, valid, k):
+    """Reference: full argsort with the engine's tie order (score desc,
+    row index asc); invalid rows lose, short corpora pad with -1."""
+    s = np.where(valid[None, :], scores, -np.inf)
+    rows = np.broadcast_to(np.arange(s.shape[1]), s.shape)
+    order = np.lexsort((rows, -s), axis=-1)[:, :k]
+    vals = np.take_along_axis(s, order, axis=1)
+    idx = np.where(np.isfinite(vals), order, -1)
+    pad = k - order.shape[1]
+    if pad > 0:
+        vals = np.concatenate(
+            [vals, np.full((s.shape[0], pad), -np.inf)], axis=1)
+        idx = np.concatenate(
+            [idx, np.full((s.shape[0], pad), -1, idx.dtype)], axis=1)
+    return vals, idx
+
+
+@pytest.mark.parametrize("block", [8, 32, 128])
+@pytest.mark.parametrize("k", [1, 5, 40])
+def test_blocked_topk_matches_argsort(block, k):
+    """Blocked streaming merge == full-scan argsort for every block
+    size, including k > block (the merge buffer is k + block wide)."""
+    rng = np.random.default_rng(0)
+    C, H, B = 256, 16, 3
+    corpus = rng.normal(size=(C, H)).astype(np.float32)
+    valid = rng.random(C) < 0.9
+    user = rng.normal(size=(B, H)).astype(np.float32)
+    vals, rows = blocked_topk(
+        jnp.asarray(user), jnp.asarray(corpus), jnp.asarray(valid), k,
+        block_rows=block)
+    ref_vals, ref_rows = exact_topk_np(user @ corpus.T, valid, k)
+    np.testing.assert_array_equal(np.asarray(rows), ref_rows)
+    np.testing.assert_allclose(np.asarray(vals)[ref_rows >= 0],
+                               ref_vals[ref_rows >= 0], rtol=1e-5)
+
+
+def test_tie_determinism_block_size_independent():
+    """Duplicate corpus rows score EQUAL — the winner must be the lowest
+    corpus row index, for every block size (the carry-precedes-block
+    merge invariant)."""
+    rng = np.random.default_rng(1)
+    H = 8
+    base = rng.normal(size=(4, H)).astype(np.float32)
+    corpus = np.tile(base, (16, 1))  # 64 rows, every vector ×16
+    valid = np.ones(64, bool)
+    user = rng.normal(size=(2, H)).astype(np.float32)
+    picks = []
+    for block in (4, 16, 64):
+        _, rows = blocked_topk(
+            jnp.asarray(user), jnp.asarray(corpus), jnp.asarray(valid),
+            8, block_rows=block)
+        picks.append(np.asarray(rows))
+    np.testing.assert_array_equal(picks[0], picks[1])
+    np.testing.assert_array_equal(picks[0], picks[2])
+    _, ref_rows = exact_topk_np(user @ corpus.T, valid, 8)
+    np.testing.assert_array_equal(picks[0], ref_rows)
+
+
+def test_topk_empty_and_overask_edges():
+    """Zero valid rows -> all -1; k past the valid count pads with -1;
+    an all-padding block never wins."""
+    rng = np.random.default_rng(2)
+    corpus = rng.normal(size=(16, 4)).astype(np.float32)
+    user = rng.normal(size=(1, 4)).astype(np.float32)
+    vals, rows = blocked_topk(
+        jnp.asarray(user), jnp.asarray(corpus),
+        jnp.zeros(16, bool), 5, block_rows=8)
+    assert (np.asarray(rows) == -1).all()
+    valid = np.zeros(16, bool)
+    valid[:3] = True
+    vals, rows = blocked_topk(
+        jnp.asarray(user), jnp.asarray(corpus), jnp.asarray(valid), 5,
+        block_rows=8)
+    rows = np.asarray(rows)
+    assert set(rows[0, :3]) == {0, 1, 2}
+    assert (rows[0, 3:] == -1).all()
+
+
+def test_merge_shard_topk_order_and_invalid():
+    ids = [np.array([[5, 3, -1]], np.int64), np.array([[4, 9, 2]], np.int64)]
+    scores = [np.array([[3.0, 1.0, -np.inf]], np.float32),
+              np.array([[3.0, 2.0, 0.5]], np.float32)]
+    out_i, out_v = merge_shard_topk(ids, scores, 4)
+    # score desc, tie on 3.0 broken by id asc (4 < 5), -1 never chosen
+    assert out_i[0].tolist() == [4, 5, 9, 3]
+    np.testing.assert_allclose(out_v[0], [3.0, 3.0, 2.0, 1.0])
+
+
+def make_stack(tmp_path, steps=8, quantize="int8", **eng_kw):
+    model = DSSM(emb_dim=8, capacity=1 << 12, num_user_feats=2,
+                 num_item_feats=2, hidden=(16, 8))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(batch_size=256, num_user=2, num_item=2,
+                            vocab=VOCAB, seed=3)
+    for _ in range(steps):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+    pred = Predictor(model, str(tmp_path))
+    eng = RetrievalEngine(pred, quantize=quantize, block_rows=256,
+                          chunk=128, **eng_kw)
+    return model, tr, st, ck, gen, pred, eng
+
+
+def make_items(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = np.arange(1, n + 1, dtype=np.int64)
+    feats = {"V0": VOCAB + rng.integers(0, VOCAB, size=n),
+             "V1": 2 * VOCAB + rng.integers(0, VOCAB, size=n)}
+    return ids, feats
+
+
+def user_batch(pred, gen, rows=4):
+    b = gen.batch()
+    user = {k: np.asarray(v)[:rows] for k, v in b.items()
+            if k.startswith("U")}
+    return parse_features(pred, fill_missing_item_features(pred, user))
+
+
+def test_engine_recall_floor_vs_exact_fp32(tmp_path):
+    """int8 blocked sweep vs exact fp32 full scan over the SAME item
+    vectors: tie-aware recall@{10,100} floors (identical-vector items
+    are interchangeable answers)."""
+    _, _, _, _, gen, pred, eng8 = make_stack(tmp_path)
+    eng32 = RetrievalEngine(pred, quantize="fp32", block_rows=256,
+                            chunk=128)
+    ids, feats = make_items(5000)
+    eng8.upsert_items(ids, feats)
+    eng32.upsert_items(ids, feats)
+    batch = user_batch(pred, gen, rows=8)
+    hids, hv = eng32.host_vectors()
+    uvec = np.asarray(eng32._user_jit(pred._snap.state, J(batch)))[:8]
+    exact = uvec @ hv.T
+    res = eng8.retrieve(batch, 100)
+    cols = np.searchsorted(hids, res.ids)
+    got = np.take_along_axis(exact, np.clip(cols, 0, exact.shape[1] - 1),
+                             axis=1)
+    got = np.where(res.ids >= 0, got, -np.inf)
+    for k in (10, 100):
+        kth = -np.partition(-exact, k - 1, axis=1)[:, k - 1]
+        recall = float((got[:, :k] >= kth[:, None] - 1e-6).mean())
+        assert recall >= 0.95, (k, recall)
+    # the fp32 engine against its own vectors is EXACT (tie order and all)
+    res32 = eng32.retrieve(batch, 50)
+    _, ref_rows = exact_topk_np(exact, np.ones(exact.shape[1], bool), 50)
+    np.testing.assert_array_equal(res32.ids, hids[ref_rows])
+
+
+def test_engine_empty_one_block_and_growth(tmp_path):
+    """Empty corpus serves all -1 (never raises); a one-block corpus
+    works; ingest past capacity grows by pow2 blocks and retrieval stays
+    exact over the grown matrix."""
+    _, _, _, _, gen, pred, eng = make_stack(tmp_path)
+    batch = user_batch(pred, gen)
+    res = eng.retrieve(batch, 5)
+    assert (res.ids == -1).all() and res.scanned == 0
+    ids, feats = make_items(10)
+    eng.upsert_items(ids, feats)
+    res = eng.retrieve(batch, 20)
+    assert set(res.ids[0][res.ids[0] >= 0]) == set(ids.tolist())
+    assert (res.ids[0] == -1).sum() == 10  # k past the corpus pads -1
+    cap0 = eng.capacity
+    ids2, feats2 = make_items(cap0 + 100, seed=7)
+    eng.upsert_items(ids2, feats2)
+    assert eng.capacity > cap0 and eng.capacity % eng.block_rows == 0
+    assert eng.corpus_rows() == cap0 + 100
+    res = eng.retrieve(batch, 10)
+    assert (res.ids >= 0).all()
+    # sweep accounting stays exact after growth
+    si = eng.sweep_info()
+    assert si["measured_bytes"] == si["modeled_bytes"]
+
+
+def frozen_dense_trainer(model, tr, st, tmp_path):
+    """The sparse-only online-update regime (embeddings train, towers
+    frozen) — the regime where the targeted corpus fold is sound. Same
+    checkpoint chain, fresh manager over the same dir."""
+    import optax as _optax
+
+    from deeprec_tpu.training.trainer import TrainState
+
+    tr2 = Trainer(model, Adagrad(lr=0.1), _optax.set_to_zero())
+    st2 = TrainState(step=st.step, tables=st.tables, dense=st.dense,
+                     opt_state=tr2.dense_opt.init(st.dense))
+    return tr2, st2, CheckpointManager(str(tmp_path), tr2)
+
+
+def test_delta_fold_targets_changed_items_and_zero_compiles(tmp_path):
+    """With the item tower frozen (sparse-only online updates), delta
+    replay folds ONLY the corpus rows whose item keys the delta touched,
+    inside the same poll round — and the steady-state fold + retrieve
+    compiles NOTHING (trace-guard, the PR 5 contract on the retrieval
+    lane)."""
+    from deeprec_tpu.analysis.trace_guard import trace_guard
+
+    model, tr0, st0, ck0, gen, pred, eng = make_stack(tmp_path)
+    tr, st, ck = frozen_dense_trainer(model, tr0, st0, tmp_path)
+    ids, feats = make_items(1000)
+    # give items 0..9 reserved V0/V1 ids the bulk corpus never uses, so
+    # a delta training ONLY those ids dirties exactly those ten rows
+    res0, res1 = 2 * VOCAB - 1, 3 * VOCAB - 1
+    feats = {k: v.copy() for k, v in feats.items()}
+    feats["V0"][10:] = VOCAB + (feats["V0"][10:] % (VOCAB - 1))
+    feats["V1"][10:] = 2 * VOCAB + (feats["V1"][10:] % (VOCAB - 1))
+    feats["V0"][:10] = res0
+    feats["V1"][:10] = res1
+    eng.upsert_items(ids, feats)
+    batch = user_batch(pred, gen)
+
+    def land_delta(targeted):
+        nonlocal st
+        for _ in range(2):
+            b = gen.batch()
+            if targeted:
+                b["V0"] = np.full_like(b["V0"], res0)
+                b["V1"] = np.full_like(b["V1"], res1)
+            st2, _ = tr.train_step(st, J(b))
+            st = st2
+        st2, _ = ck.save_incremental(st)
+        st = st2
+
+    before = np.asarray(eng._corpus.vecs).copy()
+    land_delta(targeted=True)
+    assert pred.poll_updates()
+    assert eng.last_fold is not None
+    assert eng.last_fold["rows"] == 10, eng.last_fold
+    changed = np.nonzero(
+        (np.asarray(eng._corpus.vecs) != before).any(axis=1))[0]
+    assert set(changed.tolist()) <= set(range(10))
+    # steady state: second targeted delta + retrieve under the guard
+    eng.retrieve(batch, 10)
+    land_delta(targeted=True)
+    with trace_guard(max_compiles=None) as g:
+        assert pred.poll_updates()
+        res = eng.retrieve(batch, 10)
+    assert g.compiles == 0, "corpus fold retraced in steady state"
+    assert res.version == pred.version
+    # fold parity: the folded rows decode exactly what a fresh encode of
+    # the same rows produces (same program, same state)
+    eng2 = RetrievalEngine(pred, quantize="int8", block_rows=256,
+                           chunk=128)
+    eng2.upsert_items(ids, feats)
+    np.testing.assert_array_equal(np.asarray(eng._corpus.vecs)[:1000],
+                                  np.asarray(eng2._corpus.vecs)[:1000])
+
+
+def test_full_reload_refreshes_whole_corpus(tmp_path):
+    """A full checkpoint reload marks every resident row dirty (any
+    vector may have moved)."""
+    model, tr, st, ck, gen, pred, eng = make_stack(tmp_path)
+    ids, feats = make_items(500)
+    eng.upsert_items(ids, feats)
+    for _ in range(2):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    st, _ = ck.save(st)
+    assert pred.poll_updates()
+    assert eng.last_fold["full"] and eng.last_fold["rows"] == 500
+
+
+def test_dense_tower_drift_escalates_fold_to_full(tmp_path):
+    """A delta that moved the item tower's DENSE params invalidates
+    every resident vector — the fold must escalate to a full re-encode
+    (key-targeted folding would serve stale vectors for every untouched
+    item), and the refreshed corpus must match a fresh engine's encode
+    of the post-delta state bit-for-bit."""
+    model, tr, st, ck, gen, pred, eng = make_stack(tmp_path)
+    ids, feats = make_items(300)
+    eng.upsert_items(ids, feats)
+    for _ in range(2):  # adam trainer: dense moves every step
+        st, _ = tr.train_step(st, J(gen.batch()))
+    st, _ = ck.save_incremental(st)
+    assert pred.poll_updates()
+    assert eng.last_fold["dense_drift"] and eng.last_fold["full"]
+    assert eng.last_fold["rows"] == 300
+    eng2 = RetrievalEngine(pred, quantize="int8", block_rows=256,
+                           chunk=128)
+    eng2.upsert_items(ids, feats)
+    np.testing.assert_array_equal(np.asarray(eng._corpus.vecs)[:300],
+                                  np.asarray(eng2._corpus.vecs)[:300])
+
+
+def test_upsert_updates_existing_and_shards_partition(tmp_path):
+    """Re-ingesting an id keeps its row (and re-encodes it with the new
+    features); sharded engines keep disjoint, exhaustive subsets."""
+    _, _, _, _, gen, pred, eng = make_stack(tmp_path)
+    ids, feats = make_items(100)
+    assert eng.upsert_items(ids, feats) == 100
+    rows0 = eng.corpus_rows()
+    feats2 = {k: v.copy() for k, v in feats.items()}
+    feats2["V0"][:] = VOCAB + 1
+    assert eng.upsert_items(ids[:10], {k: v[:10] for k, v in feats2.items()}) == 10
+    assert eng.corpus_rows() == rows0  # updated in place, no new rows
+    shards = [RetrievalEngine(pred, quantize="fp32", block_rows=256,
+                              chunk=128, shard_index=i, num_shards=2)
+              for i in range(2)]
+    counts = [s.upsert_items(ids, feats) for s in shards]
+    assert sum(counts) == 100 and all(c > 0 for c in counts)
+    all_ids = np.concatenate([s.host_vectors()[0] for s in shards])
+    assert sorted(all_ids.tolist()) == ids.tolist()
+
+
+def test_retrieval_server_coalesces_and_accounts(tmp_path):
+    """Concurrent requests through the RetrievalServer share sweeps and
+    land in the stats plane: retrieval stage histogram + candidates
+    counter + corpus gauges."""
+    import threading
+
+    from deeprec_tpu.serving import ModelServer
+
+    _, _, _, _, gen, pred, eng = make_stack(tmp_path)
+    ids, feats = make_items(800)
+    eng.upsert_items(ids, feats)
+    ms = ModelServer(pred, max_batch=64, max_wait_ms=1.0)
+    rs = ms.attach_retrieval(eng)
+    batch = user_batch(pred, gen, rows=2)
+    rs.engine.warmup(batch, k=8)
+    outs = [None] * 6
+
+    def call(i):
+        outs[i] = ms.retrieve_versioned(batch, 8)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(o is not None and o.ids.shape == (2, 8) for o in outs)
+    snap = ms.stats_snapshot()
+    assert snap["retrieval"]["requests"] == 6
+    assert snap["retrieval"]["candidates_scanned"] > 0
+    assert snap["stages"]["retrieval"]["count"] == 6
+    assert snap["retrieval_corpus"]["corpus_rows"] == 800
+    assert (snap["retrieval_corpus"]["measured_bytes"]
+            == snap["retrieval_corpus"]["modeled_bytes"])
+    if ms.stats.registry is not None:
+        text = ms.metrics_text()
+        assert "deeprec_retrieval_corpus_rows" in text
+        assert "deeprec_retrieval_candidates_scanned" in text
+    ms.close()
+
+
+def test_non_two_tower_model_raises(tmp_path):
+    import jax.numpy as jnp  # noqa: F401
+
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.models import WDL
+
+    model = WDL(emb_dim=8, capacity=1 << 10, hidden=(16,), num_cat=2,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=64, num_cat=2, num_dense=2,
+                          vocab=500, seed=1)
+    st, _ = tr.train_step(st, J(gen.batch()))
+    CheckpointManager(str(tmp_path), tr).save(st)
+    pred = Predictor(model, str(tmp_path))
+    with pytest.raises(ValueError, match="two-tower"):
+        RetrievalEngine(pred)

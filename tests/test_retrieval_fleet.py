@@ -1,0 +1,325 @@
+"""Fleet-path retrieval (frontend RETR/RITM fan-out + edge merge):
+2-shard merge parity vs single-shard exact, member death mid-query ->
+partial top-k served + health degraded (never a failed request), and
+sticky grouped PRED routing unaffected by the new ops."""
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticTwoTower
+from deeprec_tpu.models import DSSM
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.serving import (
+    BackendServer,
+    Frontend,
+    ModelServer,
+    Predictor,
+    RetrievalEngine,
+)
+from deeprec_tpu.serving.predictor import parse_features
+from deeprec_tpu.serving.retrieval import fill_missing_item_features
+
+VOCAB = 200
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    tmp = str(tmp_path_factory.mktemp("retr-fleet"))
+    model = DSSM(emb_dim=8, capacity=1 << 12, num_user_feats=2,
+                 num_item_feats=2, hidden=(16, 8))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(batch_size=256, num_user=2, num_item=2,
+                            vocab=VOCAB, seed=3)
+    for _ in range(8):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    CheckpointManager(tmp, tr).save(st)
+    return tmp, model, gen
+
+
+def make_items(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = np.arange(1, n + 1, dtype=np.int64)
+    return ids, {"V0": VOCAB + rng.integers(0, VOCAB, size=n),
+                 "V1": 2 * VOCAB + rng.integers(0, VOCAB, size=n)}
+
+
+def spawn_fleet(tmp, model, shards=2):
+    backends = []
+    for i in range(shards):
+        p = Predictor(model, tmp)
+        ms = ModelServer(p, max_batch=64, max_wait_ms=0.5)
+        ms.attach_retrieval(RetrievalEngine(
+            p, quantize="int8", block_rows=256, chunk=128,
+            shard_index=i, num_shards=shards))
+        backends.append(BackendServer(ms, port=0).start())
+    fe = Frontend([("127.0.0.1", b.port) for b in backends], model)
+    return backends, fe
+
+
+def user_batch(pred, gen, rows=4):
+    b = gen.batch()
+    user = {k: np.asarray(v)[:rows] for k, v in b.items()
+            if k.startswith("U")}
+    return parse_features(pred, fill_missing_item_features(pred, user))
+
+
+def test_two_shard_merge_parity_and_kill_partial(trained):
+    tmp, model, gen = trained
+    backends, fe = spawn_fleet(tmp, model)
+    try:
+        ids, feats = make_items(2000)
+        acc = fe.ingest_items(ids, feats)
+        # broadcast ingest partitions itself: disjoint, exhaustive
+        assert len(acc) == 2 and sum(acc.values()) == 2000
+        assert all(v > 0 for v in acc.values())
+
+        ref_pred = Predictor(model, tmp)
+        ref = RetrievalEngine(ref_pred, quantize="int8", block_rows=256,
+                              chunk=128)
+        ref.upsert_items(ids, feats)
+        batch = user_batch(ref_pred, gen)
+        res_fleet = fe.retrieve_versioned(batch, 10)
+        res_ref = ref.retrieve(batch, 10)
+        assert not res_fleet.partial
+        assert res_fleet.scanned == res_ref.scanned == 2000 * 4
+        for i in range(4):
+            assert set(res_fleet.ids[i].tolist()) == \
+                set(res_ref.ids[i].tolist()), i
+            np.testing.assert_allclose(
+                np.sort(res_fleet.scores[i]), np.sort(res_ref.scores[i]),
+                rtol=1e-5)
+
+        # the frontend surfaces retrieval accounting
+        snap = fe.stats_snapshot()
+        assert snap["frontend"]["retrieval_requests"] == 1
+        assert snap["frontend"]["retrieval_partials"] == 0
+
+        # member death mid-query: partial top-k served, never a failed
+        # request; health degrades but answers keep flowing
+        backends[0].stop(unregister=False)  # process-death stand-in
+        res_part = fe.retrieve_versioned(batch, 10)
+        assert res_part.partial
+        assert (res_part.ids >= 0).all()  # surviving shard fills k=10
+        surviving = set(backends[1].server.retrieval.engine
+                        .host_vectors()[0].tolist())
+        assert set(res_part.ids.ravel().tolist()) <= surviving
+        h = fe.predictor.health()
+        assert h["status"] in ("degraded", "down")
+        assert h["reachable"] == 1
+        assert h["retrieval_partials"] == 1
+        # follow-up sweeps skip the backed-off member (no connect stall)
+        # but STILL report partial — its shard is missing either way
+        res_next = fe.retrieve_versioned(batch, 10)
+        assert res_next.partial
+        assert set(res_next.ids.ravel().tolist()) <= surviving
+    finally:
+        for b in backends:
+            try:
+                b.stop()
+            except Exception:
+                pass
+        fe.close()
+
+
+def test_retr_op_leaves_grouped_routing_sticky(trained):
+    """Grouped PRED requests route on the consistent-hash ring keyed by
+    user payload; interleaving RETR fan-outs (which touch EVERY member)
+    must not perturb that stickiness — one user keeps landing on one
+    backend."""
+    tmp, model, gen = trained
+    backends, fe = spawn_fleet(tmp, model)
+    try:
+        ids, feats = make_items(500)
+        fe.ingest_items(ids, feats)
+        b = gen.batch()
+
+        def grouped_req(u):
+            req = {}
+            for k, v in b.items():
+                if k.startswith("label"):
+                    continue
+                v = np.asarray(v)
+                req[k] = (np.repeat(v[u:u + 1], 4, axis=0)
+                          if k in model.user_feats else v[u * 4:(u + 1) * 4])
+            return req
+
+        owners = {}
+        for u in range(4):
+            fe.request(grouped_req(u), group_users=True)
+            key = fe._group_key(grouped_req(u))
+            owners[u] = fe._ring.preference(key)[0]
+        ubatch = user_batch(Predictor(model, tmp), gen)
+        for _ in range(3):  # RETR sweeps hit EVERY member
+            fe.retrieve_versioned(ubatch, 5)
+        for u in range(4):
+            fe.request(grouped_req(u), group_users=True)
+            assert fe._ring.preference(fe._group_key(grouped_req(u)))[0] \
+                == owners[u], f"user {u} remapped by RETR traffic"
+        assert {e["addr"]: e["requests"] for e in
+                (m.snapshot() for m in fe._members)}  # members all alive
+    finally:
+        for srv in backends:
+            srv.stop()
+        fe.close()
+
+
+def test_draining_member_stays_in_retrieval_fanout(trained):
+    """Corpus shards are disjoint: a DRAINING member (rolling restart)
+    must keep answering RETR sweeps — excluding it would silently drop
+    1/N of the catalog for the whole drain window with partial=False."""
+    tmp, model, gen = trained
+    backends, fe = spawn_fleet(tmp, model)
+    try:
+        ids, feats = make_items(1000)
+        fe.ingest_items(ids, feats)
+        batch = user_batch(Predictor(model, tmp), gen)
+        full = fe.retrieve_versioned(batch, 10)
+        fe._members[0].draining = True  # what the membership sweep sets
+        drained = fe.retrieve_versioned(batch, 10)
+        assert not drained.partial
+        assert drained.scanned == full.scanned  # both shards swept
+        for i in range(len(drained.ids)):
+            assert set(drained.ids[i].tolist()) == \
+                set(full.ids[i].tolist())
+    finally:
+        for b in backends:
+            b.stop()
+        fe.close()
+
+
+def test_empty_shard_after_restart_degrades_health(trained):
+    """A retrieval backend that respawned lost its in-process corpus and
+    answers sweeps 'successfully' with nothing — health must surface the
+    missing coverage (degraded: retrieval_shard_empty) even though every
+    request succeeds."""
+    tmp, model, gen = trained
+    backends, fe = spawn_fleet(tmp, model)
+    try:
+        ids, feats = make_items(600)
+        # ingest ONLY into shard 1's engine — shard 0 stands in for a
+        # freshly respawned member with an empty corpus
+        backends[1].server.retrieval.engine.upsert_items(ids, feats)
+        batch = user_batch(Predictor(model, tmp), gen)
+        res = fe.retrieve_versioned(batch, 5)
+        assert not res.partial  # every member answered — that's the trap
+        h = fe.predictor.health()
+        assert h["status"] == "degraded", h
+        assert h.get("degraded_reason") == "retrieval_shard_empty", h
+        assert h["retrieval_empty_shards"] == 1
+    finally:
+        for b in backends:
+            b.stop()
+        fe.close()
+
+
+def test_frontend_http_clamps_bad_ids_instead_of_crashing(trained):
+    """The parse_features firewall through a FRONTEND-backed HttpServer:
+    a negative user id must clamp-and-serve (counted), not
+    AttributeError inside the parser (_FrontendPredictor implements the
+    count_record_error contract the parser calls)."""
+    import json
+    import urllib.request
+
+    from deeprec_tpu.serving import HttpServer
+
+    tmp, model, gen = trained
+    backends, fe = spawn_fleet(tmp, model)
+    http = HttpServer(fe, port=0).start()
+    try:
+        ids, feats = make_items(200)
+        fe.ingest_items(ids, feats)
+        b = gen.batch()
+        user = {k: np.asarray(v)[:2].tolist() for k, v in b.items()
+                if k.startswith("U")}
+        user["U0"][0] = -7  # negative id: clamp to pad, never a crash
+        body = json.dumps({"features": user, "k": 5}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/retrieve", data=body,
+            headers={"Content-Type": "application/json"}, method="POST"),
+            timeout=30)
+        out = json.loads(r.read())
+        assert len(out["items"]) == 2 and len(out["items"][0]) == 5
+        assert fe.predictor.record_errors.get("bad_id") == 1
+    finally:
+        http.stop()
+        for srv in backends:
+            srv.stop()
+        fe.close()
+
+
+def test_backend_without_retrieval_rejects_retr(trained):
+    tmp, model, gen = trained
+    p = Predictor(model, tmp)
+    ms = ModelServer(p, max_batch=16, max_wait_ms=0.5)
+    backend = BackendServer(ms, port=0).start()
+    fe = Frontend([("127.0.0.1", backend.port)], model)
+    try:
+        from deeprec_tpu.serving.predictor import BadRequest
+
+        batch = user_batch(p, gen)
+        with pytest.raises(BadRequest, match="retrieval not enabled"):
+            fe.retrieve_versioned(batch, 5)
+    finally:
+        backend.stop()
+        fe.close()
+
+
+def test_http_retrieve_route(trained):
+    """POST /v1/retrieve end to end: user-only features, pad-filled item
+    side, JSON answer with items/scores/version/partial."""
+    import json
+    import urllib.request
+
+    from deeprec_tpu.serving import HttpServer
+
+    tmp, model, gen = trained
+    p = Predictor(model, tmp)
+    ms = ModelServer(p, max_batch=16, max_wait_ms=0.5)
+    ms.attach_retrieval(RetrievalEngine(p, quantize="int8",
+                                        block_rows=256, chunk=128))
+    ids, feats = make_items(300)
+    ms.retrieval.engine.upsert_items(ids, feats)
+    http = HttpServer(ms, port=0).start()
+    try:
+        b = gen.batch()
+        user = {k: np.asarray(v)[:2].tolist() for k, v in b.items()
+                if k.startswith("U")}
+        body = json.dumps({"features": user, "k": 7}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/retrieve", data=body,
+            headers={"Content-Type": "application/json"}, method="POST"),
+            timeout=30)
+        out = json.loads(r.read())
+        assert len(out["items"]) == 2 and len(out["items"][0]) == 7
+        assert all(i in set(ids.tolist()) for i in out["items"][0])
+        assert out["partial"] is False
+        assert out["candidates_scanned"] == 600
+        assert "model_version" in out
+        # k past the corpus: ids pad -1 and scores serialize as null
+        # (json.dumps would emit non-RFC `-Infinity` for -inf)
+        body = json.dumps({"features": user, "k": 400}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/v1/retrieve", data=body,
+            headers={"Content-Type": "application/json"}, method="POST"),
+            timeout=30)
+        wide = json.loads(r.read().decode())  # strict: text was valid JSON
+        assert wide["items"][0][-1] == -1
+        assert wide["scores"][0][-1] is None
+        assert all(s is not None for s in wide["scores"][0][:300])
+        # /v1/stats covers the lane
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/v1/stats", timeout=10).read())
+        assert stats["retrieval"]["requests"] == 2
+        assert stats["retrieval_corpus"]["corpus_rows"] == 300
+    finally:
+        http.stop()
+        ms.close()

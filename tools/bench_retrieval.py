@@ -1,0 +1,464 @@
+"""Full-corpus retrieval bench: blocked top-k over the resident
+quantized item matrix (serving/retrieval.py + ops/topk.py).
+
+Measures the whole retrieval contract end to end and records it as the
+`retrieval` section of RETRIEVAL_BENCH.json, gated in CI by
+`roofline.py --assert-retrieval`:
+
+  * **qps × corpus size** — user queries/sec through the coalesced
+    sweep at 1M–10M items, int8 vs fp32 residency arms (plus corpus
+    build time: ingest + fixed-chunk encode).
+  * **recall@k vs exact scoring** — the int8 blocked sweep against an
+    exact fp32 full-scan argsort over the same item vectors.
+  * **block-size curve** — sweep qps across block_rows settings.
+  * **sweep vs per-row gather** — the resident sweep against the
+    pointwise baseline that re-gathers item rows and re-runs the item
+    tower per query (what serving full-corpus scoring costs WITHOUT the
+    resident matrix); the gate pins the sweep ≥ 3× at the 1M smoke
+    shape.
+  * **freshness** — a delta checkpoint lands under a live poller; the
+    lag from trainer commit to the corpus fold that makes the changed
+    items retrievable, against the predictor's own pinned
+    train_to_serve lag (gate: retrievable ≤ 2× pinned).
+  * **residency + compiles** — measured-vs-modeled sweep bytes
+    (ops/traffic.py retrieval_sweep_bytes, exact equality) and zero
+    steady-state XLA compiles across delta replay folding into the
+    corpus (trace-guard, the PR 5 contract).
+
+Run:  python tools/bench_retrieval.py [--corpus 1000000,10000000]
+          [--seconds 3] [--k 100] [--out RETRIEVAL_BENCH.json]
+`--smoke` runs the 1M-item shape of every arm with short windows and
+asserts structure (CI; the numeric gates live in roofline.py).
+
+On a TPU host run WITHOUT JAX_PLATFORMS=cpu to sweep from the chip.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# Two-tower stimulus: asymmetric (heavy user tower, cheap item
+# projection — the regime where one user pass amortizing over the whole
+# corpus pays), item vocab sized so item-feature combinations cover 10M
+# distinct items.
+MODEL_ARGS = dict(emb_dim=16, capacity=1 << 16, num_user_feats=4,
+                  num_item_feats=2, hidden=(32, 16),
+                  user_hidden=(256, 64, 16))
+VOCAB = 4096
+ZIPF_A = 1.2
+# Raw item-id band reserved for the freshness arm: the bulk corpus
+# never uses these ids, so a delta that trains them dirties ONLY the
+# freshly ingested probe items — the fold is the targeted ingest->
+# retrievable path, not a full re-encode.
+FRESH_BAND = 64
+
+
+def build(tmp, steps=8):
+    import jax.numpy as jnp
+    import optax
+
+    from deeprec_tpu.data import SyntheticTwoTower
+    from deeprec_tpu.models import DSSM
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    model = DSSM(**MODEL_ARGS)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(2e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(
+        batch_size=512, num_user=MODEL_ARGS["num_user_feats"],
+        num_item=MODEL_ARGS["num_item_feats"], vocab=VOCAB,
+        zipf_a=ZIPF_A, seed=17)
+    for _ in range(steps):
+        st, _ = tr.train_step(st, {k: jnp.asarray(v)
+                                   for k, v in gen.batch().items()})
+    ck = CheckpointManager(tmp, tr)
+    st, _ = ck.save(st)
+    rng = np.random.default_rng(99)
+
+    # Online phase: sparse-only updates (embeddings train, towers
+    # frozen) — the regime where the targeted corpus fold is sound; a
+    # delta that moved the dense item tower escalates the fold to a full
+    # re-encode (serving/retrieval.py dense fingerprint), which is the
+    # full-retrain -> full-reload path, not the online steady state.
+    from deeprec_tpu.training.trainer import TrainState
+
+    tr2 = Trainer(model, Adagrad(lr=0.1), optax.set_to_zero())
+    st = TrainState(step=st.step, tables=st.tables, dense=st.dense,
+                    opt_state=tr2.dense_opt.init(st.dense))
+    ck2 = CheckpointManager(tmp, tr2)
+
+    def save_delta(targeted=False):
+        """Train 2 sparse-only steps and land an incremental checkpoint.
+        `targeted` confines the steps' ITEM ids to the reserved
+        freshness band, so the delta's item-table keys touch only the
+        probe items."""
+        nonlocal st
+        for _ in range(2):
+            b = gen.batch()
+            if targeted:
+                for i in range(MODEL_ARGS["num_item_feats"]):
+                    raw = rng.integers(VOCAB - FRESH_BAND, VOCAB,
+                                       size=len(b["label"]))
+                    b[f"V{i}"] = ((i + 1) * VOCAB + raw).astype(np.int32)
+            st, _ = tr2.train_step(st, {k: jnp.asarray(v)
+                                        for k, v in b.items()})
+        st, _ = ck2.save_incremental(st)
+
+    save_delta()  # prime trainer-side incremental-save programs
+    return model, gen, save_delta
+
+
+def make_items(n, seed=0):
+    """Corpus: n distinct items whose feature ids follow the TRAINED
+    zipf distribution (head items carry learned vectors, the long tail
+    rides initializer/default rows — the production shape)."""
+    from deeprec_tpu.data.synthetic import zipf_ids
+
+    rng = np.random.default_rng(seed)
+    ids = np.arange(1, n + 1, dtype=np.int64)
+    feats = {}
+    for i in range(MODEL_ARGS["num_item_feats"]):
+        raw = np.minimum(zipf_ids(rng, VOCAB, ZIPF_A, (n,)),
+                         VOCAB - FRESH_BAND - 1)  # keep the band free
+        feats[f"V{i}"] = (raw + (i + 1) * VOCAB).astype(np.int32)
+    return ids, feats
+
+
+def make_user_batch(pred, gen, rows):
+    from deeprec_tpu.serving.predictor import parse_features
+    from deeprec_tpu.serving.retrieval import fill_missing_item_features
+
+    b = gen.batch()
+    user = {k: np.asarray(v)[:rows] for k, v in b.items()
+            if k.startswith("U")}
+    return parse_features(pred, fill_missing_item_features(pred, user))
+
+
+def measure_qps(engine, batch, k, seconds):
+    """Closed-loop sweep rate: queries (user rows)/sec and sweeps/sec."""
+    engine.retrieve(batch, k)  # warm the bucket outside the window
+    rows = len(next(iter(batch.values())))
+    sweeps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        engine.retrieve(batch, k)
+        sweeps += 1
+    dt = time.perf_counter() - t0
+    return {"qps": round(rows * sweeps / dt, 2),
+            "sweeps_per_sec": round(sweeps / dt, 3),
+            "rows_per_sweep": rows}
+
+
+def gather_baseline(pred, engine_fp32, batch, k, reps=2):
+    """The per-row-gather full-corpus baseline: no resident matrix —
+    every query re-gathers the item rows and re-runs the item tower over
+    the WHOLE corpus in fixed chunks (the engine's own encode program,
+    so the comparison is tower-for-tower honest), then scores + merges
+    host-side. This is what pointwise serving would pay to score the
+    catalog; the resident blocked sweep exists to beat it."""
+    import jax.numpy as jnp
+
+    eng = engine_fp32
+    state = pred._snap.state
+    jb = {kk: jnp.asarray(v) for kk, v in batch.items()}
+    uvec = np.asarray(eng._user_jit(state, jb))
+    rows = uvec.shape[0]
+    n = eng.corpus_rows()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        best_v = np.full((rows, k), -np.inf, np.float32)
+        best_i = np.full((rows, k), -1, np.int64)
+        for off in range(0, n, eng.chunk):
+            sl = np.arange(off, min(off + eng.chunk, n))
+            ix = np.zeros((eng.chunk,), np.int64)
+            ix[:sl.size] = sl
+            chunk_batch = {}
+            for name, tmpl in eng._templates.items():
+                col = (eng._h_feats[name][ix] if name in eng._h_feats
+                       else np.repeat(tmpl, eng.chunk, axis=0))
+                chunk_batch[name] = jnp.asarray(col)
+            vecs, _ = eng._encode_jit(state, chunk_batch)
+            scores = uvec @ np.asarray(vecs).T[:, :sl.size]
+            allv = np.concatenate([best_v, scores], axis=1)
+            alli = np.concatenate(
+                [best_i, np.broadcast_to(eng._h_ids[sl], scores.shape)],
+                axis=1)
+            top = np.argpartition(-allv, k - 1, axis=1)[:, :k]
+            best_v = np.take_along_axis(allv, top, axis=1)
+            best_i = np.take_along_axis(alli, top, axis=1)
+    dt = (time.perf_counter() - t0) / reps
+    return {"gather_qps": round(rows / dt, 3),
+            "seconds_per_query_batch": round(dt, 3)}
+
+
+def recall_arm(pred, eng8, eng32, gen, queries, k_list):
+    """int8 blocked sweep vs exact fp32 full-scan argsort (the fp32
+    engine's item vectors ARE the exact reference — same tower, no
+    quantization, no blocking). Tie-aware recall (the ANN-benchmark
+    definition): a retrieved item counts as a hit when its EXACT score
+    reaches the exact k-th score — items whose fp32 scores tie exactly
+    (zipf-head items sharing feature values encode identical vectors)
+    are interchangeable answers, not misses."""
+    import jax.numpy as jnp
+
+    batch = make_user_batch(pred, gen, queries)
+    hids, hv = eng32.host_vectors()
+    uvec = np.asarray(eng32._user_jit(
+        pred._snap.state, {kk: jnp.asarray(v) for kk, v in batch.items()}))
+    exact = uvec @ hv.T  # [Q, C] fp32 full scan
+    out = {"queries": queries}
+    kmax = max(k_list)
+    res = eng8.retrieve(batch, kmax)
+    # exact scores of the retrieved ids: hids is ascending by
+    # construction (ids 1..N ingested in order), so id -> column is one
+    # searchsorted
+    cols = np.searchsorted(hids, res.ids)
+    got_exact = np.take_along_axis(
+        exact, np.clip(cols, 0, exact.shape[1] - 1), axis=1)
+    got_exact = np.where(res.ids >= 0, got_exact, -np.inf)
+    for k in k_list:
+        kth = -np.partition(-exact, k - 1, axis=1)[:, k - 1]
+        hits = got_exact[:, :k] >= kth[:, None] - 1e-6
+        out[f"recall_at_{k}"] = round(float(hits.mean()), 4)
+    return out
+
+
+def freshness_arm(pred, engine, save_delta, poll_secs=0.2,
+                  timeout=30.0):
+    """The ingest->retrievable lag: ingest NEW probe items (reserved id
+    band), land a delta that trains exactly those items under a live
+    poll loop, and measure trainer-commit -> corpus-fold — the instant
+    the probe items' trained vectors became retrievable (the fold runs
+    INSIDE the same poll round that swapped the model, and it re-encodes
+    only the rows the delta touched)."""
+    import threading
+
+    rng = np.random.default_rng(5)
+    fresh_n = 128
+    fresh_ids = np.arange(10_000_000_001, 10_000_000_001 + fresh_n,
+                          dtype=np.int64)
+    fresh_feats = {
+        f"V{i}": ((i + 1) * VOCAB
+                  + rng.integers(VOCAB - FRESH_BAND, VOCAB,
+                                 size=fresh_n)).astype(np.int32)
+        for i in range(MODEL_ARGS["num_item_feats"])
+    }
+    engine.upsert_items(fresh_ids, fresh_feats)
+    folds0 = engine.folds
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            try:
+                pred.poll_updates()
+            except Exception:
+                pass
+            stop.wait(poll_secs)
+
+    th = threading.Thread(target=poller, daemon=True)
+    th.start()
+    try:
+        t0 = time.time()
+        save_delta(targeted=True)  # returns after the manifest commit
+        t_commit = time.time()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            lf = engine.last_fold
+            if engine.folds > folds0 and lf and lf["time"] >= t0:
+                break
+            time.sleep(0.01)
+        else:
+            raise RuntimeError("delta never folded into the corpus")
+    finally:
+        stop.set()
+        th.join(timeout=2)
+    lf = dict(engine.last_fold)
+    pinned = pred.last_apply_lag_seconds or 0.0
+    retrievable = max(0.0, lf["time"] - t_commit)
+    return {
+        "retrievable_seconds": round(retrievable, 4),
+        "pinned_lag_seconds": round(pinned, 4),
+        "fold_seconds": lf["seconds"],
+        "rows_folded": lf["rows"],
+        "poll_secs": poll_secs,
+        "ratio": round(retrievable / max(pinned, 0.05), 3),
+    }
+
+
+def compile_arm(pred, engine, save_delta, batch, k):
+    """Zero steady-state compiles: after one full warm cycle, a delta
+    replay + corpus fold + retrieve must compile NOTHING."""
+    from deeprec_tpu.analysis.trace_guard import trace_guard
+
+    engine.retrieve(batch, k)
+    save_delta(targeted=True)
+    pred.poll_updates()  # first replay+fold: pads every cache
+    engine.retrieve(batch, k)
+    save_delta(targeted=True)
+    with trace_guard(max_compiles=None) as g:
+        pred.poll_updates()
+        engine.retrieve(batch, k)
+    return g.compiles
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default="1000000,10000000",
+                    help="comma-separated corpus sizes for the qps grid")
+    ap.add_argument("--blocks", default="1024,4096,16384",
+                    help="block-size curve (pow2 rows per sweep block)")
+    ap.add_argument("--block-curve-corpus", type=int, default=262144,
+                    help="corpus size the block curve re-ingests at")
+    ap.add_argument("--k", type=int, default=100)
+    ap.add_argument("--rows", type=int, default=8,
+                    help="user rows per coalesced query batch")
+    ap.add_argument("--recall-queries", type=int, default=32)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--chunk", type=int, default=8192,
+                    help="fixed encode-chunk rows")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI pass: the 1M-item shape of every arm, short "
+                         "windows, structural asserts (numeric gates in "
+                         "roofline.py --assert-retrieval)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.corpus = "1000000"
+        args.blocks = "4096,16384"
+        args.block_curve_corpus = 131072
+        args.seconds = 1.5
+        args.recall_queries = 16
+
+    from deeprec_tpu.serving import Predictor
+    from deeprec_tpu.serving.retrieval import RetrievalEngine
+
+    sizes = sorted({int(x) for x in args.corpus.split(",") if x})
+    section = {
+        "protocol": {"k": args.k, "rows_per_query_batch": args.rows,
+                     "model": MODEL_ARGS, "vocab": VOCAB,
+                     "corpus_sizes": sizes, "seconds": args.seconds},
+        "backend": None, "arms": {}, "block_curve": {},
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        model, gen, save_delta = build(tmp)
+        pred = Predictor(model, tmp)
+        import jax
+
+        section["backend"] = jax.default_backend()
+        batch = make_user_batch(pred, gen, args.rows)
+
+        biggest = sizes[-1]
+        eng8 = eng32 = None
+        for n in sizes:
+            ids, feats = make_items(n)
+            arm = {}
+            for quant in ("int8", "fp32"):
+                t0 = time.perf_counter()
+                eng = RetrievalEngine(pred, quantize=quant,
+                                      chunk=args.chunk)
+                eng.upsert_items(ids, feats)
+                build_s = time.perf_counter() - t0
+                qps = measure_qps(eng, batch, args.k, args.seconds)
+                arm[quant] = {**qps, "build_s": round(build_s, 2),
+                              "corpus_rows": eng.corpus_rows()}
+                print(json.dumps({"config": f"corpus-{n}-{quant}",
+                                  **arm[quant]}), flush=True)
+                if quant == "int8":
+                    eng8 = eng
+                else:
+                    eng32 = eng
+            section["arms"][str(n)] = arm
+
+            if n == sizes[0]:
+                # recall + residency + gather baseline + freshness +
+                # compile gate all run at the smallest (smoke) shape —
+                # eng8/eng32 still hold this corpus.
+                section["recall"] = {
+                    "int8": recall_arm(pred, eng8, eng32, gen,
+                                       args.recall_queries, [10, args.k])}
+                print(json.dumps({"config": "recall",
+                                  **section["recall"]["int8"]}),
+                      flush=True)
+                section["residency"] = {"int8": eng8.sweep_info(),
+                                        "fp32": eng32.sweep_info()}
+                gb = gather_baseline(pred, eng32, batch, args.k,
+                                     reps=1 if args.smoke else 2)
+                sweep_qps = section["arms"][str(n)]["int8"]["qps"]
+                section["sweep_vs_gather"] = {
+                    **gb, "sweep_qps": sweep_qps,
+                    "corpus_rows": n,
+                    "speedup": round(sweep_qps / gb["gather_qps"], 2),
+                }
+                print(json.dumps({"config": "sweep-vs-gather",
+                                  **section["sweep_vs_gather"]}),
+                      flush=True)
+                # the int8 engine is the predictor's registered fold
+                # target for the freshness/compile arms (the LAST
+                # constructed engine holds the attachment — re-attach
+                # the arm under test explicitly)
+                pred.attach_retrieval(eng8)
+                section["freshness"] = freshness_arm(pred, eng8,
+                                                     save_delta)
+                print(json.dumps({"config": "freshness",
+                                  **section["freshness"]}), flush=True)
+                section["steady_compiles"] = compile_arm(
+                    pred, eng8, save_delta, batch, args.k)
+                print(json.dumps(
+                    {"config": "trace-guard",
+                     "steady_compiles": section["steady_compiles"]}),
+                    flush=True)
+            if n != sizes[0] and n != biggest:
+                del eng8, eng32  # free the mid-grid corpora
+
+        # block-size curve: re-ingest a bounded corpus per block setting
+        ids, feats = make_items(args.block_curve_corpus)
+        for blk in sorted({int(x) for x in args.blocks.split(",") if x}):
+            eng = RetrievalEngine(pred, quantize="int8", block_rows=blk,
+                                  chunk=args.chunk)
+            eng.upsert_items(ids, feats)
+            qps = measure_qps(eng, batch, args.k,
+                              min(args.seconds, 2.0))
+            section["block_curve"][str(blk)] = {
+                **qps, "corpus_rows": args.block_curve_corpus}
+            print(json.dumps({"config": f"block-{blk}", **qps}),
+                  flush=True)
+
+    if args.smoke:
+        check_smoke(section)
+        print("bench_retrieval smoke OK", flush=True)
+    out = {"retrieval": section}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+
+
+def check_smoke(section):
+    """Structural asserts (the numeric gates live in roofline.py)."""
+    assert section["arms"], section
+    for n, arm in section["arms"].items():
+        for quant in ("int8", "fp32"):
+            assert arm[quant]["qps"] > 0, (n, quant)
+            assert arm[quant]["corpus_rows"] == int(n), (n, arm)
+    ri = section["residency"]
+    for quant in ("int8", "fp32"):
+        info = ri[quant]
+        assert info["measured_bytes"] == info["modeled_bytes"], info
+    assert ri["int8"]["measured_bytes"] < ri["fp32"]["measured_bytes"]
+    assert "recall_at_10" in section["recall"]["int8"]
+    assert section["sweep_vs_gather"]["gather_qps"] > 0
+    assert section["freshness"]["rows_folded"] > 0
+    assert "steady_compiles" in section
+    assert section["block_curve"]
+
+
+if __name__ == "__main__":
+    main()

@@ -441,6 +441,109 @@ def _assert_multi_host(mh, json_path: str) -> int:
     return rc
 
 
+def assert_retrieval(json_path: str, recall_floor: float,
+                     sweep_factor: float, freshness_factor: float) -> int:
+    """CI gate for full-corpus retrieval (tools/bench_retrieval.py
+    'retrieval' section):
+
+      * recall — the int8 blocked sweep must hold `recall_floor` at
+        recall@100 against the exact fp32 full-scan argsort (tie-aware:
+        identical-vector items are interchangeable answers).
+      * sweep vs gather — the resident blocked sweep must beat the
+        per-row gather-and-re-encode baseline by `sweep_factor`× at the
+        1M-item smoke shape (the reason the corpus matrix exists).
+      * freshness — ingest -> retrievable (trainer commit to the corpus
+        fold that covers the delta) must sit within `freshness_factor`×
+        the predictor's own pinned train_to_serve lag: retrieval
+        freshness rides the SAME poll round as serving freshness, so a
+        big gap means the fold left the round.
+      * residency — measured sweep bytes must equal the
+        `ops/traffic.py retrieval_sweep_bytes` model EXACTLY (shape
+        math, not an estimate), and the int8 corpus must sit strictly
+        under the fp32 arm's bytes.
+      * compiles — delta replay folding into the corpus matrix must
+        compile ZERO steady-state XLA programs (the PR 5 zero-retrace
+        serving contract extended to the retrieval lane).
+    """
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    rt = rec.get("retrieval")
+    if not rt:
+        print(f"roofline: {json_path} has no 'retrieval' record "
+              "(run tools/bench_retrieval.py --out onto this JSON)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    rec100 = (rt.get("recall", {}).get("int8", {}) or {}).get(
+        "recall_at_100")
+    if rec100 is None or rec100 < recall_floor:
+        print(f"roofline: retrieval gate FAILED — int8 recall@100 "
+              f"{rec100} under the {recall_floor:.2f} floor vs exact "
+              f"fp32 scan (quantized blocked sweep lost ranking "
+              f"fidelity)", file=sys.stderr)
+        rc = 1
+    sg = rt.get("sweep_vs_gather") or {}
+    if not sg.get("speedup") or sg["speedup"] < sweep_factor:
+        print(f"roofline: retrieval gate FAILED — blocked sweep speedup "
+              f"{sg.get('speedup')} under the {sweep_factor:.1f}× floor "
+              f"vs the per-row gather baseline at "
+              f"{sg.get('corpus_rows')} items", file=sys.stderr)
+        rc = 1
+    fr = rt.get("freshness") or {}
+    retr = fr.get("retrievable_seconds")
+    pinned = fr.get("pinned_lag_seconds")
+    if retr is None or pinned is None or \
+            retr > freshness_factor * max(pinned, 0.05):
+        print(f"roofline: retrieval gate FAILED — ingest->retrievable "
+              f"{retr}s exceeds {freshness_factor:.1f}× the pinned "
+              f"train_to_serve lag {pinned}s (the corpus fold left the "
+              f"poll round)", file=sys.stderr)
+        rc = 1
+    if fr.get("rows_folded", 0) < 1:
+        print("roofline: retrieval gate FAILED — the freshness delta "
+              "folded zero corpus rows (changed-key discovery broke)",
+              file=sys.stderr)
+        rc = 1
+    resd = rt.get("residency") or {}
+    q8, q32 = resd.get("int8"), resd.get("fp32")
+    if not q8 or not q32:
+        print("roofline: retrieval gate FAILED — residency arms missing "
+              "(need int8 AND fp32)", file=sys.stderr)
+        rc = 1
+    else:
+        for name, ri in (("int8", q8), ("fp32", q32)):
+            if ri["measured_bytes"] != ri["modeled_bytes"]:
+                print(f"roofline: retrieval gate FAILED — {name} sweep "
+                      f"bytes measured {ri['measured_bytes']} != modeled "
+                      f"{ri['modeled_bytes']} (retrieval_sweep_bytes "
+                      f"drifted from the corpus layout)", file=sys.stderr)
+                rc = 1
+        if q8["measured_bytes"] >= q32["measured_bytes"]:
+            print(f"roofline: retrieval gate FAILED — int8 corpus "
+                  f"{q8['measured_bytes']}B not under fp32 "
+                  f"{q32['measured_bytes']}B", file=sys.stderr)
+            rc = 1
+    if rt.get("steady_compiles", -1) != 0:
+        print(f"roofline: retrieval gate FAILED — "
+              f"{rt.get('steady_compiles')} XLA compile(s) during the "
+              f"guarded delta-replay fold + retrieve (must be 0)",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        arms = {n: a.get("int8", {}).get("qps")
+                for n, a in (rt.get("arms") or {}).items()}
+        print(f"roofline: retrieval gate ok — recall@100 {rec100} "
+              f"(floor {recall_floor}), sweep {sg['speedup']}× gather "
+              f"at {sg.get('corpus_rows')} items, freshness {retr}s ≤ "
+              f"{freshness_factor:.0f}×{pinned}s, int8 corpus "
+              f"{q8['measured_bytes'] / 2 ** 20:.1f} MiB = "
+              f"{q8['measured_bytes'] / q32['measured_bytes']:.3f}× "
+              f"fp32 (model exact), 0 fold compiles, qps {arms}")
+    return rc
+
+
 def assert_obs(json_path: str, tol: float) -> int:
     """CI gate for the telemetry plane (bench.py / tools/bench_serving.py
     'obs_overhead' section): both arms (instrumented vs DEEPREC_OBS=off)
@@ -643,6 +746,26 @@ def main(argv=None):
     p.add_argument("--serving-grouped-factor", type=float, default=2.0,
                    help="required grouped/ungrouped candidates-per-sec "
                         "factor on the two-tower arm (default 2.0)")
+    p.add_argument("--assert-retrieval", metavar="RETRIEVAL_JSON",
+                   default=None,
+                   help="don't run the step: validate the full-corpus "
+                        "retrieval record written by "
+                        "tools/bench_retrieval.py (int8 recall@100 "
+                        "floor vs exact fp32 scan, blocked-sweep "
+                        "speedup over the per-row gather baseline, "
+                        "ingest->retrievable freshness vs the pinned "
+                        "train_to_serve lag, sweep bytes measured == "
+                        "modeled, zero fold compiles; CI smoke gate)")
+    p.add_argument("--retrieval-recall-floor", type=float, default=0.95,
+                   help="required int8 recall@100 vs exact fp32 scan "
+                        "(default 0.95)")
+    p.add_argument("--retrieval-sweep-factor", type=float, default=3.0,
+                   help="required blocked-sweep speedup over the "
+                        "per-row gather baseline (default 3.0)")
+    p.add_argument("--retrieval-freshness-factor", type=float,
+                   default=2.0,
+                   help="bound on ingest->retrievable as a multiple of "
+                        "the pinned train_to_serve lag (default 2.0)")
     p.add_argument("--assert-obs", metavar="BENCH_JSON", default=None,
                    help="don't run the step: validate the telemetry-plane "
                         "cost recorded in a bench.py or bench_serving.py "
@@ -688,6 +811,11 @@ def main(argv=None):
                                 args.serving_scale_floor,
                                 args.serving_grouped_factor,
                                 args.serving_quant_ratio))
+    if args.assert_retrieval:
+        sys.exit(assert_retrieval(args.assert_retrieval,
+                                  args.retrieval_recall_floor,
+                                  args.retrieval_sweep_factor,
+                                  args.retrieval_freshness_factor))
     if args.assert_obs:
         sys.exit(assert_obs(args.assert_obs, args.obs_tol))
     if args.assert_guard:
