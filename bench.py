@@ -293,6 +293,45 @@ def _traffic_report(trainer, budget_mode, dedup_stats):
     }
 
 
+def _skew_bench_model(dims):
+    """Linear model over T skewed single-hot tables + 2 dense features —
+    shared by the placement grid arm and the drift arm (same structure,
+    different dims/zipf constants)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.features import DenseFeature, SparseFeature
+
+    t_tables = len(dims)
+
+    class SkewModel:
+        features = [
+            SparseFeature(
+                f"C{i+1}",
+                table=TableConfig(
+                    name=f"C{i+1}", dim=dims[i], capacity=1 << 13
+                ),
+            )
+            for i in range(t_tables)
+        ] + [DenseFeature("I1", 1), DenseFeature("I2", 1)]
+
+        def init(self, key):
+            return {
+                "w": jax.random.normal(key, (sum(dims) + 2,)) * 0.05
+            }
+
+        def apply(self, dense, inputs, train):
+            x = jnp.concatenate(
+                [inputs.pooled[f"C{i+1}"] for i in range(t_tables)]
+                + [inputs.dense["I1"], inputs.dense["I2"]],
+                -1,
+            )
+            return x @ dense["w"]
+
+    return SkewModel()
+
+
 def _placement_workload():
     """Skew-aware placement bench (round 12): measured per-shard
     exchange-bytes imbalance, uniform hash vs the adopted ShardPlan, on a
@@ -334,30 +373,6 @@ def _placement_workload():
     n_batches = 8 if smoke else 12
     reps = 2 if smoke else 3
 
-    class SkewModel:
-        features = [
-            SparseFeature(
-                f"C{i+1}",
-                table=TableConfig(
-                    name=f"C{i+1}", dim=DIMS[i], capacity=1 << 13
-                ),
-            )
-            for i in range(T_TABLES)
-        ] + [DenseFeature("I1", 1), DenseFeature("I2", 1)]
-
-        def init(self, key):
-            return {
-                "w": jax.random.normal(key, (sum(DIMS) + 2,)) * 0.05
-            }
-
-        def apply(self, dense, inputs, train):
-            x = jnp.concatenate(
-                [inputs.pooled[f"C{i+1}"] for i in range(T_TABLES)]
-                + [inputs.dense["I1"], inputs.dense["I2"]],
-                -1,
-            )
-            return x @ dense["w"]
-
     mesh = make_mesh(N)
     gen = SyntheticCriteo(
         batch_size=B, num_cat=T_TABLES, num_dense=2, vocab=200_000,
@@ -368,7 +383,8 @@ def _placement_workload():
         for _ in range(n_batches)
     ]
     tr = ShardedTrainer(
-        SkewModel(), Adagrad(lr=0.1), mesh=mesh, placement="plan"
+        _skew_bench_model(DIMS), Adagrad(lr=0.1), mesh=mesh,
+        placement="plan",
     )
     st = tr.init(0)
 
@@ -443,7 +459,191 @@ def _placement_workload():
         report["per_shard_exchange_bytes"]["plan"] = [
             round(float(x)) for x in per_after
         ]
+    if mode in ("grid", "drift"):
+        report["drift"] = _placement_drift_arm(smoke)
     print(json.dumps(report))
+
+
+def _placement_drift_arm(smoke):
+    """Drifting-skew placement arm (round 19): the hot-key set rotates
+    mid-stream (`SyntheticCriteo(zipf_rotate_every=)`) under a live
+    `placement="plan"` trainer on the budgeted a2a exchange — the
+    workload the drift-driven replanner exists for.
+
+    Protocol: one dominant-dim zipf-head table + three light tables in a
+    shared raw id space; windows of train steps with `maintain()` after
+    each (the maybe_replan drift gate runs exactly as production would).
+    The trainer first adopts a plan off the early windows; at the
+    midpoint the generator rotates the hot set, the adopted plan goes
+    stale, the measured imbalance spikes, and the replanner must catch
+    it AUTOMATICALLY — hysteresis-triggered, amortization-approved,
+    never forced. Records the per-window imbalance trajectory, the
+    replan/migration accounting, the a2a overflow counters (must be 0:
+    the drift-safety margin of the per-dest budget covers the stale
+    window), and the per-dest-budget wire diet next to the v1
+    global-headroom model (measured bucket == modeled vector max).
+    `tools/roofline.py --assert-imbalance` gates all of it in CI."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeprec_tpu.config import TableConfig
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.features import DenseFeature, SparseFeature
+    from deeprec_tpu.ops import traffic as T
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh, shard_batch
+    from deeprec_tpu.parallel.placement import ReplanConfig
+
+    N = 8
+    ZIPF = [3.0, 1.6, 1.4, 1.2]
+    DIMS = [128, 8, 8, 8]
+    T_TABLES = len(ZIPF)
+    B = 512
+    spw = 2 if smoke else 3  # steps per maintain window
+    # ONE rotation at the midpoint: pre == post keeps exactly one
+    # rotate_every boundary inside the run (the generator rotates at
+    # every multiple).
+    pre = 4 if smoke else 5  # windows before the hot set rotates
+    post = 4 if smoke else 5  # windows after
+
+    mesh = make_mesh(N)
+    gen = SyntheticCriteo(
+        batch_size=B, num_cat=T_TABLES, num_dense=2, vocab=200_000,
+        seed=11, zipf_a=ZIPF, offset_ids=False,
+        zipf_rotate_every=pre * spw,
+    )
+    tr = ShardedTrainer(
+        _skew_bench_model(DIMS), Adagrad(lr=0.1), mesh=mesh, comm="a2a",
+        placement="plan", placement_hot_budget=64,
+        replan=ReplanConfig(threshold=1.4, sustain=1, cooldown=1,
+                            horizon_steps=20_000),
+    )
+    st = tr.init(0)
+
+    def window_imbalance(state):
+        per = np.zeros(N)
+        for _, d in tr.dedup_stats(state).items():
+            ps = d.get("per_shard") if isinstance(d, dict) else None
+            if ps:
+                per += np.asarray(ps["exchange_bytes"])
+        return T.shard_imbalance(per)
+
+    trajectory = []
+    post_drift_auto = 0
+    last_sb = None
+    for w in range(pre + post):
+        for _ in range(spw):
+            last_sb = shard_batch(
+                mesh, {k: jnp.asarray(v) for k, v in gen.batch().items()}
+            )
+            st, mets = tr.train_step(st, last_sb)
+        jax.block_until_ready(mets["loss"])
+        imb = window_imbalance(st)
+        before = int(tr._replan_stats["replans"])
+        st, _ = tr.maintain(st)
+        replanned = int(tr._replan_stats["replans"]) > before
+        if replanned and w >= pre:
+            post_drift_auto += 1
+        trajectory.append({
+            "window": w, "imbalance": round(imb, 4),
+            "post_drift": w >= pre, "replanned": replanned,
+        })
+
+    # One settling step AFTER the last maintain(): an adoption on the
+    # final window updates plan_dest_hot/plan_hot_count and rebuilds the
+    # jits, but last_a2a_budgets/bucket/unique only refresh at the next
+    # TRACE — without this step the measured==modeled budget assert
+    # below would compare the NEW plan's model against the OLD plan's
+    # compiled bucket and fail spuriously. Re-runs the LAST drawn batch
+    # (never a fresh draw — the next index would cross a SECOND
+    # rotate_every boundary and train one step on a third hot set the
+    # protocol never replans).
+    st, mets = tr.train_step(st, last_sb)
+    jax.block_until_ready(mets["loss"])
+
+    # Post-drift peak = worst window up to and including the first
+    # post-drift replan; recovery = the final window (plan re-settled).
+    post_w = [t for t in trajectory if t["post_drift"]]
+    peak = 0.0
+    for t in post_w:
+        peak = max(peak, t["imbalance"])
+        if t["replanned"]:
+            break
+    recovered = post_w[-1]["imbalance"] if post_w else None
+
+    overflow = sum(
+        int(np.sum(np.asarray(jax.device_get(ts.a2a_overflow))))
+        for ts in st.tables.values()
+    )
+    # Per-dest budget diet: the bucket each bundle's trace compiled
+    # (measured) vs the model's vector max (must agree exactly) vs the
+    # v1 global-headroom bucket, in wire bytes (id/count + both payload
+    # directions, ops/traffic.py a2a_exchange_wire_bytes).
+    budgets = {}
+    wire_plan = wire_global = 0.0
+    budgets_match = True
+    for bname, b in tr.bundles.items():
+        sh = tr.sharded[bname]
+        bp = tr._plans.get(bname)
+        U = sh.last_a2a_unique
+        dest_hot = sh.plan_dest_hot
+        hot_max = int(bp.dest_hot_counts().max()) if bp else 0
+        modeled = T.a2a_dest_budgets(
+            unique=U, num_shards=N, slack=sh.a2a_slack,
+            dest_hot=dest_hot, hot_count=sh.plan_hot_count,
+        )
+        match = (
+            int(modeled.max()) == sh.last_a2a_bucket
+            and np.array_equal(modeled, np.asarray(sh.last_a2a_budgets))
+        )
+        budgets_match &= match
+        g_bucket = T.a2a_bucket_rows_global(
+            unique=U, num_shards=N, slack=sh.a2a_slack, hot_max=hot_max,
+        )
+        n_members = len(b.features) if b.stacked else 1
+        cfg = b.table.cfg
+        wire_b = 2 if cfg.exchange_dtype == "bfloat16" else 4
+        wire_plan += n_members * T.a2a_exchange_wire_bytes(
+            bucket_rows=sh.last_a2a_bucket, num_shards=N, dim=cfg.dim,
+            wire_bytes=wire_b,
+        )
+        wire_global += n_members * T.a2a_exchange_wire_bytes(
+            bucket_rows=g_bucket, num_shards=N, dim=cfg.dim,
+            wire_bytes=wire_b,
+        )
+        budgets[bname] = {
+            "unique": U,
+            "bucket_rows": sh.last_a2a_bucket,
+            "modeled_bucket_rows": int(modeled.max()),
+            "dest_budgets": [int(x) for x in modeled],
+            "global_headroom_rows": g_bucket,
+            "hot_max": hot_max,
+            "measured_eq_modeled": match,
+        }
+    return {
+        "batch": B, "num_shards": N, "zipf": ZIPF, "dims": DIMS,
+        "steps_per_window": spw, "windows_pre": pre, "windows_post": post,
+        "rotate_at_step": pre * spw,
+        "trajectory": trajectory,
+        "peak_post_drift": round(peak, 4),
+        "recovered_imbalance": (
+            round(recovered, 4) if recovered is not None else None
+        ),
+        "replans": {
+            "total": int(tr._replan_stats["replans"]),
+            "forced": int(tr._replan_stats["forced_replans"]),
+            "post_drift_auto": post_drift_auto,
+        },
+        "migration_rows": int(tr._replan_stats["migration_rows"]),
+        "migration_bytes": float(tr._replan_stats["migration_bytes"]),
+        "a2a_overflow": overflow,
+        "budgets": budgets,
+        "budgets_measured_eq_modeled": bool(budgets_match),
+        "wire_bytes_per_dest_model": round(wire_plan, 1),
+        "wire_bytes_global_headroom_model": round(wire_global, 1),
+        "cost_model": tr.cost_model.info(),
+    }
 
 
 def _run_placement_worker():

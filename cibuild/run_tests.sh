@@ -91,7 +91,7 @@ echo "== in-step pipelining grid vs overlap model (regression fails the smoke) =
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-overlap /tmp/deeprec_bench_smoke.json
 
-echo "== skew-aware placement vs uniform hash (imbalance gate fails the smoke) =="
+echo "== skew-aware placement vs uniform hash + drifting-skew replanning (imbalance/drift gates fail the smoke: auto replan, recovery, zero a2a overflow, per-dest budget diet) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-imbalance /tmp/deeprec_bench_smoke.json
 

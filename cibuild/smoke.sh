@@ -39,4 +39,9 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_retrieval.py::test_tie_determinism_block_size_independent \
   tests/test_retrieval.py::test_delta_fold_targets_changed_items_and_zero_compiles \
   tests/test_retrieval_fleet.py::test_two_shard_merge_parity_and_kill_partial \
+  tests/test_placement_v2.py::test_dest_budget_vector_uniform_parity_and_diet \
+  tests/test_placement_v2.py::test_drift_detector_hysteresis_cooldown_and_projection \
+  tests/test_placement_v2.py::test_cost_model_untrained_is_bit_identical \
+  tests/test_placement_v2.py::test_zipf_rotation_off_is_stream_identical_and_on_is_deterministic \
+  tests/test_placement_v2.py::test_amortization_defers_below_horizon_and_adopts_above \
   "$@"

@@ -35,7 +35,19 @@ class SyntheticCriteo:
     (legacy, bit-identical draw stream) or a per-table sequence of
     `num_cat` exponents — real workloads have wide variance in per-table
     skew/unique fractions (ROADMAP), and the placement bench needs tables
-    whose heads differ to show hot-key balancing."""
+    whose heads differ to show hot-key balancing.
+
+    `zipf_rotate_every=N` is the DRIFTING-skew mode (flash sales,
+    diurnal cycles — the workload Placement v2's replanner exists for):
+    after every N batches the hot-key set rotates to a different region
+    of the id space (rank r maps to id (r + k·stride) % vocab for
+    rotation k = batches_drawn // N), so a placement plan tuned on one
+    window becomes stale mid-stream. Deterministic — the rotation is a
+    pure function of the batch index, the RNG draw stream is untouched —
+    and the labels follow the rotated ids (a newly-hot id brings its own
+    hidden weight, like a new product going viral). Off (None, the
+    default) the generator is stream-identical to before the knob
+    existed."""
 
     def __init__(
         self,
@@ -47,6 +59,8 @@ class SyntheticCriteo:
         seed: int = 0,
         dtype=np.int32,
         offset_ids: bool = True,
+        zipf_rotate_every: Optional[int] = None,
+        zipf_rotate_stride: Optional[int] = None,
     ):
         self.B = batch_size
         self.num_cat = num_cat
@@ -68,6 +82,21 @@ class SyntheticCriteo:
         # owner shards — the correlated-head case the placement plan's
         # owner-offset rotation exists for.
         self.offset_ids = offset_ids
+        if zipf_rotate_every is not None and zipf_rotate_every <= 0:
+            raise ValueError(
+                f"zipf_rotate_every must be positive, got {zipf_rotate_every}"
+            )
+        self.zipf_rotate_every = zipf_rotate_every
+        # Default stride lands each rotation's head deep inside the
+        # previous tail (≈ a third of the vocab, offset so consecutive
+        # rotations never re-overlap a small head region); any stride
+        # coprime-ish with vocab works, it only has to MOVE the head.
+        self.zipf_rotate_stride = (
+            zipf_rotate_stride
+            if zipf_rotate_stride is not None
+            else vocab // 3 + 1
+        )
+        self._batches_drawn = 0
         self.rng = np.random.default_rng(seed)
         self.dtype = dtype
         # hidden ground-truth weights giving the label structure
@@ -89,8 +118,24 @@ class SyntheticCriteo:
             for a in self._zipf_per_table
         ])
 
+    def rotation_at(self, batch_index: int) -> int:
+        """Hot-set rotation index in force for batch `batch_index` (0
+        when rotation is off) — pure, so tests and the bench can locate
+        the drift boundary without consuming the stream."""
+        if not self.zipf_rotate_every:
+            return 0
+        return batch_index // self.zipf_rotate_every
+
     def batch(self) -> Dict[str, np.ndarray]:
         cats = self._cat_ids()
+        if self.zipf_rotate_every:
+            # Drifting skew: shift the rank->id mapping so the zipf head
+            # occupies a different id region each rotation. Applied
+            # BEFORE the label logit, so the task rotates with the ids.
+            k = self.rotation_at(self._batches_drawn)
+            if k:
+                cats = (cats + k * self.zipf_rotate_stride) % self.vocab
+        self._batches_drawn += 1
         dense = self.rng.lognormal(0.0, 1.0, size=(self.B, self.num_dense)).astype(
             np.float32
         )

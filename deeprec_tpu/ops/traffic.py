@@ -66,6 +66,151 @@ def shard_imbalance(loads) -> float:
     return float(l.max()) / mean
 
 
+# ------------------------------------------------- a2a budget model (plan v2)
+#
+# The a2a exchange buckets ids by destination with a static per-bucket
+# budget. Placement v1 modeled the budget as hash-uniform spread
+# (slack·U/N) plus one GLOBAL hot-key headroom — the plan's worst
+# per-destination hot concentration added to EVERY bucket. Placement v2
+# replaces that with a per-destination budget VECTOR derived from the
+# plan's own routing: destination d pays the tail share (the uniques the
+# plan's hot table does NOT route explicitly — slack·(U−H)/N) plus
+# exactly the hot-key arrivals the plan routes to d. The compiled bucket
+# is the vector's max (all_to_all moves equal chunks — SPMD programs
+# cannot ship ragged per-destination buckets), which is still strictly
+# tighter than the global-headroom bucket whenever the plan routes enough
+# hot keys to shrink the tail share past the 8-row rounding.
+# `ShardedTable._a2a_budget` calls `a2a_dest_budgets` directly, so the
+# model and the program share one formula by construction; bench.py's
+# drift arm additionally records the bucket the trace actually used next
+# to the modeled vector (measured == modeled, the residency discipline).
+
+
+def a2a_dest_budgets(
+    *,
+    unique: int,
+    num_shards: int,
+    slack: float = 2.0,
+    dest_hot=None,
+    hot_count: int = 0,
+    floor: int = 8,
+):
+    """Per-destination a2a bucket budgets [N] (rows).
+
+    `dest_hot` is the plan's per-destination explicit hot-key arrival
+    counts (None = uniform hash: no hot routing) and `hot_count` the
+    number of plan hot keys removed from the hash-spread tail (each hot
+    key is a local unique that the plan routes explicitly, so it never
+    competes for tail slots). dest_hot=None/hot_count=0 reproduces the
+    legacy slack·U/N budget bit-for-bit. Each budget rounds up to a
+    VPU-friendly multiple of 8 with a floor of `floor`.
+
+    Drift-safety margin: the tail subtraction is capped at U/4, so even
+    when the ENTIRE routed hot set goes cold at once (a rotated key
+    distribution — the window between a drift and the replan that chases
+    it) every destination still budgets ≥ 3/4·slack × the uniform
+    per-dest spread of what is then an all-tail stream (1.5× the
+    expected per-dest load at the default slack=2 — real variance
+    headroom, not just the mean). Shortfall beyond that degrades via the
+    sentinel bucket (default-served, counted), never drops rows."""
+    import math
+
+    import numpy as np
+
+    N = int(num_shards)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    h_eff = min(max(0, int(hot_count)), int(unique) // 4)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    tail = math.ceil(max(0, int(unique) - h_eff) * slack / N)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    hot = (
+        np.zeros((N,), np.int64)
+        if dest_hot is None
+        else np.asarray(dest_hot, np.int64)  # noqa: DRT002 — host plan constants (numpy), never a device value
+    )
+    if hot.shape != (N,):
+        raise ValueError(
+            f"dest_hot must be a length-{N} vector, got shape {hot.shape}"
+        )
+    b = np.maximum(int(floor), ((tail + hot + 7) // 8) * 8)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    return b.astype(np.int64)
+
+
+def a2a_bucket_rows(
+    *,
+    unique: int,
+    num_shards: int,
+    slack: float = 2.0,
+    dest_hot=None,
+    hot_count: int = 0,
+    floor: int = 8,
+) -> int:
+    """The uniform physical bucket the a2a program compiles: the max of
+    the per-destination budget vector (all_to_all chunks are equal)."""
+    return int(a2a_dest_budgets(
+        unique=unique, num_shards=num_shards, slack=slack,
+        dest_hot=dest_hot, hot_count=hot_count, floor=floor,
+    ).max())
+
+
+def a2a_bucket_rows_global(
+    *,
+    unique: int,
+    num_shards: int,
+    slack: float = 2.0,
+    hot_max: int = 0,
+    floor: int = 8,
+) -> int:
+    """The placement-v1 global-headroom bucket: the full hash-spread tail
+    (hot keys NOT subtracted) plus the plan's worst per-destination hot
+    concentration on every bucket. Kept as the reproducible "before"
+    column of the per-dest budget diet (the traffic-diet discipline)."""
+    import math
+
+    per = math.ceil(int(unique) * slack / num_shards) + int(hot_max)
+    return max(int(floor), ((per + 7) // 8) * 8)
+
+
+def a2a_exchange_wire_bytes(
+    *,
+    bucket_rows: int,
+    num_shards: int,
+    dim: int,
+    wire_bytes: int = 4,
+    key_bytes: int = 4,
+) -> float:
+    """Per-device per-step wire bytes of the budgeted a2a exchange at a
+    physical bucket of `bucket_rows`: id + count buckets out, embeddings
+    back, grads out — (N−1) remote buckets each direction (the bucket a
+    shard addresses to itself never leaves the chip)."""
+    per_dir = (num_shards - 1) * int(bucket_rows)
+    return float(
+        per_dir * (key_bytes + 4) + 2 * per_dir * dim * wire_bytes
+    )
+
+
+# --------------------------------------------- replanning amortization model
+
+
+def migration_bytes(moved_rows: int, *, row_bytes: float) -> float:
+    """Modeled one-shot cost of migrating `moved_rows` between shards at
+    plan adoption: `exchange_row_bytes` over the moved rows — the same
+    per-row unit as the placement load model, so gain/step and cost live
+    in one currency and the amortization horizon is a plain division."""
+    return float(moved_rows) * float(row_bytes)
+
+
+def replan_gain_bytes(loads_current, loads_candidate) -> float:
+    """Modeled per-step byte gain of adopting a candidate plan: the drop
+    in the MAX-shard exchange load (after round 11's pipelining the
+    exchange straggler is what bounds step time, so straggler bytes are
+    the honest unit — mean load is invariant under re-routing)."""
+    import numpy as np
+
+    cur = np.asarray(loads_current, np.float64)
+    cand = np.asarray(loads_candidate, np.float64)
+    if cur.size == 0 or cand.size == 0:
+        return 0.0
+    return float(cur.max() - cand.max())
+
+
 # --------------------------------------------------------------- bytes model
 
 
@@ -136,13 +281,14 @@ def table_step_traffic(
             wire += (N - 1) * U * D * wire_bytes  # embeddings down
             wire += (N - 1) * U * D * wire_bytes  # grads up
         elif comm == "a2a":
-            import math
-
-            Bd = max(8, math.ceil(U * a2a_slack / N / 8) * 8)
-            per_dir_rows = (N - 1) * Bd
-            wire += per_dir_rows * (kb + 4)  # id + count buckets
-            wire += per_dir_rows * D * wire_bytes  # embeddings back
-            wire += per_dir_rows * D * wire_bytes  # grads out
+            # Placement v2: the bucket is the max of the per-destination
+            # budget vector (uniform hash: hot terms zero — identical to
+            # the legacy slack·U/N bucket).
+            Bd = a2a_bucket_rows(unique=U, num_shards=N, slack=a2a_slack)
+            wire += a2a_exchange_wire_bytes(
+                bucket_rows=Bd, num_shards=N, dim=D,
+                wire_bytes=wire_bytes, key_bytes=kb,
+            )
         else:
             raise ValueError(f"unknown comm {comm!r}")
     return {

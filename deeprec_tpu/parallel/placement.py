@@ -126,6 +126,17 @@ class ShardPlan:
             owner = np.where(hit, ho[cand], owner).astype(np.int32)
         return owner
 
+    def dest_hot_counts(self) -> np.ndarray:
+        """[N] explicit hot-key arrivals this plan routes to each
+        destination — the per-dest half of the a2a budget vector
+        (`ops/traffic.py a2a_dest_budgets`): every source that sees a hot
+        key sends it to the same planned owner, so the worst-case
+        per-(source, dest) concentration IS this bincount."""
+        return np.bincount(
+            np.asarray(self.hot_owners, np.int64),
+            minlength=self.num_shards,
+        ).astype(np.int64)
+
     def leaves(self, key_dtype, pad_h: Optional[int] = None) -> Dict:
         """Device constants for `plan_owner`, hot arrays sentinel-padded
         to `pad_h` (stacked bundles need one common H across members)."""
@@ -172,6 +183,141 @@ class BundlePlan:
         return {
             k: jnp.stack([leaf[k] for leaf in per]) for k in per[0]
         }
+
+    def dest_hot_counts(self) -> np.ndarray:
+        """Elementwise max of the member plans' per-dest hot arrival
+        counts — the bucket is shared by every vmapped member, so each
+        destination budgets for its worst member."""
+        out = np.zeros((self.plans[0].num_shards,), np.int64)
+        for p in self.plans:
+            out = np.maximum(out, p.dest_hot_counts())
+        return out
+
+    def hot_count_min(self) -> int:
+        """Min hot-key count across members — the tail-share subtraction
+        must hold for EVERY member riding the shared bucket, so only the
+        keys every member's plan routes explicitly leave the tail."""
+        return min((len(p.hot_keys) for p in self.plans), default=0)
+
+
+# --------------------------------------------------- drift-driven replanning
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplanConfig:
+    """Knobs of the drift-driven replan trigger (`ShardedTrainer.
+    maybe_replan`, run from maintain()). The discipline is the
+    FleetAutoscaler's: hysteresis (sustain) so one noisy window never
+    fires, cooldown so adoptions can't thrash, and an amortization
+    horizon so the system replans exactly when the modeled gain pays for
+    the modeled migration.
+
+      threshold      windowed max-table imbalance (max/mean exchange
+                     bytes) that counts as drift
+      sustain        consecutive maintain() observations at/over the
+                     threshold before the placer runs
+      cooldown       maintain() calls after an adoption during which the
+                     trigger stays quiet (migration just perturbed the
+                     window; let the counters resettle)
+      horizon_steps  steps over which the modeled straggler-bytes gain
+                     must amortize the modeled migration bytes
+                     (ops/traffic.py migration_bytes) for adoption
+      min_gain       modeled-imbalance improvement factor required of a
+                     candidate (the placement-v1 bar, kept as a second
+                     hysteresis)
+      window_secs    obs ring-buffer window consulted for the level/slope
+                     (obs/metrics.py window queries)
+      lead_secs      slope projection: a positive `window_slope` of the
+                     imbalance gauge projected `lead_secs` ahead may
+                     breach the threshold EARLY — the replan fires while
+                     the drift is still building instead of after the
+                     straggler fully forms (0 = level-only trigger)
+    """
+
+    threshold: float = 1.5
+    sustain: int = 2
+    cooldown: int = 2
+    horizon_steps: int = 2000
+    min_gain: float = 1.05
+    window_secs: float = 120.0
+    lead_secs: float = 0.0
+
+
+class DriftDetector:
+    """Pure host-side hysteresis gate over (level, slope) observations —
+    one observe() per maintain(). Separated from the trainer so the
+    trigger logic is unit-testable without a mesh
+    (tests/test_placement_v2.py)."""
+
+    def __init__(self, cfg: ReplanConfig):
+        self.cfg = cfg
+        self._breaches = 0
+        self._cooldown = 0
+        self.last: Dict[str, object] = {}
+
+    def observe(self, level: float, slope: Optional[float] = None) -> bool:
+        """Feed one windowed observation; True = run the placer now.
+        `level` is the windowed max-table imbalance, `slope` its
+        d/dt (None when the obs plane has <2 ring slots of history)."""
+        cfg = self.cfg
+        projected = level
+        if slope is not None and slope > 0 and cfg.lead_secs > 0:
+            projected = level + slope * cfg.lead_secs
+        breach = level >= cfg.threshold or projected >= cfg.threshold
+        self._breaches = self._breaches + 1 if breach else 0
+        cooling = self._cooldown > 0
+        if cooling:
+            self._cooldown -= 1
+        fire = (not cooling) and self._breaches >= cfg.sustain
+        self.last = {
+            "level": round(float(level), 4),  # noqa: DRT002 — host telemetry scalars by contract (maintain cadence, never traced)
+            "slope_per_sec": (
+                None if slope is None else round(float(slope), 6)  # noqa: DRT002 — host telemetry scalars by contract (maintain cadence, never traced)
+            ),
+            "projected": round(float(projected), 4),  # noqa: DRT002 — host telemetry scalars by contract (maintain cadence, never traced)
+            "breaches": self._breaches,
+            "cooldown": self._cooldown + (1 if cooling else 0),
+            "fired": fire,
+        }
+        return fire
+
+    def adopted(self) -> None:
+        """A plan was adopted: start the cooldown, reset the breach run
+        (the migration itself perturbs the next window's counters)."""
+        self._cooldown = self.cfg.cooldown
+        self._breaches = 0
+
+    def deferred(self) -> None:
+        """The placer ran but declined (min_gain / amortization): reset
+        the breach run WITHOUT a cooldown — the trigger re-arms after
+        another `sustain` breaching windows instead of re-running the
+        placer every maintain() while the (unchanged) condition holds."""
+        self._breaches = 0
+
+
+def plan_moved_rows(
+    members: Sequence["MemberTraffic"],
+    current: Optional[Dict[Tuple[str, int], "ShardPlan"]],
+    candidate: Dict[Tuple[str, int], "ShardPlan"],
+) -> Dict[Tuple[str, int], int]:
+    """Rows whose owner changes between two plan sets, per member —
+    computed from the live key sets WITHOUT migrating (the amortization
+    check needs the cost before deciding to pay it). Matches what
+    `reshard_members` would move: a row migrates iff its owner under the
+    candidate differs from its owner under the active plan."""
+    out: Dict[Tuple[str, int], int] = {}
+    for m in members:
+        ref = (m.bundle, m.member)
+        if ref not in candidate or len(m.keys) == 0:
+            out[ref] = 0
+            continue
+        cur = (current or {}).get(ref)
+        cur_owner = (
+            cur.owner_np(m.keys) if cur is not None
+            else hashing.hash_shard_np(m.keys, candidate[ref].num_shards)
+        )
+        out[ref] = int(np.sum(candidate[ref].owner_np(m.keys) != cur_owner))
+    return out
 
 
 # -------------------------------------------------------------- cost model
@@ -225,6 +371,8 @@ def build_plans(
     *,
     hot_budget: int = 64,
     base_loads=None,
+    cost_model=None,
+    ambiguity: float = 1e-6,
 ) -> Tuple[Dict[Tuple[str, int], ShardPlan], Dict[str, object]]:
     """Greedy cost-model placer: minimize the max-shard exchange load.
 
@@ -243,6 +391,15 @@ def build_plans(
     must pack AROUND but cannot move — tables whose plan is pinned
     uniform (multi-tier storage keeps demoted rows in per-shard tier
     stores that don't migrate, so their routing must not change).
+
+    `cost_model` (parallel/costmodel.py PlacementCostModel, optional) is
+    the learned ranker: where the ANALYTIC rotation costs are ambiguous
+    (within `ambiguity` relative of the best — ties are common once the
+    running load vector is flat), a TRAINED model re-ranks the tied
+    rotations by its calibrated per-shard load prediction. An untrained
+    or absent model leaves every choice bit-identical to the analytic
+    placer — the fallback contract
+    (tests/test_placement_v2.py::test_cost_model_untrained_is_bit_identical).
 
     Returns (plans keyed by (bundle, member), report) where the report
     carries modeled per-shard loads and max/mean imbalance before (uniform
@@ -288,11 +445,33 @@ def build_plans(
         tail = np.bincount(
             base[~hot_mask], weights=load[~hot_mask], minlength=N
         )
+        costs = [float(np.max(L + np.roll(tail, r))) for r in range(N)]
         best_r, best_cost = 0, float("inf")
-        for r in range(N):
-            cost = float(np.max(L + np.roll(tail, r)))
+        for r, cost in enumerate(costs):
             if cost < best_cost - 1e-9:
                 best_r, best_cost = r, cost
+        if cost_model is not None and cost_model.trained:
+            # Learned re-rank of the analytic ties: rotations whose
+            # analytic cost is indistinguishable from the winner's get
+            # re-scored with the model's calibrated per-shard loads.
+            # Deterministic: ties in the prediction fall back to the
+            # analytic winner, then the smallest rotation.
+            tol = abs(best_cost) * ambiguity + 1e-9
+            tied = [r for r in range(N) if costs[r] <= best_cost + tol]
+            if len(tied) > 1:
+                stats = cost_model.member_stats(m)
+                best_r = min(
+                    tied,
+                    key=lambda r: (
+                        float(np.max(
+                            L + cost_model.predict_loads(
+                                stats, np.roll(tail, r)
+                            )
+                        )),
+                        0 if r == best_r else 1,
+                        r,
+                    ),
+                )
         offsets[ref] = best_r
         L += np.roll(tail, best_r)
         for i in hot_ix:

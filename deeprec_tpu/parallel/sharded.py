@@ -140,10 +140,21 @@ class ShardedTable:
         self.comm = comm
         self.a2a_slack = a2a_slack
         self.exchange_chunks = max(1, int(exchange_chunks))
-        # Max hot-key arrivals a placement plan routes to ONE destination
-        # bucket (see _a2a_budget); 0 = uniform hash, set by
-        # ShardedTrainer.update_placement at plan adoption.
-        self.plan_hot_headroom = 0
+        # Plan-aware per-destination a2a budget inputs (see _a2a_budget):
+        # `plan_dest_hot` is the active plan's per-destination explicit
+        # hot-key arrival counts ([N] ints; None = uniform hash) and
+        # `plan_hot_count` how many plan hot keys leave the hash-spread
+        # tail. Both are static trace-time constants set by
+        # ShardedTrainer.update_placement at plan adoption (before the
+        # jit rebuild).
+        self.plan_dest_hot = None
+        self.plan_hot_count = 0
+        # Trace-time record of the budget the compiled program actually
+        # uses — the measured side of the measured==modeled budget assert
+        # (bench.py drift arm, tests/test_placement_v2.py).
+        self.last_a2a_unique = None
+        self.last_a2a_budgets = None
+        self.last_a2a_bucket = None
 
     # --------------------------------------------------------- split phases
 
@@ -390,20 +401,33 @@ class ShardedTable:
     # ------------------------------------------------------------- a2a path
 
     def _a2a_budget(self, U: int) -> int:
-        import math
+        from deeprec_tpu.ops import traffic as T
 
-        # slack·U/N models hash-uniform owner spread. A placement plan
-        # (parallel/placement.py) breaks that assumption by design: its
-        # hot-key table concentrates up to `plan_hot_headroom` EXPLICIT
-        # arrivals per (source, dest) bucket on top of the rotated tail —
-        # every source that sees a hot key sends it to the same planned
-        # owner. The headroom is a static trace-time constant the trainer
-        # sets at plan adoption (update_placement, before the jit
-        # rebuild), so balanced plans never buy their balance with
-        # overflow-degraded (default-served) hot ids.
-        per_dest = math.ceil(U * self.a2a_slack / self.num_shards)
-        per_dest += int(self.plan_hot_headroom)
-        return max(8, ((per_dest + 7) // 8) * 8)  # pad to VPU-friendly size
+        # Per-destination budget vector (ops/traffic.py a2a_dest_budgets):
+        # destination d pays the hash-spread TAIL share — slack·(U−H)/N,
+        # H = the plan's hot-key count, keys the routing table sends
+        # explicitly and so never compete for tail slots — plus exactly
+        # the hot-key arrivals the plan routes to d (every source that
+        # sees a hot key sends it to the same planned owner, so the
+        # per-(source, dest) concentration is the plan's own bincount).
+        # The compiled bucket is the vector's max: all_to_all moves equal
+        # chunks, so an SPMD program cannot ship ragged per-destination
+        # buckets — but the max is still strictly tighter than the v1
+        # global-headroom bucket (full tail + the worst concentration on
+        # EVERY bucket) once the plan routes enough hot keys. Uniform
+        # hash (no plan) reproduces the legacy slack·U/N budget
+        # bit-for-bit. The inputs are static trace-time constants
+        # (update_placement sets them before the jit rebuild); genuine
+        # shortfall still degrades via the sentinel bucket — default
+        # served, counted in a2a_overflow — never dropped rows.
+        budgets = T.a2a_dest_budgets(
+            unique=U, num_shards=self.num_shards, slack=self.a2a_slack,
+            dest_hot=self.plan_dest_hot, hot_count=self.plan_hot_count,
+        )
+        self.last_a2a_unique = int(U)  # noqa: DRT002 — static trace-time shape, no device value
+        self.last_a2a_budgets = budgets
+        self.last_a2a_bucket = int(budgets.max())  # noqa: DRT002 — max of a host numpy budget vector, no device value
+        return self.last_a2a_bucket
 
     def _route_a2a(self, ids, pad_value, unique_size,
                    plan=None) -> ShardedRoute:

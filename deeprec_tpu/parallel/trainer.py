@@ -68,27 +68,47 @@ class ShardedTrainer(Trainer):
         pipeline_chunks: int = 4,
         placement: str = "uniform",
         placement_hot_budget: int = 64,
+        replan: Optional["placement_lib.ReplanConfig"] = None,
     ):
+        from deeprec_tpu.parallel.costmodel import PlacementCostModel
         from deeprec_tpu.parallel.mesh import make_mesh
 
         self.mesh = mesh or make_mesh(axis=axis)
         self.axis = axis
         self.num_shards = self.mesh.devices.size
         # Skew-aware table placement (parallel/placement.py): "uniform"
-        # keeps the legacy hash_shard routing; "plan" lets maintain() run
-        # update_placement() next to update_budgets — recomputing the
-        # owner-offset/hot-key plan from live freq counters and migrating
-        # moved rows at the step boundary. Plans always start uniform;
-        # update_placement(force=True) also works under "uniform" for
-        # one-shot manual placement.
+        # keeps the legacy hash_shard routing; "plan" arms the
+        # drift-driven replanner — maintain() runs maybe_replan() next to
+        # update_budgets, which fires the cost-model placer only when the
+        # live per-shard imbalance telemetry breaches the ReplanConfig
+        # trigger (hysteresis + cooldown) AND the modeled gain amortizes
+        # the modeled migration within the horizon. Plans always start
+        # uniform; update_placement(force=True) also works under
+        # "uniform" for one-shot manual placement.
         if placement not in ("uniform", "plan"):
             raise ValueError(
                 f"placement must be 'uniform' or 'plan', got {placement!r}"
             )
         self.placement = placement
         self.placement_hot_budget = int(placement_hot_budget)
+        self.replan_config = replan or placement_lib.ReplanConfig()
+        self._drift = placement_lib.DriftDetector(self.replan_config)
+        # Learned cost model (parallel/costmodel.py): trained from this
+        # trainer's own (plan, measured per-shard bytes) windows, used by
+        # build_plans to rank analytically-tied rotations; bit-identical
+        # fallback until trained.
+        self.cost_model = PlacementCostModel()
         self._plans: Dict[str, "BundlePlan"] = {}
         self.last_placement: Optional[Dict] = None
+        self._window_reset_step = 0
+        # (bundle, member) -> (step, sorted keys, freqs) at the last
+        # placer run — the windowed-arrivals baseline (_member_traffics).
+        self._freq_snaps: Dict = {}
+        self._replan_stats: Dict[str, object] = {
+            "replans": 0, "forced_replans": 0, "migration_rows": 0,
+            "migration_bytes": 0.0, "deferred": 0,
+            "last_gain_bytes_per_step": None,
+        }
         super().__init__(model, sparse_opt, dense_opt, grad_averaging, remat,
                          unique_budget=unique_budget,
                          pipeline_mode=pipeline_mode,
@@ -292,11 +312,22 @@ class ShardedTrainer(Trainer):
             "imbalance": round(T.shard_imbalance(xb), 4),
         }
 
-    def _member_traffics(self, state):
+    def _member_traffics(self, state, return_pulls: bool = False):
         """Placer inputs: one MemberTraffic per member table, weights
         modeled from the live freq counters (TableState.meta) — a key's
         arrivals/step is at most its occurrence rate and at most N (each
-        source shard dedups before the exchange)."""
+        source shard dedups before the exchange).
+
+        Windowed weights: once a freq snapshot exists (stamped at every
+        placer run, `_snapshot_freqs`), the arrival rate is the DELTA
+        since the snapshot over the window's steps — so a replan chases
+        the distribution the drift trigger actually fired on, not the
+        lifetime average a rotated hot set would dilute for thousands of
+        steps. First run (no snapshot) uses lifetime freq/steps.
+
+        return_pulls=True additionally returns the raw (keys, freqs)
+        host arrays per member so `_snapshot_freqs` can reuse them —
+        these are the full table pulls, paid once per placer run."""
         import numpy as np
 
         from deeprec_tpu.embedding.table import empty_key
@@ -305,6 +336,7 @@ class ShardedTrainer(Trainer):
         N = self.num_shards
         steps = max(1, int(state.step))
         out = []
+        pulls = {}
         for bname, b in self.bundles.items():
             cfg = b.table.cfg
             sent = empty_key(cfg)
@@ -317,46 +349,152 @@ class ShardedTrainer(Trainer):
                 k = keys_np[m] if b.stacked else keys_np  # [N, C]
                 fq = freq_np[m] if b.stacked else freq_np
                 occ = k != sent
+                k_live = k[occ]
+                f_live = fq[occ].astype(np.float64)
+                pulls[(bname, m)] = (k_live, f_live)
+                snap = self._freq_snaps.get((bname, m))
+                w_steps = steps
+                # A snapshot taken at THIS step means an empty window —
+                # no arrivals to weight by; fall back to lifetime rates
+                # (back-to-back placer runs, e.g. a deferred evaluation
+                # immediately re-run with a different horizon).
+                if snap is not None and steps - snap[0] > 0:
+                    snap_step, snap_keys, snap_freq = snap
+                    w_steps = steps - snap_step
+                    if snap_keys.size:
+                        pos = np.searchsorted(snap_keys, k_live)
+                        pos = np.clip(pos, 0, len(snap_keys) - 1)
+                        hit = snap_keys[pos] == k_live
+                        prev = np.where(hit, snap_freq[pos], 0.0)
+                    else:
+                        prev = np.zeros_like(f_live)
+                    # eviction/row-reinit resets freq mid-window: clamp
+                    f_live = np.maximum(f_live - prev, 0.0)
                 out.append(placement_lib.MemberTraffic(
-                    bundle=bname, member=m, keys=k[occ],
-                    weight=np.minimum(
-                        fq[occ].astype(np.float64) / steps, float(N)
-                    ),
+                    bundle=bname, member=m, keys=k_live,
+                    weight=np.minimum(f_live / w_steps, float(N)),
                     row_bytes=row_bytes, sentinel=sent,
                 ))
+        if return_pulls:
+            return out, pulls
         return out
 
+    def _snapshot_freqs(self, step: int, pulls) -> None:
+        """Stamp the per-key freq counters (sorted by key, host-side) so
+        the NEXT placer run models arrivals over the window since this
+        one — called once per update_placement, reusing the host arrays
+        `_member_traffics(return_pulls=True)` already fetched (no second
+        full-table device pull)."""
+        import numpy as np
+
+        for ref, (k_live, f_live) in pulls.items():
+            order = np.argsort(k_live, kind="stable")
+            self._freq_snaps[ref] = (
+                int(step), k_live[order], f_live[order]
+            )
+
     def update_placement(self, state, *, hot_budget=None,
-                         min_gain: float = 1.05, force: bool = False):
+                         min_gain: Optional[float] = None,
+                         force: bool = False,
+                         horizon_steps: Optional[int] = None):
         """The cost-model placer, end to end: estimate per-shard exchange
         load from the live freq/dedup counters + per-table dims
         (ops/traffic.py), greedily build a candidate ShardPlan per member
-        (parallel/placement.py build_plans), and — when it models at
-        least `min_gain`x less max/mean imbalance than the ACTIVE plan
-        (or force=True) — migrate moved rows between shards and swap the
-        plan at this step boundary. The old plan serves until the swap;
-        migration moves rows verbatim (bit-identical per-key state) and
-        a migration that cannot place every key aborts, keeping the old
-        plan. Adoption rebuilds the jitted steps (plan constants resolve
-        at trace time, the update_budgets stale-executable contract).
+        (parallel/placement.py build_plans, learned-cost-model assisted
+        once trained), and — when it models at least `min_gain`x less
+        max/mean imbalance than the ACTIVE plan AND the modeled
+        straggler-bytes gain amortizes the modeled migration bytes within
+        `horizon_steps` (or force=True, which skips both bars) — migrate
+        moved rows between shards and swap the plan at this step
+        boundary. The old plan serves until the swap; migration moves
+        rows verbatim (bit-identical per-key state) and a migration that
+        cannot place every key aborts, keeping the old plan. Adoption
+        rebuilds the jitted steps (plan constants resolve at trace time,
+        the update_budgets stale-executable contract) and sets the
+        per-destination a2a budget vector (`ShardedTable.plan_dest_hot`).
+
+        Every run also feeds the learned cost model one observation per
+        member: the ACTIVE plan's modeled per-shard bytes next to the
+        window's measured per-shard bytes — the placer's own history is
+        its training set.
 
         Returns (state, report) with a per-bundle report; the global
-        model numbers land on `self.last_placement`."""
+        model + amortization numbers land on `self.last_placement`."""
         import numpy as np
 
         from jax.sharding import NamedSharding
 
         from deeprec_tpu.ops import traffic as T
+        from deeprec_tpu.utils.hashing import hash_shard_np
 
+        cfg = self.replan_config
         hot_budget = (
             self.placement_hot_budget if hot_budget is None else hot_budget
         )
-        members_info = self._member_traffics(state)
+        min_gain = cfg.min_gain if min_gain is None else min_gain
+        horizon = cfg.horizon_steps if horizon_steps is None else horizon_steps
+        step_now = int(state.step)
+        snap_steps = {
+            ref: step_now - snap[0] for ref, snap in self._freq_snaps.items()
+        }
+        members_info, pulls = self._member_traffics(state, return_pulls=True)
         current = {
             (m.bundle, m.member): self._plans[m.bundle].member(m.member)
             for m in members_info
             if m.bundle in self._plans
         }
+        # Learned-cost-model observation: the ACTIVE plan's modeled
+        # per-shard bytes/step vs what the window measured (the per-shard
+        # owner counters, normalized by the window's steps). Recorded
+        # BEFORE planning so even a deferred run teaches the model. The
+        # two sides span different windows (modeled: since the last
+        # placer run; measured: since the last counter reset), so pairs
+        # are recorded only when the windows roughly coincide — a
+        # first-run LIFETIME modeled vector paired with one post-drift
+        # measured window would teach a systematically wrong correction.
+        # The calibration is over the TAIL load only: build_plans queries
+        # the model with tail-only rotation candidates (hot keys are
+        # assigned later, by LPT), so hot-routed keys are excluded from
+        # the modeled X and their modeled contribution subtracted from
+        # the measured y — training and prediction see the same feature
+        # distribution.
+        window_steps = max(1, step_now - self._window_reset_step)
+        measured = self._measured_member_windows(state, window_steps)
+        for m in members_info:
+            ref = (m.bundle, m.member)
+            if ref not in measured or len(m.keys) == 0:
+                continue
+            ss = snap_steps.get(ref)
+            if ss is None or ss <= 0 or ss > 2 * window_steps:
+                continue  # no/empty/over-long modeled window: skip
+            plan = current.get(ref)
+            owner = (
+                plan.owner_np(m.keys) if plan is not None
+                else hash_shard_np(m.keys, self.num_shards)
+            )
+            load = m.weight * m.row_bytes
+            hot_mask = (
+                np.isin(m.keys, np.asarray(plan.hot_keys, m.keys.dtype))
+                if plan is not None and plan.hot_keys else
+                np.zeros(len(m.keys), bool)
+            )
+            modeled_tail = np.bincount(
+                owner[~hot_mask], weights=load[~hot_mask],
+                minlength=self.num_shards,
+            )
+            modeled_hot = np.bincount(
+                owner[hot_mask], weights=load[hot_mask],
+                minlength=self.num_shards,
+            )
+            self.cost_model.record_window(
+                self.cost_model.member_stats(m), modeled_tail,
+                np.maximum(measured[ref] - modeled_hot, 0.0),
+            )
+        # Next placer run models arrivals over the window starting HERE
+        # (freq values survive migration verbatim, so the snapshot is
+        # valid whether or not this run adopts; reuses the host arrays
+        # already pulled above — no second full-table device pull).
+        self._snapshot_freqs(step_now, pulls)
         # Multi-tier bundles keep uniform routing: their demoted rows live
         # in per-(bundle, shard) tier stores the migration cannot move —
         # re-routing a demoted key would strand its trained values/slots
@@ -374,29 +512,75 @@ class ShardedTrainer(Trainer):
         candidate, model_rep = placement_lib.build_plans(
             self.num_shards, plannable, hot_budget=hot_budget,
             base_loads=placement_lib.modeled_loads(self.num_shards, fixed),
+            cost_model=self.cost_model,
         )
-        imb_current = T.shard_imbalance(placement_lib.modeled_loads(
+        loads_current = placement_lib.modeled_loads(
             self.num_shards, members_info, current
-        ))
-        imb_candidate = T.shard_imbalance(placement_lib.modeled_loads(
+        )
+        loads_candidate = placement_lib.modeled_loads(
             self.num_shards, members_info, candidate
-        ))
+        )
+        imb_current = T.shard_imbalance(loads_current)
+        imb_candidate = T.shard_imbalance(loads_candidate)
+        # Amortization: straggler bytes/step saved vs the one-shot
+        # migration bytes (exchange_row_bytes over the rows that would
+        # move) — the replan must pay for itself within the horizon.
+        moved_map = placement_lib.plan_moved_rows(
+            plannable, current, candidate
+        )
+        row_bytes_by_ref = {
+            (m.bundle, m.member): m.row_bytes for m in plannable
+        }
+        mig_bytes = sum(
+            T.migration_bytes(n, row_bytes=row_bytes_by_ref[ref])
+            for ref, n in moved_map.items()
+        )
+        gain = T.replan_gain_bytes(loads_current, loads_candidate)
+        import math
+
         self.last_placement = dict(
             model_rep,
             imbalance_current=round(imb_current, 4),
             imbalance_candidate=round(imb_candidate, 4),
+            gain_bytes_per_step=round(gain, 1),
+            migration_rows=int(sum(moved_map.values())),
+            migration_bytes=round(float(mig_bytes), 1),
+            horizon_steps=horizon,
+            amortize_steps=(
+                int(math.ceil(mig_bytes / gain)) if gain > 0 else None
+            ),
         )
-        adopt = force or imb_candidate * min_gain <= imb_current
+        self._replan_stats["last_gain_bytes_per_step"] = round(gain, 1)
+        from deeprec_tpu.obs import metrics as obs_metrics
+
+        if obs_metrics.metrics_enabled():
+            obs_metrics.default_registry().gauge(
+                "deeprec_placement_modeled_gain",
+                "modeled straggler exchange bytes/step a candidate plan "
+                "would save over the active plan",
+            ).set(gain)
+        imb_ok = imb_candidate * min_gain <= imb_current
+        amortized = gain > 0 and gain * float(horizon) >= mig_bytes
+        adopt = force or (imb_ok and amortized)
         report = {}
         if not adopt:
+            reason = "min_gain" if not imb_ok else "amortization"
+            self._replan_stats["deferred"] = (
+                int(self._replan_stats.get("deferred", 0)) + 1
+            )
+            self._replan_stats["last_deferred_reason"] = reason
             return state, {
-                bname: {"adopted": False, "imbalance_current": imb_current,
-                        "imbalance_candidate": imb_candidate}
+                bname: {"adopted": False, "deferred": reason,
+                        "imbalance_current": imb_current,
+                        "imbalance_candidate": imb_candidate,
+                        "gain_bytes_per_step": round(gain, 1),
+                        "migration_bytes": round(float(mig_bytes), 1)}
                 for bname in self.bundles
             }
 
         tables = dict(state.tables)
         changed_any = False
+        moved_rows, moved_bytes = 0, 0.0
         for bname, b in self.bundles.items():
             if bname in pinned:
                 report[bname] = {"adopted": False, "skipped": "multi_tier"}
@@ -452,30 +636,164 @@ class ShardedTrainer(Trainer):
                 NamedSharding(self.mesh, self._table_spec(bname)),
             )
             self._plans[bname] = bp_new
-            # a2a headroom: the plan concentrates up to this many explicit
-            # hot-key arrivals on one (source, dest) bucket — the budget
-            # model's uniform-spread assumption no longer covers them, so
-            # the per-destination budget grows by exactly that count
-            # (ShardedTable._a2a_budget; static, baked at the jit rebuild).
-            self.sharded[bname].plan_hot_headroom = max(
-                (
-                    int(np.bincount(
-                        np.asarray(p.hot_owners, np.int64),
-                        minlength=self.num_shards,
-                    ).max()) if p.hot_keys else 0
-                )
-                for p in bp_new.plans
+            # Per-destination a2a budget vector: each destination's
+            # bucket pays the hot-key arrivals THIS plan routes to it
+            # (elementwise-max across vmapped members — they share the
+            # bucket) on top of the tail share, which shrinks by the
+            # keys every member routes explicitly
+            # (ShardedTable._a2a_budget / ops/traffic.py
+            # a2a_dest_budgets; static, baked at the jit rebuild).
+            dest_hot = bp_new.dest_hot_counts()
+            if dest_hot.any():
+                self.sharded[bname].plan_dest_hot = dest_hot
+                self.sharded[bname].plan_hot_count = bp_new.hot_count_min()
+            else:
+                self.sharded[bname].plan_dest_hot = None
+                self.sharded[bname].plan_hot_count = 0
+            # (bname, 0) is always in the dict: every non-pinned
+            # bundle's members are in `plannable`, which populated it.
+            moved_bytes += T.migration_bytes(
+                moved_total, row_bytes=row_bytes_by_ref[(bname, 0)],
             )
+            moved_rows += moved_total
             rep.update(adopted=True, moved=moved_total)
             report[bname] = rep
             changed_any = True
         if changed_any:
             self._make_jits()
+            self._replan_stats["replans"] = (
+                int(self._replan_stats["replans"]) + 1
+            )
+            if force:
+                self._replan_stats["forced_replans"] = (
+                    int(self._replan_stats["forced_replans"]) + 1
+                )
+            self._replan_stats["migration_rows"] = (
+                int(self._replan_stats["migration_rows"]) + moved_rows
+            )
+            self._replan_stats["migration_bytes"] = round(
+                float(self._replan_stats["migration_bytes"]) + moved_bytes, 1
+            )
+            if obs_metrics.metrics_enabled():
+                reg = obs_metrics.default_registry()
+                reg.counter(
+                    "deeprec_placement_replans",
+                    "adopted placement replans",
+                    {"trigger": "forced" if force else "auto"},
+                ).inc(1)
+                reg.counter(
+                    "deeprec_placement_migration_bytes",
+                    "modeled bytes of rows migrated at plan adoptions",
+                ).inc(moved_bytes)
         return (
             TrainState(step=state.step, tables=tables, dense=state.dense,
                        opt_state=state.opt_state),
             report,
         )
+
+    def _measured_member_windows(self, state, window_steps: int):
+        """(bundle, member) -> measured per-shard exchange bytes/STEP of
+        the current counter window — the learned cost model's training
+        targets (same unit as the analytic load model). Members whose
+        window saw no arrivals are skipped."""
+        import numpy as np
+
+        out = {}
+        for bname, b in self.bundles.items():
+            ts = state.tables[bname]
+            for m in (range(len(b.features)) if b.stacked else [0]):
+                member_ts = (
+                    jax.tree.map(lambda a, m=m: a[m], ts) if b.stacked
+                    else ts
+                )
+                ps = self._per_shard_stats(b, member_ts)
+                if not ps or sum(ps["owner_arrivals"]) == 0:
+                    continue
+                out[(bname, m)] = (
+                    np.asarray(ps["exchange_bytes"], np.float64)
+                    / max(1, int(window_steps))
+                )
+        return out
+
+    def update_budgets(self, state, **kw):
+        # The owner-load counters reset here; remember where the window
+        # started so the replanner can normalize measured bytes to
+        # bytes/step (the cost model's unit).
+        state, rep = super().update_budgets(state, **kw)
+        self._window_reset_step = int(state.step)
+        return state, rep
+
+    def maybe_replan(self, state):
+        """The drift-driven replan trigger (maintain() runs this BEFORE
+        update_budgets when placement="plan"): publish the window's
+        per-shard telemetry into the obs plane, read the windowed
+        imbalance level + its ring-buffer slope back
+        (obs/metrics.py window queries — the PR 11 consumer contract),
+        and run the placer only when the DriftDetector's hysteresis/
+        cooldown gate fires. The placer itself then applies the
+        min_gain + migration-amortization bars — so the system replans
+        exactly when drift is real AND the move pays for itself."""
+        if self.placement != "plan":
+            return state, {}
+        from deeprec_tpu.obs import metrics as obs_metrics
+
+        cfg = self.replan_config
+        stats = self.dedup_stats(state)  # device_get + gauge publish
+        tables_ps = {
+            t: d["per_shard"] for t, d in stats.items()
+            if isinstance(d, dict) and d.get("per_shard")
+        }
+        level = max(
+            (ps["imbalance"] for ps in tables_ps.values()), default=1.0
+        )
+        slope = None
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.default_registry()
+            slopes = [
+                reg.window(
+                    "deeprec_shard_imbalance", {"table": t},
+                    cfg.window_secs,
+                ).get("slope_per_sec")
+                for t in tables_ps
+            ]
+            slopes = [s for s in slopes if s is not None]
+            slope = max(slopes) if slopes else None
+        fired = self._drift.observe(level, slope)
+        report = {"drift": dict(self._drift.last)}
+        if not fired:
+            return state, report
+        state, placer_rep = self.update_placement(state)
+        if any(
+            r.get("adopted") for r in placer_rep.values()
+            if isinstance(r, dict)
+        ):
+            self._drift.adopted()
+        else:
+            self._drift.deferred()
+        report.update(placer_rep)
+        return state, report
+
+    def placement_stats(self):
+        """Replanner telemetry (surfaced as
+        dedup_stats()['__placement__'] — dunder key, so a real table
+        named 'placement' cannot collide): adoption/migration counters,
+        the last drift observation and the learned cost model's
+        training state."""
+        out = dict(self._replan_stats)
+        out["cost_model"] = self.cost_model.info()
+        if self._drift.last:
+            out["drift"] = dict(self._drift.last)
+        return out
+
+    def dedup_stats(self, state):
+        out = super().dedup_stats(state)
+        if self.placement == "plan":
+            # Added AFTER the per-table gauge publication (super() has
+            # already run _publish_dedup_obs); per-table consumers use
+            # .get("per_shard") and skip this record naturally. Dunder
+            # key: a real table named "placement" must not collide.
+            out["__placement__"] = self.placement_stats()
+        return out
 
     def restore_owner(self, bname: str, member, keys):
         """Owner shard of `keys` under the ACTIVE plan — the checkpoint
@@ -522,17 +840,18 @@ class ShardedTrainer(Trainer):
     def _set_bundle_capacity(self, b, new_c):
         super()._set_bundle_capacity(b, new_c)
         # Re-point the collective wrapper at the grown local table. The
-        # a2a hot-key headroom carries over: the adopted plan still
+        # per-dest a2a budget vector carries over: the adopted plan still
         # concentrates its hot keys regardless of capacity, and dropping
         # it here would re-expose the overflow-degraded hot ids the
-        # headroom exists to prevent (growth and adoption can land in the
+        # budget exists to prevent (growth and adoption can land in the
         # SAME maintain call).
         old = self.sharded[b.name]
         self.sharded[b.name] = ShardedTable(
             b.table, old.num_shards, old.axis, comm=old.comm,
             a2a_slack=old.a2a_slack, exchange_chunks=old.exchange_chunks,
         )
-        self.sharded[b.name].plan_hot_headroom = old.plan_hot_headroom
+        self.sharded[b.name].plan_dest_hot = old.plan_dest_hot
+        self.sharded[b.name].plan_hot_count = old.plan_hot_count
 
     def maintain(self, state, **kw):
         # max_capacity is the GLOBAL cap; the base loop compares against

@@ -1300,8 +1300,17 @@ class Trainer:
         re-shard tables whose plan changed (parallel/placement.py). The
         base trainer has no shard axis — placement is meaningless, so this
         is a no-op; ShardedTrainer implements it and maintain() runs it
-        next to update_budgets when the trainer was built with
-        placement="plan"."""
+        (through the maybe_replan drift gate) next to update_budgets when
+        the trainer was built with placement="plan"."""
+        return state, {}
+
+    def maybe_replan(
+        self, state: TrainState
+    ) -> Tuple[TrainState, Dict[str, Dict[str, float]]]:
+        """Drift-driven replan gate: run the placer only when the live
+        per-shard imbalance telemetry says the key distribution moved AND
+        the modeled gain amortizes the migration. No shard axis on the
+        base trainer — no-op; ShardedTrainer implements."""
         return state, {}
 
     def maintain(
@@ -1342,11 +1351,14 @@ class Trainer:
         import numpy as np
 
         step = int(state.step) if step is None else int(step)
-        # Placement BEFORE update_budgets: the placer wants the window's
-        # owner-load counters, which update_budgets resets.
+        # Placement BEFORE update_budgets: the replanner wants the
+        # window's owner-load counters, which update_budgets resets.
+        # maybe_replan is the drift gate — the placer itself runs only
+        # when the windowed imbalance telemetry breaches the ReplanConfig
+        # trigger and the modeled gain amortizes the migration.
         placement_report = {}
         if getattr(self, "placement", "uniform") == "plan":
-            state, placement_report = self.update_placement(state)
+            state, placement_report = self.maybe_replan(state)
         # Dedup telemetry: fold counters into the auto-budget EMA,
         # reset them, and carry the per-bundle stats into the report.
         state, dedup_report = self.update_budgets(state)

@@ -196,6 +196,7 @@ def assert_imbalance(json_path: str, factor: float, tol: float) -> int:
             file=sys.stderr,
         )
         rc = 1
+    rc |= _assert_drift(pl.get("drift"))
     if rc == 0:
         print(
             f"roofline: placement gate ok — imbalance {before:.3f} -> "
@@ -203,6 +204,79 @@ def assert_imbalance(json_path: str, factor: float, tol: float) -> int:
             f"{factor:.1f}x), step {ms.get('uniform')} -> {ms.get('plan')}"
             f" ms, moved {pl.get('moved_rows')} rows, "
             f"{pl.get('hot_keys')} hot keys"
+        )
+    return rc
+
+
+def _assert_drift(drift, peak_floor: float = 2.0,
+                  recover_bound: float = 1.3) -> int:
+    """Drifting-skew replanning gates (bench.py placement 'drift' arm,
+    round 19): after the hot set rotates mid-stream the stale plan's
+    measured imbalance must spike past `peak_floor`, an AUTOMATIC
+    (drift-triggered, amortization-approved, never forced) replan must
+    fire, and the trajectory must recover to <= `recover_bound` — with
+    ZERO a2a overflow across the whole run (the per-dest budget's
+    drift-safety margin covers the stale window) and the per-dest-budget
+    wire model strictly below the v1 global-headroom model with the
+    compiled buckets matching the budget vector exactly."""
+    if not drift:
+        print("roofline: placement record has no 'drift' arm — run "
+              "bench.py --placement grid", file=sys.stderr)
+        return 1
+    rc = 0
+    reps = drift.get("replans", {})
+    if reps.get("post_drift_auto", 0) < 1:
+        print("roofline: drift gate FAILED — no automatic post-drift "
+              f"replan fired (replans: {reps})", file=sys.stderr)
+        rc = 1
+    if reps.get("forced", 0):
+        print("roofline: drift gate FAILED — replans were forced "
+              f"({reps}); the trigger path was not exercised",
+              file=sys.stderr)
+        rc = 1
+    peak = drift.get("peak_post_drift") or 0.0
+    if peak < peak_floor:
+        print(
+            f"roofline: drift gate FAILED — post-drift imbalance peaked "
+            f"at {peak:.3f} < {peak_floor:.1f}: the rotation no longer "
+            f"stresses the stale plan (workload drifted?)",
+            file=sys.stderr)
+        rc = 1
+    rec = drift.get("recovered_imbalance")
+    if rec is None or rec > recover_bound:
+        print(
+            f"roofline: drift gate FAILED — imbalance recovered to "
+            f"{rec} > {recover_bound} after the replan(s): the replanner "
+            f"no longer flattens the rotated hot set", file=sys.stderr)
+        rc = 1
+    if drift.get("a2a_overflow", 1) != 0:
+        print(
+            f"roofline: drift gate FAILED — {drift.get('a2a_overflow')} "
+            f"a2a overflow(s): the per-dest budget degraded rows "
+            f"(default-served) somewhere in the drift window",
+            file=sys.stderr)
+        rc = 1
+    if not drift.get("budgets_measured_eq_modeled"):
+        print(
+            "roofline: drift gate FAILED — a compiled a2a bucket "
+            "diverged from the modeled per-dest budget vector "
+            f"(budgets: {drift.get('budgets')})", file=sys.stderr)
+        rc = 1
+    wp = drift.get("wire_bytes_per_dest_model")
+    wg = drift.get("wire_bytes_global_headroom_model")
+    if wp is None or wg is None or not wp < wg:
+        print(
+            f"roofline: drift gate FAILED — per-dest-budget wire bytes "
+            f"{wp} not strictly below the global-headroom model {wg}",
+            file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: drift gate ok — peak {peak:.3f} -> recovered "
+            f"{rec:.3f} (bound {recover_bound}), "
+            f"{reps.get('post_drift_auto')} automatic post-drift "
+            f"replan(s), 0 overflow, wire {wp:.0f} < global {wg:.0f} "
+            f"({wg / max(wp, 1e-9):.2f}x diet)"
         )
     return rc
 
