@@ -646,6 +646,268 @@ def _placement_drift_arm(smoke):
     }
 
 
+def _mesh_workload():
+    """Pod-scale 2-D mesh bench (round 19): flat 1-D exchange vs the
+    hierarchical two-tier exchange on the same high-overlap stream.
+
+    Runs in its OWN subprocess (stdout = one JSON line) on the forced
+    virtual 8-device CPU mesh, like the placement arm. Workload: the
+    skew-bench model drawing per-table zipf ids from one SMALL shared id
+    space (`vocab=1500, offset_ids=False`) so devices inside a host group
+    see heavily overlapping id sets — the regime the intra-tier
+    aggregation exists for (a disjoint stream would make U_g = intra·U
+    and the hierarchy pointless).
+
+    Arms (mode "grid" runs all; "1d"/"2d" subsets):
+      1d_a2a      make_mesh(8),        comm="a2a"     — the flat baseline
+      2d_hier     make_mesh_2d(4, 2),  comm="hier"    — two-tier exchange
+      2d_nested   same mesh/comm, pipeline_mode="nested" K-scan — the
+                  inter-tier id exchange of batch t+1 hoisted behind
+                  dense(t) across BOTH tiers
+    Every arm records its first-step loss from a fresh init (the forward
+    is exact under the hierarchy — one contributor per psum_scatter
+    position — so all arms must agree BITWISE), single-step and K-scan
+    ms/step under trace_guard (steady compiles: contract 0), and the a2a
+    overflow counters (contract 0).
+
+    The hier arm also records the per-tier wire model at the measured
+    unique budget — `ops/traffic.py hier_exchange_bytes` next to
+    `flat_exchange_tier_bytes` (the flat a2a mapped onto the same 2×4
+    topology) — plus the compiled inter bucket vs the model's vector max
+    (must agree exactly, same discipline as the drift arm's budgets).
+    `tools/roofline.py --assert-hierarchy` gates: inter-tier modeled
+    bytes ≤ total_flat/intra AND ≤ 0.5× flat inter-host bytes, 0
+    overflow, 0 steady compiles, bitwise loss parity, nested K-scan
+    within tolerance of the unpipelined hier K-scan."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeprec_tpu.analysis import trace_guard
+    from deeprec_tpu.data import SyntheticCriteo
+    from deeprec_tpu.ops import traffic as T
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.parallel import (
+        ShardedTrainer, make_mesh, make_mesh_2d, shard_batch,
+    )
+    from deeprec_tpu.training import stack_batches
+
+    mode = os.environ.get("BENCH_MESH", "grid")
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    N, INTRA, INTER = 8, 4, 2
+    GROUP_FACTOR = 1.5
+    SLACK = 2.0
+    ZIPF = [2.2, 2.0, 1.8, 1.6]
+    DIMS = [32, 16, 16, 8]
+    # Batch large enough that the per-device unique budget clears the
+    # multiple-of-8 bucket rounding by a wide margin — at tiny U the
+    # rounding, not the hierarchy, sets the inter bucket and the modeled
+    # ratios are granularity noise.
+    B = 1024
+    K = 4
+    n_batches = 8
+    prefill = 4 if smoke else 8
+    reps = 2 if smoke else 3
+    timed_steps = 4 if smoke else 8
+
+    gen = SyntheticCriteo(
+        batch_size=B, num_cat=len(DIMS), num_dense=2, vocab=1500,
+        seed=5, zipf_a=ZIPF, offset_ids=False,
+    )
+    host_batches = [
+        {k: jnp.asarray(v) for k, v in gen.batch().items()}
+        for _ in range(n_batches)
+    ]
+
+    def run_arm(mesh, comm, pipeline_mode="off", group_factor=None):
+        tr = ShardedTrainer(
+            _skew_bench_model(DIMS), Adagrad(lr=0.1), mesh=mesh, comm=comm,
+            a2a_slack=SLACK, pipeline_mode=pipeline_mode, pipeline_chunks=2,
+            hier_group_factor=group_factor,
+        )
+        sb = [shard_batch(mesh, b) for b in host_batches]
+        st = tr.init(0)
+        # First step from a FRESH init on the shared batch: the parity
+        # anchor (forward is exact, so every arm must agree bitwise).
+        st, mets = tr.train_step(st, sb[0])
+        first_loss = float(mets["loss"])
+        for i in range(1, prefill):
+            st, mets = tr.train_step(st, sb[i % n_batches])
+        jax.block_until_ready(mets["loss"])
+
+        # Timed single-step windows. Record-only guard (the gate reads
+        # the count): the arm is measured, not hard-failed mid-bench.
+        times = []
+        with trace_guard(max_compiles=None, note=f"mesh {comm} step") as g1:
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for i in range(timed_steps):
+                    st, mets = tr.train_step(st, sb[i % n_batches])
+                jax.block_until_ready(mets["loss"])
+                times.append((time.perf_counter() - t0) / timed_steps * 1e3)
+        # Snapshot NOW: .compiles reads the process-wide counter live, so
+        # a late read would absorb the scan warmup's legitimate compiles.
+        step_compiles = g1.compiles
+        # K-step scan arm (where pipeline_mode engages).
+        sh = NamedSharding(mesh, P(None, tr.axis))
+        stacked = [
+            jax.device_put(
+                stack_batches(
+                    [host_batches[(d * K + i) % n_batches] for i in range(K)]
+                ),
+                sh,
+            )
+            for d in range(2)
+        ]
+        st, mets = tr.train_steps(st, stacked[0])  # warm: compile K-path
+        jax.block_until_ready(mets["loss"])
+        scan_times = []
+        with trace_guard(max_compiles=None, note=f"mesh {comm} scan") as g2:
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for d in range(len(stacked)):
+                    st, mets = tr.train_steps(st, stacked[d])
+                jax.block_until_ready(mets["loss"])
+                scan_times.append(
+                    (time.perf_counter() - t0) / (len(stacked) * K) * 1e3
+                )
+        scan_compiles = g2.compiles
+        overflow = sum(
+            int(np.sum(np.asarray(jax.device_get(ts.a2a_overflow))))
+            for ts in st.tables.values()
+        )
+        return {
+            "first_loss": first_loss,
+            "step_ms": round(min(times), 3),
+            "scan_ms_per_step": round(min(scan_times), 3),
+            "steady_compiles": step_compiles + scan_compiles,
+            "overflow": overflow,
+        }, tr
+
+    arms = {}
+    hier_tr = None
+    if mode in ("1d", "grid"):
+        arms["1d_a2a"], _ = run_arm(make_mesh(N), "a2a")
+    if mode in ("2d", "grid"):
+        mesh2 = make_mesh_2d(INTRA, INTER)
+        arms["2d_hier"], hier_tr = run_arm(
+            mesh2, "hier", group_factor=GROUP_FACTOR
+        )
+        arms["2d_nested"], _ = run_arm(
+            make_mesh_2d(INTRA, INTER), "hier", pipeline_mode="nested",
+            group_factor=GROUP_FACTOR,
+        )
+
+    report = {
+        "mode": mode,
+        "device": jax.devices()[0].platform,
+        "num_devices": N,
+        "shape_2d": {"intra": INTRA, "inter": INTER},
+        "group_factor": GROUP_FACTOR,
+        "slack": SLACK,
+        "zipf": ZIPF, "dims": DIMS, "batch": B,
+        "steps_per_dispatch": K,
+        "arms": arms,
+        "first_loss_equal": len({a["first_loss"] for a in arms.values()}) <= 1,
+        "overflow": sum(a["overflow"] for a in arms.values()),
+        "trace_guard": {
+            "budget": 0,
+            "steady_state_compiles": sum(
+                a["steady_compiles"] for a in arms.values()
+            ),
+        },
+    }
+    if hier_tr is not None:
+        # Per-tier wire model at each bundle's MEASURED unique budget,
+        # next to the flat a2a mapped onto the same topology; the
+        # compiled inter bucket must equal the model's vector max.
+        tiers = {}
+        hier_intra = hier_inter = 0.0
+        flat_intra = flat_inter = flat_total = 0.0
+        buckets_match = True
+        for bname, b in hier_tr.bundles.items():
+            sh_t = hier_tr.sharded[bname]
+            U = sh_t.last_a2a_unique
+            cfg = b.table.cfg
+            wire_b = 2 if cfg.exchange_dtype == "bfloat16" else 4
+            n_members = len(b.features) if b.stacked else 1
+            hb = T.hier_exchange_bytes(
+                unique=U, intra=INTRA, inter=INTER, dim=cfg.dim,
+                wire_bytes=wire_b, slack=sh_t.a2a_slack,
+                group_factor=sh_t.hier_group_factor,
+                dest_hot=sh_t.plan_dest_hot, hot_count=sh_t.plan_hot_count,
+            )
+            fb = T.flat_exchange_tier_bytes(
+                unique=U, num_shards=N, intra=INTRA, comm="a2a",
+                dim=cfg.dim, wire_bytes=wire_b, slack=sh_t.a2a_slack,
+            )
+            match = int(hb["bucket_rows"]) == sh_t.last_a2a_bucket
+            buckets_match &= match
+            hier_intra += n_members * hb["intra_bytes"]
+            hier_inter += n_members * hb["inter_bytes"]
+            flat_intra += n_members * fb["intra_bytes"]
+            flat_inter += n_members * fb["inter_bytes"]
+            flat_total += n_members * fb["total_bytes"]
+            tiers[bname] = {
+                "unique": U,
+                "group_unique_budget": int(hb["group_unique_budget"]),
+                "bucket_rows": sh_t.last_a2a_bucket,
+                "modeled_bucket_rows": int(hb["bucket_rows"]),
+                "measured_eq_modeled": match,
+            }
+        report["hier"] = {
+            "per_bundle": tiers,
+            "modeled_bytes": {
+                "hier_intra": round(hier_intra),
+                "hier_inter": round(hier_inter),
+                "flat_a2a_intra": round(flat_intra),
+                "flat_a2a_inter": round(flat_inter),
+                "flat_a2a_total": round(flat_total),
+            },
+            "inter_ratio_vs_flat_inter": round(
+                hier_inter / max(flat_inter, 1e-9), 4
+            ),
+            "inter_ratio_vs_flat_total_over_intra": round(
+                hier_inter / max(flat_total / INTRA, 1e-9), 4
+            ),
+            "buckets_measured_eq_modeled": bool(buckets_match),
+        }
+    print(json.dumps(report))
+
+
+def _run_mesh_worker():
+    """Spawn _mesh_workload on a forced 8-device CPU mesh; returns its
+    JSON section (or an error record — the bench JSON stays usable)."""
+    env = dict(os.environ)
+    env.pop("BENCH_WORKER", None)
+    env["BENCH_MESH_WORKER"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        t for t in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in t
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=1200, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh workload timed out"}
+    if r.returncode != 0:
+        return {"error": "mesh workload rc=%d: %s" % (
+            r.returncode, _error_line(r.stderr or ""))}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": "mesh workload produced no JSON"}
+
+
 def _run_placement_worker():
     """Spawn _placement_workload on a forced 8-device CPU mesh; returns
     its JSON section (or an error record — the bench JSON stays usable)."""
@@ -1121,6 +1383,15 @@ def workload():
         if os.environ.get("BENCH_PLACEMENT", "off") != "off"
         else None
     )
+    # Pod-scale 2-D mesh arm (round 19): flat 1-D a2a vs the two-tier
+    # hierarchical exchange (+ nested lookahead) with the per-tier wire
+    # model (own subprocess — needs the virtual mesh). Gated in CI by
+    # tools/roofline.py --assert-hierarchy.
+    mesh_rec = (
+        _run_mesh_worker()
+        if os.environ.get("BENCH_MESH", "off") != "off"
+        else None
+    )
     # --profile reuses the phase breakdown the pipeline report already
     # measured instead of running the (multi-second) protocol twice.
     phases = (
@@ -1199,6 +1470,12 @@ def workload():
                 # (adopted ShardPlan) + step time per arm — gated by
                 # tools/roofline.py --assert-imbalance in CI smoke.
                 **({"placement": placement} if placement else {}),
+                # Pod-scale 2-D mesh (round 19): per-tier modeled wire
+                # bytes of the hierarchical exchange vs flat a2a on the
+                # same topology, bitwise loss parity across arms, 0
+                # overflow / steady compiles, nested K-scan — gated by
+                # tools/roofline.py --assert-hierarchy in CI smoke.
+                **({"mesh": mesh_rec} if mesh_rec else {}),
                 **({"phases": phases} if phases else {}),
                 "flags": {
                     "f32_row": _fl.AUTO_TRUSTS_F32_ROW,
@@ -1249,6 +1526,15 @@ def main():
                         "the hash baseline; 'plan' is an alias of grid "
                         "(the plan arm needs the uniform window first); "
                         "'off' (default) skips the section")
+    p.add_argument("--mesh", nargs="?", const="grid",
+                   default=os.environ.get("BENCH_MESH", "off"),
+                   choices=["off", "1d", "2d", "grid"],
+                   help="pod-scale 2-D mesh arm on the virtual 8-device "
+                        "mesh (own subprocess): 'grid' (bare --mesh) "
+                        "measures flat 1-D a2a AND the 2x4 hierarchical "
+                        "two-tier exchange (+ nested lookahead K-scan) "
+                        "with the per-tier wire model (JSON 'mesh'); "
+                        "'1d'/'2d' run one side; 'off' (default) skips")
     p.add_argument("--profile", action="store_true",
                    help="add a per-phase step breakdown (lookup / sparse "
                         "apply / dense+overhead, training/profiler.py) to "
@@ -1269,6 +1555,7 @@ def main():
     os.environ["BENCH_UNIQUE_BUDGET"] = str(args.unique_budget)
     os.environ["BENCH_PIPELINE"] = str(args.pipeline_mode)
     os.environ["BENCH_PLACEMENT"] = str(args.placement)
+    os.environ["BENCH_MESH"] = str(args.mesh)
     if args.profile:
         os.environ["BENCH_PROFILE"] = "1"
     if args.smoke:
@@ -1309,6 +1596,8 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("BENCH_PLACEMENT_WORKER") == "1":
         _placement_workload()
+    elif os.environ.get("BENCH_MESH_WORKER") == "1":
+        _mesh_workload()
     elif os.environ.get("BENCH_WORKER") == "1":
         workload()
     else:

@@ -79,7 +79,7 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
-    BENCH_PIPELINE=grid python bench.py --placement --smoke \
+    BENCH_PIPELINE=grid python bench.py --placement --mesh --smoke \
     | tee /tmp/deeprec_bench_smoke.out
 tail -n 1 /tmp/deeprec_bench_smoke.out > /tmp/deeprec_bench_smoke.json
 
@@ -94,6 +94,10 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== skew-aware placement vs uniform hash + drifting-skew replanning (imbalance/drift gates fail the smoke: auto replan, recovery, zero a2a overflow, per-dest budget diet) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-imbalance /tmp/deeprec_bench_smoke.json
+
+echo "== pod-scale 2-D mesh gate (hier inter-tier wire diet vs flat a2a, bitwise loss parity, zero overflow/steady compiles, nested K-scan bound) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-hierarchy /tmp/deeprec_bench_smoke.json
 
 echo "== steady-state retrace gate (compiles inside timed windows fail the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \

@@ -186,6 +186,211 @@ def a2a_exchange_wire_bytes(
     )
 
 
+# ------------------------------------------ hierarchical (two-tier) model
+#
+# The 2-D mesh splits the flat device axis into a cheap `intra` tier
+# (same host group: ICI/NVLink) and an expensive `inter` tier (DCN).
+# The hierarchical exchange aggregates ids per host-group on the cheap
+# tier first — cross-device duplicates collapse at a relay before
+# anything crosses the expensive tier — so the inter-tier bucket is
+# budgeted off the GROUP uniques (U_g ≤ group_factor·U ≤ intra·U), not
+# off intra·U raw gathered rows. `ShardedTable._hier_budget` calls
+# `hier_dest_budgets` directly: model and program share one formula by
+# construction, and `bench.py --mesh` records both per-tier modeled and
+# measured bytes for `roofline.py --assert-hierarchy` to gate.
+
+
+def hier_group_unique_budget(
+    *, unique: int, intra: int, group_factor: Optional[float] = None,
+) -> int:
+    """Static budget U_g for the per-host-group unique ids after the
+    intra-tier aggregation. `group_factor=None` means exact (intra·U —
+    no dedup assumed, the inter bucket can never bind on group overlap);
+    a float f budgets U_g = ceil(f·U), capped at intra·U, expressing the
+    expected cross-device id overlap inside a group (f→1 as devices in a
+    group see the same hot ids). Rounded up to a multiple of 8."""
+    import math
+
+    U, I = int(unique), int(intra)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    cap = I * U
+    if group_factor is None:
+        return cap
+    ug = min(cap, math.ceil(float(group_factor) * U))  # noqa: DRT002 — group_factor is a host float knob, no device value
+    return min(cap, ((ug + 7) // 8) * 8)
+
+
+def hier_relay_rows(*, unique: int, intra: int) -> int:
+    """Static size of the relay dedup stage: the intra-tier allgather
+    hands every device intra·U rows; the relay (device i of each group
+    handles gathered ids whose owner sits at intra position i) dedups
+    over that full static extent — compute-only, nothing crosses a
+    wire at this size."""
+    return int(intra) * int(unique)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+
+
+def hier_dest_budgets(
+    *,
+    unique: int,
+    intra: int,
+    inter: int,
+    slack: float = 2.0,
+    group_factor: Optional[float] = None,
+    dest_hot=None,
+    hot_count: int = 0,
+    floor: int = 8,
+):
+    """Per-destination-GROUP budgets [J] (rows) of the inter-tier a2a.
+
+    Each relay holds ~U_g/intra of its group's uniques (owner intra-pos
+    partitions the group uniques across relays under a uniform hash), and
+    buckets them by owner GROUP — J destinations. This reuses the per-dest
+    budget discipline of `a2a_dest_budgets` verbatim at the group tier:
+    `dest_hot` is the plan's per-device hot arrival vector [N] folded to
+    per-group maxima over the relay position (all relays compile one
+    bucket), `hot_count` the plan hot keys removed from the tail (split
+    across relays). Overflow degrades via the sentinel bucket exactly as
+    in the flat a2a — default-served, counted, never dropped."""
+    import math
+
+    import numpy as np
+
+    I, J = int(intra), int(inter)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    ug = hier_group_unique_budget(
+        unique=unique, intra=I, group_factor=group_factor
+    )
+    relay_u = math.ceil(ug / I)
+    group_hot = None
+    if dest_hot is not None:
+        hot = np.asarray(dest_hot, np.int64)  # noqa: DRT002 — host plan constants (numpy), never a device value
+        if hot.shape != (J * I,):
+            raise ValueError(
+                f"dest_hot must be a length-{J * I} per-device vector, "
+                f"got shape {hot.shape}"
+            )
+        group_hot = hot.reshape(J, I).max(axis=1)
+    return a2a_dest_budgets(
+        unique=relay_u, num_shards=J, slack=slack,
+        dest_hot=group_hot, hot_count=math.ceil(int(hot_count) / I),  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+        floor=floor,
+    )
+
+
+def hier_bucket_rows(
+    *,
+    unique: int,
+    intra: int,
+    inter: int,
+    slack: float = 2.0,
+    group_factor: Optional[float] = None,
+    dest_hot=None,
+    hot_count: int = 0,
+    floor: int = 8,
+) -> int:
+    """The uniform physical inter-tier bucket (max of the per-group
+    budget vector — all_to_all chunks are equal)."""
+    return int(hier_dest_budgets(
+        unique=unique, intra=intra, inter=inter, slack=slack,
+        group_factor=group_factor, dest_hot=dest_hot, hot_count=hot_count,
+        floor=floor,
+    ).max())
+
+
+def hier_exchange_bytes(
+    *,
+    unique: int,
+    intra: int,
+    inter: int,
+    dim: int,
+    wire_bytes: int = 4,
+    key_bytes: int = 4,
+    slack: float = 2.0,
+    group_factor: Optional[float] = None,
+    dest_hot=None,
+    hot_count: int = 0,
+    intra_bw_gbs: Optional[float] = None,
+    inter_bw_gbs: Optional[float] = None,
+) -> Dict[str, float]:
+    """Per-device per-step wire bytes of the hierarchical exchange, split
+    by tier (the whole point of the 2-D mesh: the tiers have different
+    bandwidths, so one aggregate byte count hides the term that matters).
+
+    intra tier (cheap) per device:
+      id+count allgather        (I−1)·U·(kb+4)
+      value psum_scatter        (I−1)·U·D·wb   (tiled partial sums)
+      grad allgather            (I−1)·U·D·wb
+    inter tier (expensive) per device, bucket B_g = hier_bucket_rows:
+      id+count buckets out      (J−1)·B_g·(kb+4)
+      embeddings back           (J−1)·B_g·D·wb
+      grads out                 (J−1)·B_g·D·wb
+
+    With `intra_bw_gbs`/`inter_bw_gbs` (GB/s per device, e.g. ICI vs DCN
+    injection bandwidth) the dict also carries modeled per-tier
+    milliseconds — the roofline form `bench.py --mesh` records."""
+    U, D, I, J = int(unique), int(dim), int(intra), int(inter)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    kb, wb = int(key_bytes), int(wire_bytes)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    Bg = hier_bucket_rows(
+        unique=U, intra=I, inter=J, slack=slack, group_factor=group_factor,
+        dest_hot=dest_hot, hot_count=hot_count,
+    )
+    intra_b = float(
+        (I - 1) * U * (kb + 4) + 2 * (I - 1) * U * D * wb
+    )
+    inter_b = float(
+        (J - 1) * Bg * (kb + 4) + 2 * (J - 1) * Bg * D * wb
+    )
+    out: Dict[str, float] = {
+        "intra_bytes": intra_b,
+        "inter_bytes": inter_b,
+        "total_bytes": intra_b + inter_b,
+        "bucket_rows": float(Bg),
+        "group_unique_budget": float(hier_group_unique_budget(
+            unique=U, intra=I, group_factor=group_factor
+        )),
+    }
+    if intra_bw_gbs:
+        out["intra_ms"] = intra_b / (float(intra_bw_gbs) * 1e9) * 1e3
+    if inter_bw_gbs:
+        out["inter_ms"] = inter_b / (float(inter_bw_gbs) * 1e9) * 1e3
+    return out
+
+
+def flat_exchange_tier_bytes(
+    *,
+    unique: int,
+    num_shards: int,
+    intra: int,
+    comm: str = "a2a",
+    dim: int = 16,
+    wire_bytes: int = 4,
+    key_bytes: int = 4,
+    slack: float = 2.0,
+) -> Dict[str, float]:
+    """The FLAT exchange's per-device bytes mapped onto the two-tier
+    topology: of its N−1 remote peers, I−1 sit inside the host group
+    (intra tier) and N−I across groups (inter tier). This is the
+    baseline column of the hierarchy diet — `roofline.py
+    --assert-hierarchy` pins hier inter_bytes ≤ total/intra and
+    ≤ 0.5 × this function's inter_bytes at the reference shape."""
+    U, D, N, I = int(unique), int(dim), int(num_shards), int(intra)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    kb, wb = int(key_bytes), int(wire_bytes)  # noqa: DRT002 — trace-time budget arithmetic on static shapes, no device value
+    if comm == "a2a":
+        Bd = a2a_bucket_rows(unique=U, num_shards=N, slack=slack)
+        row = (kb + 4) + 2 * D * wb
+        return {
+            "intra_bytes": float((I - 1) * Bd * row),
+            "inter_bytes": float((N - I) * Bd * row),
+            "total_bytes": float((N - 1) * Bd * row),
+        }
+    if comm == "allgather":
+        row = (kb + 4) + 2 * D * wb
+        return {
+            "intra_bytes": float((I - 1) * U * row),
+            "inter_bytes": float((N - I) * U * row),
+            "total_bytes": float((N - 1) * U * row),
+        }
+    raise ValueError(f"unknown comm {comm!r}")
+
+
 # --------------------------------------------- replanning amortization model
 
 

@@ -1,4 +1,12 @@
-from deeprec_tpu.parallel.mesh import make_mesh, shard_batch
+from deeprec_tpu.parallel.mesh import (
+    DATA_AXIS,
+    INTER_AXIS,
+    INTRA_AXIS,
+    make_mesh,
+    make_mesh_2d,
+    mesh_batch_axes,
+    shard_batch,
+)
 from deeprec_tpu.parallel.sharded import ShardedLookup, ShardedRoute, ShardedTable
 from deeprec_tpu.parallel.trainer import ShardedTrainer
 from deeprec_tpu.parallel.async_stage import AsyncShardedTrainer, AsyncState

@@ -41,6 +41,52 @@ from deeprec_tpu.training.trainer import TrainState, Trainer
 EXIT_RESCALE = 42
 
 
+def factorize_mesh(n: int, prefer_intra: int) -> Tuple[int, int]:
+    """Pick an ``(intra, inter)`` factorization for `n` surviving devices.
+
+    After a rescale changes the device count, a 2-D hierarchical mesh
+    (`make_mesh_2d`) must be rebuilt with ``intra * inter == n`` — a
+    host-group leaving rarely preserves the old shape. Policy: keep the
+    cheap tier as wide as possible without exceeding its old width
+    (`prefer_intra`, typically the chips-per-host ICI domain, which the
+    hardware bounds), i.e. the largest divisor of `n` that is
+    ``<= prefer_intra`` with co-factor ``>= 2``. When no such divisor
+    exists (prime counts, n < 4), degrade to 1-D — ``(n, 1)`` — rather
+    than wedge: every n >= 1 gets a buildable mesh, and comm="hier"
+    callers fall back to the flat exchange on the 1-D result.
+    """
+    if n < 1:
+        raise ValueError(f"factorize_mesh: n must be >= 1, got {n}")
+    for cand in range(min(int(prefer_intra), n // 2), 1, -1):
+        if n % cand == 0:
+            return cand, n // cand
+    return n, 1  # 1-D degrade
+
+
+def plan_mesh_after_rescale(n: int, old_mesh=None):
+    """Build the mesh for `n` surviving devices, preserving the old
+    mesh's hierarchy when one exists.
+
+    1-D old mesh (or None) -> 1-D new mesh. 2-D old mesh -> the
+    `factorize_mesh` shape seeded with the old intra width, degrading to
+    1-D when `n` has no valid factorization (never raises for n >= 1 up
+    to the available device count). Use on the respawn side of an
+    EXIT_RESCALE cycle, before `reshard`/checkpoint restore — restore is
+    mesh-shape independent, so the state loads regardless of which shape
+    comes back.
+    """
+    from deeprec_tpu.parallel.mesh import make_mesh, make_mesh_2d
+
+    names = tuple(old_mesh.axis_names) if old_mesh is not None else ()
+    if len(names) != 2:
+        return make_mesh(n)
+    old_intra = int(old_mesh.shape[names[1]])
+    intra, inter = factorize_mesh(n, old_intra)
+    if inter == 1:
+        return make_mesh(n)
+    return make_mesh_2d(intra, inter)
+
+
 def reshard(
     src_trainer: Trainer,
     src_state: TrainState,

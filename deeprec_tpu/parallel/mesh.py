@@ -6,20 +6,85 @@ parallelism (tables hash-sharded across the same devices) — exactly the
 topology of DeepRec's CollectiveStrategy scope()/embedding_scope() over
 HybridBackend/SOK (group_embedding_collective_strategy.py:29-108), with the
 NVLink/NCCL exchanges replaced by XLA collectives over ICI.
+
+Pod-scale meshes are 2-D (`make_mesh_2d`): a cheap `intra` axis over
+same-host/ICI peers and an expensive `inter` axis across host groups (DCN).
+Devices are laid out host-major so the flat rank ``g * intra + i`` of device
+``(inter=g, intra=i)`` equals its 1-D `make_mesh` position — hash-shard
+ownership, placement plans and checkpoints are therefore identical across
+mesh shapes (see docs/multihost.md).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Canonical axis names. shard_map callers and mesh builders must agree on
+# these strings; drift fails only at trace time with an opaque unbound-axis
+# error, so every in-repo user imports the constants instead of re-spelling
+# the literal.
+DATA_AXIS = "data"
+INTRA_AXIS = "intra"  # cheap tier: same host group (ICI / NVLink)
+INTER_AXIS = "inter"  # expensive tier: across host groups (DCN)
 
-def make_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+# An "axis spec" as accepted by collectives / PartitionSpec entries: the 1-D
+# mesh uses the plain string, the 2-D mesh the (inter, intra) tuple —
+# inter-major so the flattened device order matches the 1-D mesh.
+AxisSpec = Union[str, Tuple[str, ...]]
+
+
+def make_mesh(num_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
     devs = jax.devices()
     n = num_devices or len(devs)
     return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def make_mesh_2d(intra: int, inter: Optional[int] = None) -> Mesh:
+    """Two-tier mesh: axes ``(inter, intra)`` over ``inter * intra`` devices.
+
+    `jax.devices()` enumerates devices host-major (all of process 0, then
+    process 1, ...), so reshaping to ``(inter, intra)`` puts same-host /
+    ICI-adjacent peers along the trailing `intra` axis — the cheap tier —
+    and host-group boundaries along `inter`.  Flat rank of device
+    ``(g, i)`` is ``g * intra + i``: identical to its `make_mesh` position,
+    which keeps hash ownership and checkpoints mesh-shape independent.
+    """
+    devs = jax.devices()
+    if inter is None:
+        if len(devs) % intra:
+            raise ValueError(
+                f"intra={intra} does not divide device count {len(devs)}"
+            )
+        inter = len(devs) // intra
+    n = intra * inter
+    if n > len(devs):
+        raise ValueError(
+            f"mesh {inter}x{intra} needs {n} devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs[:n]).reshape(inter, intra)
+    return Mesh(grid, (INTER_AXIS, INTRA_AXIS))
+
+
+def mesh_batch_axes(mesh: Mesh) -> AxisSpec:
+    """The axis spec the batch dimension shards over: the single data axis
+    of a 1-D mesh, or the (inter, intra) tuple of a 2-D mesh.  Tuple order
+    is mesh-major (inter first) so flat collectives over it enumerate
+    devices in 1-D rank order."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def axis_size(mesh: Mesh, axes: Optional[AxisSpec] = None) -> int:
+    axes = mesh_batch_axes(mesh) if axes is None else axes
+    if isinstance(axes, str):
+        axes = (axes,)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
 
 
 def put_global(x, sharding: NamedSharding):
@@ -58,9 +123,14 @@ def put_tiled_global(local: "np.ndarray", lead: tuple, sharding: NamedSharding):
     return jax.device_put(stacked, sharding)
 
 
-def shard_batch(mesh: Mesh, batch: dict, axis: str = "data",
+def shard_batch(mesh: Mesh, batch: dict, axis: Optional[AxisSpec] = None,
                 stacked: bool = False) -> dict:
     """Place a host batch with batch-dim sharding over the mesh.
+
+    2-D-mesh aware: when `axis` is left None it is derived from the mesh —
+    the single data axis of a 1-D mesh, or the ``(inter, intra)`` tuple of a
+    `make_mesh_2d` mesh (batch splits over ALL devices either way, in the
+    same flat order).
 
     stacked=True places a K-stacked batch pytree (leading [K, ...] axis,
     `training.stack_batches`) for `train_steps`: the K axis stays
@@ -70,6 +140,8 @@ def shard_batch(mesh: Mesh, batch: dict, axis: str = "data",
     initialized), each process passes its LOCAL slice of the batch — sized
     B_global * local_devices / global_devices — and the global array is
     assembled across hosts (data stays put; no DCN transfer)."""
+    if axis is None:
+        axis = mesh_batch_axes(mesh)
     sharding = NamedSharding(mesh, P(None, axis) if stacked else P(axis))
     if jax.process_count() > 1:
         return {

@@ -50,6 +50,7 @@ from deeprec_tpu.training.profiler import phase_scope
 from deeprec_tpu.embedding.table import EmbeddingTable, TableState, UniqueLookup, empty_key
 from deeprec_tpu.optim import apply as optim_apply
 from deeprec_tpu.optim.sparse import SparseOptimizer
+from deeprec_tpu.parallel.mesh import DATA_AXIS, AxisSpec
 
 
 @struct.dataclass
@@ -72,11 +73,22 @@ class ShardedRoute:
     loc_overflow: Optional[jnp.ndarray]
     # a2a path only: [U] position of each local unique id in the [N*Bd]
     # send buffer (-1 = overflow, served default this step) and the scalar
-    # overflow count; empty/None for allgather.
+    # overflow count; empty/None for allgather. The hier path reuses
+    # send_slot for the RELAY's inter-tier slots ([Rr], -1 = overflow)
+    # and a2a_overflow for the relay overflow count.
     send_slot: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((0,), jnp.int32)
     )
     a2a_overflow: Optional[jnp.ndarray] = None
+    # hier path only: per gathered intra-tier position [R = I*U] — whether
+    # THIS device is the relay for that position's id, and the position's
+    # index into the relay-unique rows. Empty for flat comms.
+    h_rel_mask: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,), bool)
+    )
+    h_r_inverse: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.int32)
+    )
 
 
 @struct.dataclass
@@ -96,7 +108,12 @@ class ShardedLookup:
     owned: jnp.ndarray  # [G] bool — valid rows this shard received/owns
     # a2a path only: [U] position of each local unique id in the [N*Bd] send
     # buffer (-1 = overflow, served default this step); empty for allgather.
+    # hier: the RELAY's inter-tier slots ([Rr], -1 = overflow).
     send_slot: jnp.ndarray = struct.field(default_factory=lambda: jnp.zeros((0,), jnp.int32))
+    # hier path only (see ShardedRoute): relay mask + relay-unique inverse
+    # over the gathered intra-tier layout [R = I*U]; empty for flat comms.
+    h_rel_mask: jnp.ndarray = struct.field(default_factory=lambda: jnp.zeros((0,), bool))
+    h_r_inverse: jnp.ndarray = struct.field(default_factory=lambda: jnp.zeros((0,), jnp.int32))
 
 
 class ShardedTable:
@@ -104,7 +121,7 @@ class ShardedTable:
     methods from inside a shard_map over that axis; state is the LOCAL shard's
     TableState with capacity = global_capacity / num_shards).
 
-    Two exchange strategies:
+    Three exchange strategies:
       * comm="allgather" (default): all_gather ids + psum_scatter embeddings.
         Exact for any skew; comm volume ~ U·D·(N−1) per device.
       * comm="a2a": budgeted id all2all → owner lookup → embedding all2all —
@@ -115,6 +132,22 @@ class ShardedTable:
         default value for that step and is counted in state.a2a_overflow —
         the knob for it is a2a_slack, NOT capacity (insert_fails is the
         separate capacity/grow signal).
+      * comm="hier": the two-tier exchange of a `make_mesh_2d` mesh
+        (docs/multihost.md). Ids are gathered on the cheap `intra` tier,
+        cross-device duplicates collapse at a per-group RELAY (device i of
+        each group aggregates the group's ids whose owner sits at intra
+        position i), and only the aggregated per-group uniques cross the
+        expensive `inter` tier in a budgeted all2all (ops/traffic.py
+        `hier_dest_budgets` — the PR-15 per-dest discipline applied at the
+        group tier). Values and grads retrace both tiers in reverse with
+        fp32 accumulation at the relay and the owner; both wires ride
+        `exchange_dtype`. Inter-tier overflow serves the default value and
+        counts in state.a2a_overflow, same as "a2a". Requires `axis` to be
+        the (inter, intra) name tuple plus the `intra`/`inter` sizes.
+
+    On a 2-D mesh the FLAT comms still work unchanged: pass the (inter,
+    intra) axis tuple and every collective enumerates devices in flat
+    host-major rank order, bit-identical to the 1-D mesh program.
 
     `exchange_chunks > 1` splits the value/grad payload exchanges into that
     many column chunks — bitwise-identical arithmetic (per-element reduction
@@ -129,17 +162,40 @@ class ShardedTable:
         self,
         table: EmbeddingTable,
         num_shards: int,
-        axis: str = "data",
+        axis: AxisSpec = DATA_AXIS,
         comm: str = "allgather",
         a2a_slack: float = 2.0,
         exchange_chunks: int = 1,
+        intra: Optional[int] = None,
+        inter: Optional[int] = None,
+        hier_group_factor: Optional[float] = None,
     ):
         self.table = table
         self.num_shards = num_shards
-        self.axis = axis
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
         self.comm = comm
         self.a2a_slack = a2a_slack
         self.exchange_chunks = max(1, int(exchange_chunks))
+        # Two-tier geometry (comm="hier"): `axis` must be the (inter,
+        # intra) tuple of a make_mesh_2d mesh; `hier_group_factor` is the
+        # static per-group unique budget U_g = factor·U (None = exact
+        # intra·U — group overlap can never overflow the inter bucket).
+        self.intra = int(intra) if intra is not None else None
+        self.inter = int(inter) if inter is not None else None
+        self.hier_group_factor = hier_group_factor
+        if comm == "hier":
+            if not (isinstance(self.axis, tuple) and len(self.axis) == 2):
+                raise ValueError(
+                    "comm='hier' needs axis=(inter, intra) name tuple, "
+                    f"got {self.axis!r}"
+                )
+            if not self.intra or not self.inter:
+                raise ValueError("comm='hier' needs intra/inter sizes")
+            if self.intra * self.inter != num_shards:
+                raise ValueError(
+                    f"hier mesh {self.inter}x{self.intra} != "
+                    f"num_shards {num_shards}"
+                )
         # Plan-aware per-destination a2a budget inputs (see _a2a_budget):
         # `plan_dest_hot` is the active plan's per-destination explicit
         # hot-key arrival counts ([N] ints; None = uniform hash) and
@@ -178,6 +234,8 @@ class ShardedTable:
         keeps the uniform hash (identical program)."""
         if self.comm == "a2a":
             return self._route_a2a(ids, pad_value, unique_size, plan)
+        if self.comm == "hier":
+            return self._route_hier(ids, pad_value, unique_size, plan)
         return self._route_allgather(ids, pad_value, unique_size, plan)
 
     def resolve(
@@ -229,6 +287,8 @@ class ShardedTable:
             o_inverse=route.o_inverse,
             owned=route.owned,
             send_slot=route.send_slot,
+            h_rel_mask=route.h_rel_mask,
+            h_r_inverse=route.h_r_inverse,
         )
 
     def finish(
@@ -250,6 +310,8 @@ class ShardedTable:
         )
         if self.comm == "a2a":
             return self._finish_a2a(sl, o_res, train)
+        if self.comm == "hier":
+            return self._finish_hier(sl, o_res, train)
         return self._finish_allgather(sl, o_res, train)
 
     def lookup_unique(
@@ -567,6 +629,232 @@ class ShardedTable:
             stamp_meta=stamp_meta,
         )
 
+    # ------------------------------------------------- hierarchical path
+    #
+    # Two-tier exchange over a make_mesh_2d mesh (docs/multihost.md).
+    # Forward ids: local dedup (U) → intra-tier allgather ([I·U], cheap
+    # wire) → per-group relay dedup (device i of the group aggregates the
+    # gathered ids whose owner sits at intra position i — the group's
+    # uniques partition across relays, so each id crosses the expensive
+    # tier exactly once per source group) → budgeted inter-tier a2a by
+    # owner GROUP → owner dedup → resolve. The bucket a relay addresses
+    # to owner group j lands on device (j, i) — exactly the owner,
+    # because relay position i IS the owner's intra position. Values and
+    # grads retrace the tiers in reverse: owner → inter a2a → relay →
+    # intra psum_scatter/allgather, fp32 accumulation at relay and
+    # owner, `exchange_dtype` on both wires (the forward stays exact at
+    # bf16: every psum_scatter position has ONE nonzero contributor and
+    # bf16∘bf16 rounding is idempotent; the backward's relay pre-sum
+    # regroups the fp32 reduction, an ulp-level reordering — same class
+    # as a2a-vs-allgather).
+
+    def _hier_budget(self, U: int) -> int:
+        from deeprec_tpu.ops import traffic as T
+
+        # Per-destination-GROUP budget vector (ops/traffic.py
+        # hier_dest_budgets): the PR-15 per-dest discipline applied at
+        # the group tier — each relay holds ~U_g/I group uniques and
+        # buckets them over J owner groups; the plan's per-device hot
+        # arrivals fold to per-group maxima. Model and program share one
+        # formula by construction; bench.py --mesh records the bucket
+        # the trace used next to the modeled vector.
+        budgets = T.hier_dest_budgets(
+            unique=U, intra=self.intra, inter=self.inter,
+            slack=self.a2a_slack, group_factor=self.hier_group_factor,
+            dest_hot=self.plan_dest_hot, hot_count=self.plan_hot_count,
+        )
+        self.last_a2a_unique = int(U)  # noqa: DRT002 — static trace-time shape, no device value
+        self.last_a2a_budgets = budgets
+        self.last_a2a_bucket = int(budgets.max())  # noqa: DRT002 — max of a host numpy budget vector, no device value
+        return self.last_a2a_bucket
+
+    def _route_hier(self, ids, pad_value, unique_size,
+                    plan=None) -> ShardedRoute:
+        from deeprec_tpu.ops import dedup
+        from deeprec_tpu.parallel import placement
+
+        N = self.num_shards
+        I, J = self.intra, self.inter
+        ea, ia = self.axis  # (inter, intra) — mesh-major
+        sent_py = empty_key(self.table.cfg)
+        uids, inverse, counts, valid, loc_ovf = dedup.route_ids(
+            ids, pad_value=pad_value, sentinel=sent_py,
+            unique_size=unique_size,
+        )
+        sentinel = jnp.asarray(sent_py, uids.dtype)
+        U = uids.shape[0]
+
+        # --- intra tier: id/count gather inside the host group.
+        with phase_scope("hier_intra_ids"):
+            g_uids = jax.lax.all_gather(uids, ia, tiled=True)  # [I*U]
+            g_counts = jax.lax.all_gather(counts, ia, tiled=True)
+        owner = placement.plan_owner(g_uids, N, plan)  # [I*U]
+        g_valid = g_uids != sentinel
+        i_me = jax.lax.axis_index(ia)
+        # Relay selection: flat rank r = g·I + i, so owner % I is the
+        # owner's intra position — the coordinate the inter a2a cannot
+        # change. Exactly one device per group relays a given position.
+        rel_mask = ((owner % jnp.int32(I)) == i_me) & g_valid
+        r_uids, r_inverse, r_counts, r_valid = self._owner_dedup(
+            g_uids, g_counts, rel_mask, sentinel, budgeted=True
+        )
+        Rr = r_uids.shape[0]
+
+        # --- inter tier: bucket relay uniques by owner group under the
+        # per-group budget; overflow degrades to the sentinel bucket
+        # (default-served, counted), never dropped rows.
+        Bg = self._hier_budget(U)
+        group = jnp.where(
+            r_valid,
+            placement.plan_owner(r_uids, N, plan) // jnp.int32(I),
+            jnp.int32(J),
+        )  # invalid sort last
+        sort_ix = jnp.argsort(group, stable=True)
+        sorted_group = group[sort_ix]
+        start = jnp.searchsorted(
+            sorted_group, jnp.arange(J, dtype=group.dtype)
+        )
+        rank = jnp.arange(Rr, dtype=jnp.int32) - start[
+            jnp.clip(sorted_group, 0, J - 1)
+        ].astype(jnp.int32)
+        slot_sorted = jnp.where(
+            (sorted_group < J) & (rank < Bg), sorted_group * Bg + rank, -1
+        )
+        send_slot = jnp.zeros((Rr,), jnp.int32).at[sort_ix].set(slot_sorted)
+        overflow = (send_slot < 0) & r_valid
+        sslot_safe = jnp.where(send_slot >= 0, send_slot, J * Bg)
+
+        buf_ids = jnp.full((J * Bg,), sentinel, uids.dtype).at[
+            sslot_safe
+        ].set(r_uids, mode="drop")
+        buf_counts = jnp.zeros((J * Bg,), jnp.int32).at[sslot_safe].set(
+            r_counts, mode="drop"
+        )
+        with phase_scope("hier_inter_ids"):
+            recv_ids = jax.lax.all_to_all(
+                buf_ids.reshape(J, Bg), ea, split_axis=0, concat_axis=0,
+                tiled=True,
+            ).reshape(-1)
+            recv_counts = jax.lax.all_to_all(
+                buf_counts.reshape(J, Bg), ea, split_axis=0, concat_axis=0,
+                tiled=True,
+            ).reshape(-1)
+
+        # Everything that arrives is owned by me (relay position == my
+        # intra position, bucket == my group).
+        recv_valid = recv_ids != sentinel
+        o_uids, o_inverse, o_counts, o_valid = self._owner_dedup(
+            recv_ids, recv_counts, recv_valid, sentinel, budgeted=True
+        )
+        return ShardedRoute(
+            inverse=inverse, counts=counts, valid=valid,
+            o_uids=o_uids, o_inverse=o_inverse, o_counts=o_counts,
+            o_valid=o_valid, owned=recv_valid, loc_overflow=loc_ovf,
+            send_slot=send_slot,
+            a2a_overflow=jnp.sum(overflow).astype(jnp.int32),
+            h_rel_mask=rel_mask, h_r_inverse=r_inverse,
+        )
+
+    def _finish_hier(self, sl: ShardedLookup, o_res: UniqueLookup,
+                     train: bool) -> ShardedLookup:
+        cfg = self.table.cfg
+        J = self.inter
+        ea, ia = self.axis
+        G2 = sl.o_inverse.shape[0]  # J*Bg
+        Bg = G2 // J
+        wire = self._wire_dtype(train)
+        # --- inter tier back: owner rows → relay buckets.
+        e_out = o_res.embeddings[sl.o_inverse].astype(wire)
+        e_out = e_out * sl.owned[:, None].astype(wire)
+        D = e_out.shape[1]
+        blocked = jnp.asarray(
+            cfg.ev.init.default_value_no_permission, jnp.float32
+        )
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(D)):
+            with phase_scope(f"hier_inter_chunk{ci}"):
+                e_back = jax.lax.all_to_all(
+                    e_out[:, a:b].reshape(J, Bg, b - a), ea,
+                    split_axis=0, concat_axis=0, tiled=True,
+                ).reshape(G2, b - a).astype(jnp.float32)
+            # e_back[send_slot[u]] is relay-unique u's row; inter-tier
+            # overflow serves the default (the a2a degrade contract).
+            v_r = e_back.at[
+                jnp.where(sl.send_slot >= 0, sl.send_slot, 0)
+            ].get(mode="clip")
+            v_r = jnp.where((sl.send_slot >= 0)[:, None], v_r, blocked)
+            # --- intra tier back: relay rows → gathered layout → one
+            # reduce-scatter hands each device its own uniques. Exact at
+            # the wire dtype: exactly one relay contributes per position.
+            e_g = v_r[sl.h_r_inverse] * sl.h_rel_mask[:, None].astype(
+                jnp.float32
+            )
+            with phase_scope(f"hier_intra_chunk{ci}"):
+                parts.append(jax.lax.psum_scatter(
+                    e_g.astype(wire), ia, scatter_dimension=0, tiled=True,
+                ))
+        emb_local = (
+            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        ).astype(jnp.float32)  # [U, D]
+        return sl.replace(embeddings=emb_local, owner_res=o_res)
+
+    def _apply_hier(
+        self, state, opt, sl, grad_u, *, step, lr, grad_averaging,
+        reuse_rows, stamp_meta,
+    ) -> TableState:
+        J = self.inter
+        ea, ia = self.axis
+        G2 = sl.o_inverse.shape[0]
+        Bg = G2 // J
+        Rr = sl.send_slot.shape[0]
+        D = grad_u.shape[1]
+        wire = self._wire_dtype(True)  # the backward only exists in train
+        O = sl.owner_res.uids.shape[0]
+        sslot_safe = jnp.where(sl.send_slot >= 0, sl.send_slot, G2)
+        rel = sl.h_rel_mask[:, None].astype(jnp.float32)
+        parts = []
+        for ci, (a, b) in enumerate(self._col_chunks(D)):
+            # Intra tier: grads gather inside the group at the wire
+            # dtype; the relay segment-sums its positions in fp32 (the
+            # cross-device duplicate merge happens HERE, before the
+            # expensive tier — the byte diet of the whole design).
+            with phase_scope(f"hier_intra_chunk{ci}"):
+                g_g = jax.lax.all_gather(
+                    grad_u[:, a:b].astype(wire), ia, tiled=True
+                )  # [I*U, b-a]
+            r_grad = (
+                jnp.zeros((Rr, b - a), jnp.float32)
+                .at[sl.h_r_inverse]
+                .add(g_g.astype(jnp.float32) * rel)
+            )
+            # Inter tier: relay subtotals ride the budgeted buckets back
+            # to the owner (overflowed rows drop, matching their
+            # default-served forward); owner accumulates in fp32.
+            g_buf = (
+                jnp.zeros((G2, b - a), wire)
+                .at[sslot_safe]
+                .set(r_grad.astype(wire), mode="drop")
+            )
+            with phase_scope(f"hier_inter_chunk{ci}"):
+                g_recv = jax.lax.all_to_all(
+                    g_buf.reshape(J, Bg, b - a), ea, split_axis=0,
+                    concat_axis=0, tiled=True,
+                ).reshape(G2, b - a)
+            parts.append(
+                jnp.zeros((O, b - a), jnp.float32)
+                .at[sl.o_inverse]
+                .add(g_recv.astype(jnp.float32)
+                     * sl.owned[:, None].astype(jnp.float32))
+            )
+        o_grad = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        # Same local-mean-loss rescale as the flat paths.
+        o_grad = o_grad / jnp.float32(self.num_shards)
+        return optim_apply.apply_gradients(
+            self.table, state, opt, sl.owner_res, o_grad, step=step, lr=lr,
+            grad_averaging=grad_averaging, reuse_rows=reuse_rows,
+            stamp_meta=stamp_meta,
+        )
+
     # ------------------------------------------------------------- backward
 
     def apply_gradients(
@@ -589,6 +877,12 @@ class ShardedTable:
         async stale-by-one apply keeps the defaults."""
         if self.comm == "a2a":
             return self._apply_a2a(
+                state, opt, sl, grad_u, step=step, lr=lr,
+                grad_averaging=grad_averaging, reuse_rows=reuse_rows,
+                stamp_meta=stamp_meta,
+            )
+        if self.comm == "hier":
+            return self._apply_hier(
                 state, opt, sl, grad_u, step=step, lr=lr,
                 grad_averaging=grad_averaging, reuse_rows=reuse_rows,
                 stamp_meta=stamp_meta,

@@ -32,6 +32,7 @@ from deeprec_tpu.parallel.compat import shard_map
 from deeprec_tpu.embedding.table import EmbeddingTable
 from deeprec_tpu.optim.apply import ensure_slots
 from deeprec_tpu.parallel import placement as placement_lib
+from deeprec_tpu.parallel.mesh import DATA_AXIS
 from deeprec_tpu.parallel.placement import BundlePlan
 from deeprec_tpu.parallel.sharded import ShardedTable
 from deeprec_tpu.training import metrics as M
@@ -58,9 +59,9 @@ class ShardedTrainer(Trainer):
         sparse_opt,
         dense_opt: Optional[optax.GradientTransformation] = None,
         mesh: Optional[Mesh] = None,
-        axis: str = "data",
+        axis: str = DATA_AXIS,
         grad_averaging: bool = False,
-        comm: str = "allgather",  # or "a2a": budgeted all2all (SOK path)
+        comm: str = "allgather",  # "a2a" (budgeted, SOK path) | "hier" (2-D)
         remat: bool = False,
         a2a_slack: float = 2.0,
         unique_budget=None,
@@ -69,13 +70,30 @@ class ShardedTrainer(Trainer):
         placement: str = "uniform",
         placement_hot_budget: int = 64,
         replan: Optional["placement_lib.ReplanConfig"] = None,
+        hier_group_factor: Optional[float] = None,
     ):
         from deeprec_tpu.parallel.costmodel import PlacementCostModel
-        from deeprec_tpu.parallel.mesh import make_mesh
+        from deeprec_tpu.parallel.mesh import make_mesh, mesh_batch_axes
 
         self.mesh = mesh or make_mesh(axis=axis)
-        self.axis = axis
+        # The axis spec every P()/collective in the step program uses: the
+        # plain data axis of a 1-D mesh, or the (inter, intra) tuple of a
+        # make_mesh_2d mesh — flat collectives over the tuple enumerate
+        # devices in 1-D host-major rank order, so the allgather/a2a
+        # programs (and hash ownership, and checkpoints) are identical
+        # across mesh shapes. comm="hier" splits the exchange across the
+        # two tiers instead (docs/multihost.md).
+        self.axis = mesh_batch_axes(self.mesh)
         self.num_shards = self.mesh.devices.size
+        names = tuple(self.mesh.axis_names)
+        self.inter_size = self.mesh.shape[names[0]] if len(names) == 2 else None
+        self.intra_size = self.mesh.shape[names[1]] if len(names) == 2 else None
+        self.hier_group_factor = hier_group_factor
+        if comm == "hier" and len(names) != 2:
+            raise ValueError(
+                "comm='hier' needs a 2-D mesh (make_mesh_2d); "
+                f"got axes {names}"
+            )
         # Skew-aware table placement (parallel/placement.py): "uniform"
         # keeps the legacy hash_shard routing; "plan" arms the
         # drift-driven replanner — maintain() runs maybe_replan() next to
@@ -117,13 +135,18 @@ class ShardedTrainer(Trainer):
         # pipeline_mode="chunked" splits each table's value/grad exchanges
         # into pipeline_chunks column chunks (ShardedTable.exchange_chunks)
         # on EVERY train path (single-step and K-step scan) — bitwise
-        # identical arithmetic, overlappable wire.
-        chunks = pipeline_chunks if pipeline_mode == "chunked" else 1
+        # identical arithmetic, overlappable wire. "nested" (the 2-D-mesh
+        # lookahead) keeps the chunked exchanges too: the inter-tier hop
+        # of chunk k overlaps the intra-tier hop of chunk k+1.
+        chunks = pipeline_chunks if pipeline_mode in ("chunked", "nested") else 1
         for bname, b in self.bundles.items():
             b.table = EmbeddingTable(_local_cfg(b.table.cfg, self.num_shards))
         self.sharded = {
-            bname: ShardedTable(b.table, self.num_shards, axis, comm=comm,
-                                a2a_slack=a2a_slack, exchange_chunks=chunks)
+            bname: ShardedTable(b.table, self.num_shards, self.axis,
+                                comm=comm, a2a_slack=a2a_slack,
+                                exchange_chunks=chunks,
+                                intra=self.intra_size, inter=self.inter_size,
+                                hier_group_factor=hier_group_factor)
             for bname, b in self.bundles.items()
         }
 
@@ -849,6 +872,8 @@ class ShardedTrainer(Trainer):
         self.sharded[b.name] = ShardedTable(
             b.table, old.num_shards, old.axis, comm=old.comm,
             a2a_slack=old.a2a_slack, exchange_chunks=old.exchange_chunks,
+            intra=old.intra, inter=old.inter,
+            hier_group_factor=old.hier_group_factor,
         )
         self.sharded[b.name].plan_dest_hot = old.plan_dest_hot
         self.sharded[b.name].plan_hot_count = old.plan_hot_count
@@ -1021,7 +1046,13 @@ class ShardedTrainer(Trainer):
 
           1. route(t+1): id dedup + id a2a/allgather + owner dedup —
              ids-only, issued before the dense compute so the async
-             collective hides behind the matmuls;
+             collective hides behind the matmuls. Under
+             pipeline_mode="nested" with comm="hier" this is where the
+             nesting lands: route contains BOTH tiers' id hops, so the
+             expensive inter-tier exchange of t+1 (phase
+             "hier_inter_ids") is issued a full dense fwd/bwd ahead and
+             its DCN latency hides behind t's intra-host work AND
+             matmuls;
           2. resolve(t+1): owner probe/insert + fused metadata + init —
              keys/meta only, commutes bit-exactly with apply(t);
           3. dense fwd/bwd on the carried lookup of batch t;

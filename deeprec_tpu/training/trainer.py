@@ -89,7 +89,15 @@ class PipelineCarry:
 #                 exchange_chunks): several smaller collectives whose wire
 #                 time pipelines against the neighbouring gather /
 #                 segment-sum compute. Also exact.
-PIPELINE_MODES = ("off", "lookahead", "chunked")
+#   "nested"    — the 2-D-mesh form of "chunked" (docs/multihost.md):
+#                 same rotated scan and chunked exchanges, intended for
+#                 comm="hier" where route(t+1) contains BOTH tiers' id
+#                 hops — the expensive inter-tier (DCN) exchange of t+1
+#                 is issued a full dense fwd/bwd ahead, nesting the DCN
+#                 pipeline inside the intra-host one. Same exact-no-
+#                 staleness contract (prologue fill, last-iteration
+#                 peel): bit-identical to "off".
+PIPELINE_MODES = ("off", "lookahead", "chunked", "nested")
 
 
 def validate_pipeline_mode(mode: str, where: str) -> None:

@@ -109,7 +109,9 @@ def test_prefetcher_sharding_places_on_mesh():
     assert {len(v.sharding.device_set) for v in b0.values()} == {1}
 
     # sharding= threads the mesh through the default transform
-    sh = NamedSharding(mesh, P("data"))
+    from deeprec_tpu.parallel.mesh import DATA_AXIS
+
+    sh = NamedSharding(mesh, P(DATA_AXIS))
     ring = staged(iter([gen.batch()]), sharding=sh)
     b1 = next(ring)
     ring.close()

@@ -1,0 +1,453 @@
+"""Pod-scale 2-D mesh (parallel/mesh.py make_mesh_2d + the hierarchical
+two-tier exchange, comm="hier"): the mesh SHAPE must be invisible to the
+training math and to persistence.
+
+Contracts pinned here:
+  * device order is host-major — flat rank g*intra+i equals the 1-D
+    position, so hash ownership, placement and checkpoints are
+    mesh-shape independent by construction;
+  * the FLAT exchanges (allgather, a2a) run BITWISE identically on a
+    2-D mesh (tuple axis names enumerate devices in 1-D rank order);
+  * the hierarchical exchange keeps every per-key TABLE INT (meta:
+    freq/version, key sets, shard ownership) exactly equal to the flat
+    path; float rows and per-step losses agree to ulp-level tolerance
+    (the relay's fp32 pre-sum regroups the owner-side reduction — same
+    class as the a2a-vs-allgather precedent in test_a2a.py), and the
+    FIRST step from a fresh init is bitwise (forward is exact: one
+    contributor per psum_scatter position);
+  * pipeline_mode="nested" (two-tier lookahead) is bitwise identical to
+    "off" — losses AND full table state;
+  * checkpoints round-trip across mesh-shape changes in both directions;
+  * elastic rescale factorization never wedges (degrades to 1-D);
+  * the two-tier wire model puts the inter tier on a real diet at the
+    reference 2x4 shape.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo
+from deeprec_tpu.models import WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.parallel import (
+    ShardedTrainer,
+    make_mesh,
+    make_mesh_2d,
+    mesh_batch_axes,
+    shard_batch,
+)
+from deeprec_tpu.parallel.elastic import factorize_mesh, plan_mesh_after_rescale
+from deeprec_tpu.parallel.mesh import DATA_AXIS, INTER_AXIS, INTRA_AXIS
+from deeprec_tpu.training import stack_batches
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def model():
+    return WDL(emb_dim=8, capacity=1 << 12, hidden=(16,), num_cat=4,
+               num_dense=2)
+
+
+def overlap_batches(n, batch_size=256, seed=7):
+    """Shared raw id space + per-table zipf: heavy cross-device id
+    overlap, the regime where the relay pre-sum actually regroups."""
+    gen = SyntheticCriteo(
+        batch_size=batch_size, num_cat=4, num_dense=2, vocab=3000,
+        seed=seed, zipf_a=[1.2, 1.5, 1.8, 2.1], offset_ids=False,
+    )
+    return [J(gen.batch()) for _ in range(n)]
+
+
+def build(mesh, comm, pipeline_mode="off", group_factor=None):
+    return ShardedTrainer(
+        model(), Adagrad(lr=0.1), optax.sgd(0.01), mesh=mesh, comm=comm,
+        pipeline_mode=pipeline_mode, pipeline_chunks=2,
+        hier_group_factor=group_factor,
+    )
+
+
+def split_maps(tr, state):
+    """Two views of the live rows, keyed (bundle, member, key):
+    ints — shard ownership + meta columns, compared EXACTLY;
+    floats — value row + optimizer slot rows, compared to tolerance.
+    Slot LAYOUT inside a shard's hash table may differ between runs
+    (insertion order), so only per-key content is comparable."""
+    from deeprec_tpu.embedding.table import empty_key
+    from deeprec_tpu.ops.packed import unpack_array
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    ints, floats = {}, {}
+    for bname, b in tr.bundles.items():
+        ts = state.tables[bname]
+        sent = empty_key(b.table.cfg)
+        keys = np.asarray(jax.device_get(ts.keys))
+        meta = np.asarray(jax.device_get(ts.meta))
+        C = keys.shape[-1]
+        vals = np.asarray(jax.device_get(ts.values))
+        slots = {
+            k: np.asarray(jax.device_get(v))
+            for k, v in ts.slots.items()
+            if not k.startswith(SCALAR_PREFIX)
+        }
+        lead = keys.shape[:-1]  # [T?, N]
+        for idx in np.ndindex(*lead):
+            m = idx[0] if len(idx) == 2 else 0
+            shard = idx[-1]
+            k_loc = keys[idx]
+            v_loc = unpack_array(vals[idx], C)
+            s_loc = [unpack_array(sl[idx], C) for sl in slots.values()]
+            occ = np.nonzero(k_loc != sent)[0]
+            for s in occ:
+                ref = (bname, m, int(k_loc[s]))
+                assert ref not in ints, f"key on two shards: {ref}"
+                ints[ref] = (shard, meta[idx][:, s].tobytes())
+                floats[ref] = (
+                    v_loc[s].copy(),
+                    tuple(sl[s].copy() for sl in s_loc),
+                )
+    return ints, floats
+
+
+def assert_same_tables(tr_a, s_a, tr_b, s_b, exact=True):
+    ia, fa = split_maps(tr_a, s_a)
+    ib, fb = split_maps(tr_b, s_b)
+    assert set(ia) == set(ib), (
+        f"live key sets differ: {len(set(ia) ^ set(ib))} keys"
+    )
+    bad = [k for k in ia if ia[k] != ib[k]]
+    assert not bad, f"{len(bad)} keys differ on ints/ownership: {bad[:3]}"
+    for k in fa:
+        va, sa = fa[k]
+        vb, sb_ = fb[k]
+        if exact:
+            np.testing.assert_array_equal(va, vb, err_msg=str(k))
+            for x, y in zip(sa, sb_):
+                np.testing.assert_array_equal(x, y, err_msg=str(k))
+        else:
+            np.testing.assert_allclose(va, vb, rtol=1e-3, atol=1e-5,
+                                       err_msg=str(k))
+            for x, y in zip(sa, sb_):
+                np.testing.assert_allclose(x, y, rtol=1e-3, atol=1e-5,
+                                           err_msg=str(k))
+
+
+# --------------------------------------------------------- mesh plumbing
+
+
+def test_make_mesh_2d_layout():
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh_2d(4, 2)
+    assert tuple(mesh.axis_names) == (INTER_AXIS, INTRA_AXIS)
+    assert mesh.shape[INTER_AXIS] == 2 and mesh.shape[INTRA_AXIS] == 4
+    # Host-major: flat rank g*intra+i is the 1-D device position — the
+    # property that makes hash ownership mesh-shape independent.
+    np.testing.assert_array_equal(
+        np.asarray([d.id for d in mesh.devices.flatten()]),
+        np.asarray([d.id for d in jax.devices()[:8]]),
+    )
+    # inter inferred from the available device count
+    assert make_mesh_2d(2).shape[INTER_AXIS] == len(jax.devices()) // 2
+    # a 3x2 carve-out of the 8 devices is legal; inference is not (3 ∤ 8)
+    assert make_mesh_2d(3, 2).devices.size == 6
+    with pytest.raises(ValueError):
+        make_mesh_2d(3)  # intra must divide the device count to infer
+    with pytest.raises(ValueError):
+        make_mesh_2d(16, 2)  # more devices than exist
+
+
+def test_mesh_batch_axes_and_shard_batch():
+    m1, m2 = make_mesh(8), make_mesh_2d(4, 2)
+    assert mesh_batch_axes(m1) == DATA_AXIS
+    assert mesh_batch_axes(m2) == (INTER_AXIS, INTRA_AXIS)
+    b = {"x": jnp.arange(64, dtype=jnp.int32)}
+    s1 = shard_batch(m1, b)
+    s2 = shard_batch(m2, b)
+    assert len(s1["x"].sharding.device_set) == 8
+    assert len(s2["x"].sharding.device_set) == 8
+    # identical global content, identical per-device slices (host-major)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s1["x"])),
+        np.asarray(jax.device_get(s2["x"])),
+    )
+
+
+def test_hier_requires_2d_mesh():
+    with pytest.raises(ValueError):
+        build(make_mesh(8), "hier")
+
+
+# ----------------------------------------------------- elastic rescaling
+
+
+def test_factorize_mesh_never_wedges():
+    # (survivors, prefer_intra) -> (intra, inter)
+    assert factorize_mesh(8, 4) == (4, 2)
+    assert factorize_mesh(6, 4) == (3, 2)
+    assert factorize_mesh(4, 4) == (2, 2)
+    assert factorize_mesh(12, 4) == (4, 3)
+    # primes / tiny counts degrade to 1-D rather than wedge
+    assert factorize_mesh(7, 4) == (7, 1)
+    assert factorize_mesh(3, 4) == (3, 1)
+    assert factorize_mesh(1, 4) == (1, 1)
+    for n in range(1, 9):
+        intra, inter = factorize_mesh(n, 4)
+        assert intra * inter == n and intra >= 1 and inter >= 1
+    with pytest.raises(ValueError):
+        factorize_mesh(0, 4)
+
+
+def test_plan_mesh_after_rescale_shapes():
+    old2d = make_mesh_2d(4, 2)
+    # a host group leaves: 8 -> 4 survivors refactorize to 2x2
+    m = plan_mesh_after_rescale(4, old2d)
+    assert tuple(m.axis_names) == (INTER_AXIS, INTRA_AXIS)
+    assert m.shape[INTRA_AXIS] == 2 and m.shape[INTER_AXIS] == 2
+    # prime survivor count degrades to 1-D — never wedges
+    m = plan_mesh_after_rescale(7, old2d)
+    assert tuple(m.axis_names) == (DATA_AXIS,)
+    # 1-D stays 1-D
+    m = plan_mesh_after_rescale(4, make_mesh(8))
+    assert tuple(m.axis_names) == (DATA_AXIS,)
+    assert m.devices.size == 4
+
+
+def test_exit_rescale_2d_to_1d_resumes(tmp_path):
+    """The EXIT_RESCALE cycle across a mesh-shape change: train on the
+    2-D hier mesh, reshard through the checkpoint container onto the
+    degraded 1-D topology a prime survivor count forces, keep training.
+    (The PR 12 drain discipline: state moves via the tested export/
+    import path, keys re-probe into their new owners' shards.)"""
+    from deeprec_tpu.parallel.elastic import reshard
+
+    batches = overlap_batches(4)
+    tr_a = build(make_mesh_2d(4, 2), "hier")
+    s_a = tr_a.init(0)
+    for i in range(3):
+        s_a, m_a = tr_a.train_step(s_a, shard_batch(tr_a.mesh, batches[i]))
+    # survivors = 2: no >=2 co-factor under prefer_intra, degrades to
+    # 1-D (2 also divides the table capacity, which a resharded trainer
+    # still requires of its mesh size)
+    new_mesh = plan_mesh_after_rescale(2, tr_a.mesh)
+    assert tuple(new_mesh.axis_names) == (DATA_AXIS,)
+    assert new_mesh.devices.size == 2
+    tr_b = ShardedTrainer(model(), Adagrad(lr=0.1), optax.sgd(0.01),
+                          mesh=new_mesh, comm="a2a")
+    s_b = reshard(tr_a, s_a, tr_b, scratch_dir=str(tmp_path))
+    s_b, m_b = tr_b.train_step(s_b, shard_batch(new_mesh, batches[3]))
+    assert np.isfinite(float(m_b["loss"]))
+
+
+# -------------------------------------------- flat-comm mesh-shape parity
+
+
+@pytest.mark.parametrize("comm", ["allgather", "a2a"])
+def test_flat_comm_parity_across_mesh_shapes(comm):
+    """The flat exchanges on a 2-D mesh (axis = the tuple) enumerate
+    devices in 1-D rank order: losses and the full table state must be
+    BITWISE identical across {1-D, 2x4, 4x2} — including the K-scan."""
+    batches = overlap_batches(5)
+    tr_1d = build(make_mesh(8), comm)
+    s_1d = tr_1d.init(0)
+    runs = []
+    for intra, inter in ((4, 2), (2, 4)):
+        tr = build(make_mesh_2d(intra, inter), comm)
+        runs.append((tr, tr.init(0)))
+    for i in range(3):
+        s_1d, m_1d = tr_1d.train_step(s_1d, shard_batch(tr_1d.mesh,
+                                                        batches[i]))
+        for j, (tr, st) in enumerate(runs):
+            st, m = tr.train_step(st, shard_batch(tr.mesh, batches[i]))
+            runs[j] = (tr, st)
+            assert float(m["loss"]) == float(m_1d["loss"]), (
+                f"step {i}, mesh {tr.mesh.shape}: "
+                f"{float(m['loss'])} != {float(m_1d['loss'])}"
+            )
+    # K-step scan: same program shape, still bitwise
+    stacked_1d = shard_batch(tr_1d.mesh, stack_batches(batches[3:5]),
+                             stacked=True)
+    s_1d, m_1d = tr_1d.train_steps(s_1d, stacked_1d)
+    for j, (tr, st) in enumerate(runs):
+        stacked = shard_batch(tr.mesh, stack_batches(batches[3:5]),
+                              stacked=True)
+        st, m = tr.train_steps(st, stacked)
+        runs[j] = (tr, st)
+        np.testing.assert_array_equal(
+            np.asarray(m["loss"]), np.asarray(m_1d["loss"])
+        )
+    for tr, st in runs:
+        assert_same_tables(tr_1d, s_1d, tr, st, exact=True)
+
+
+# ------------------------------------------------- hierarchical exchange
+
+
+def test_hier_parity_vs_flat():
+    """comm="hier" vs the flat 1-D path on a high-overlap stream: first
+    step bitwise (fresh tables, forward exact), every per-key table INT
+    and the shard ownership exactly equal throughout, float rows and
+    later losses within the a2a-precedent tolerance (the relay's fp32
+    pre-sum regroups the owner-side reduction)."""
+    batches = overlap_batches(6)
+    tr_f = build(make_mesh(8), "allgather")
+    s_f = tr_f.init(0)
+    runs = []
+    for intra, inter in ((4, 2), (2, 4)):
+        tr = build(make_mesh_2d(intra, inter), "hier")
+        runs.append((tr, tr.init(0)))
+    for i in range(4):
+        s_f, m_f = tr_f.train_step(s_f, shard_batch(tr_f.mesh, batches[i]))
+        for j, (tr, st) in enumerate(runs):
+            st, m = tr.train_step(st, shard_batch(tr.mesh, batches[i]))
+            runs[j] = (tr, st)
+            if i == 0:
+                assert float(m["loss"]) == float(m_f["loss"]), (
+                    "first step must be bitwise (forward is exact)"
+                )
+            else:
+                np.testing.assert_allclose(
+                    float(m["loss"]), float(m_f["loss"]), rtol=1e-4
+                )
+    for tr, st in runs:
+        assert_same_tables(tr_f, s_f, tr, st, exact=False)
+        overflow = sum(
+            int(np.sum(np.asarray(jax.device_get(ts.a2a_overflow))))
+            for ts in st.tables.values()
+        )
+        assert overflow == 0, f"hier overflow on {tr.mesh.shape}"
+
+
+def test_hier_group_budget_discipline():
+    """A finite group_factor engages the budgeted inter bucket: the
+    compiled bucket must equal ops/traffic.py's model max (one formula,
+    shared by construction) with ZERO overflow at a roomy factor."""
+    from deeprec_tpu.ops import traffic as T
+
+    batches = overlap_batches(4)
+    tr = build(make_mesh_2d(4, 2), "hier", group_factor=2.0)
+    st = tr.init(0)
+    for i in range(4):
+        st, m = tr.train_step(st, shard_batch(tr.mesh, batches[i]))
+    assert np.isfinite(float(m["loss"]))
+    for bname in tr.bundles:
+        sh = tr.sharded[bname]
+        budgets = T.hier_dest_budgets(
+            unique=sh.last_a2a_unique, intra=4, inter=2,
+            slack=sh.a2a_slack, group_factor=2.0,
+            dest_hot=sh.plan_dest_hot, hot_count=sh.plan_hot_count,
+        )
+        assert int(budgets.max()) == sh.last_a2a_bucket
+        np.testing.assert_array_equal(
+            np.asarray(budgets), np.asarray(sh.last_a2a_budgets)
+        )
+    overflow = sum(
+        int(np.sum(np.asarray(jax.device_get(ts.a2a_overflow))))
+        for ts in st.tables.values()
+    )
+    assert overflow == 0
+
+
+def test_nested_lookahead_bitwise_vs_off():
+    """pipeline_mode="nested" on the hier K-scan: the inter-tier id
+    exchange of batch t+1 is hoisted behind dense(t) across BOTH tiers —
+    same-exact-no-staleness contract, pinned bitwise against "off" on
+    losses AND the full table state."""
+    batches = overlap_batches(7)
+    tr_o = build(make_mesh_2d(4, 2), "hier", pipeline_mode="off")
+    tr_n = build(make_mesh_2d(4, 2), "hier", pipeline_mode="nested")
+    s_o, s_n = tr_o.init(0), tr_n.init(0)
+    for i in range(3):
+        s_o, m_o = tr_o.train_step(s_o, shard_batch(tr_o.mesh, batches[i]))
+        s_n, m_n = tr_n.train_step(s_n, shard_batch(tr_n.mesh, batches[i]))
+        assert float(m_o["loss"]) == float(m_n["loss"])
+    stacked_o = shard_batch(tr_o.mesh, stack_batches(batches[3:7]),
+                            stacked=True)
+    stacked_n = shard_batch(tr_n.mesh, stack_batches(batches[3:7]),
+                            stacked=True)
+    s_o, m_o = tr_o.train_steps(s_o, stacked_o)
+    s_n, m_n = tr_n.train_steps(s_n, stacked_n)
+    np.testing.assert_array_equal(
+        np.asarray(m_o["loss"]), np.asarray(m_n["loss"])
+    )
+    assert_same_tables(tr_o, s_o, tr_n, s_n, exact=True)
+
+
+# ------------------------------------------------- checkpoints x meshes
+
+
+def test_checkpoint_roundtrip_across_mesh_shapes(tmp_path):
+    """Save under 1-D, restore under 2-D hier (and the reverse): restore
+    re-probes keys into the restoring trainer's shards, which the
+    host-major 2-D layout maps to the same owners — both directions must
+    resume with the flat path's exact table state and a bitwise resumed
+    forward loss."""
+    from deeprec_tpu.training.checkpoint import CheckpointManager
+
+    batches = overlap_batches(5)
+    tr_a = build(make_mesh(8), "allgather")
+    s_a = tr_a.init(0)
+    for i in range(3):
+        s_a, _ = tr_a.train_step(s_a, shard_batch(tr_a.mesh, batches[i]))
+    ck_a = CheckpointManager(str(tmp_path / "ck"), tr_a)
+    s_a, _ = ck_a.save(s_a)
+
+    # 1-D -> 2-D hier
+    tr_b = build(make_mesh_2d(4, 2), "hier")
+    r_b = CheckpointManager(str(tmp_path / "ck"), tr_b).restore()
+    assert_same_tables(tr_a, s_a, tr_b, r_b, exact=True)
+    s_a, m_a = tr_a.train_step(s_a, shard_batch(tr_a.mesh, batches[3]))
+    r_b, m_b = tr_b.train_step(r_b, shard_batch(tr_b.mesh, batches[3]))
+    assert float(m_a["loss"]) == float(m_b["loss"])
+
+    # 2-D hier -> 1-D a2a
+    ck_b = CheckpointManager(str(tmp_path / "ck_b"), tr_b)
+    r_b, _ = ck_b.save(r_b)
+    tr_c = ShardedTrainer(model(), Adagrad(lr=0.1), optax.sgd(0.01),
+                          mesh=make_mesh(8), comm="a2a")
+    r_c = CheckpointManager(str(tmp_path / "ck_b"), tr_c).restore()
+    assert_same_tables(tr_b, r_b, tr_c, r_c, exact=True)
+    r_b, m_b = tr_b.train_step(r_b, shard_batch(tr_b.mesh, batches[4]))
+    r_c, m_c = tr_c.train_step(r_c, shard_batch(tr_c.mesh, batches[4]))
+    assert float(m_b["loss"]) == float(m_c["loss"])
+
+
+# ------------------------------------------------------ two-tier model
+
+
+def test_hier_wire_model_reference_shape():
+    """At the reference 8-device 2x4 shape the modeled inter-tier bytes
+    must undercut BOTH baselines: <= 0.5x the flat a2a's inter-host
+    bytes and <= 1/intra of the flat a2a's total — the acceptance bound
+    `roofline.py --assert-hierarchy` gates on the recorded bench JSON."""
+    from deeprec_tpu.ops import traffic as T
+
+    U, D = 1024, 32
+    hb = T.hier_exchange_bytes(
+        unique=U, intra=4, inter=2, dim=D, wire_bytes=4, slack=2.0,
+        group_factor=1.5,
+    )
+    fb = T.flat_exchange_tier_bytes(
+        unique=U, num_shards=8, intra=4, comm="a2a", dim=D, wire_bytes=4,
+        slack=2.0,
+    )
+    assert hb["inter_bytes"] <= 0.5 * fb["inter_bytes"], (hb, fb)
+    assert hb["inter_bytes"] <= fb["total_bytes"] / 4, (hb, fb)
+    # budget algebra: U_g caps at intra*U with no factor, the bucket is
+    # the max of the per-group vector, rows round to 8
+    assert T.hier_group_unique_budget(unique=U, intra=4) == 4 * U
+    ug = T.hier_group_unique_budget(unique=U, intra=4, group_factor=1.5)
+    assert ug == int(np.ceil(1.5 * U / 8)) * 8
+    budgets = T.hier_dest_budgets(unique=U, intra=4, inter=2, slack=2.0,
+                                  group_factor=1.5)
+    assert int(budgets.max()) == T.hier_bucket_rows(
+        unique=U, intra=4, inter=2, slack=2.0, group_factor=1.5
+    )
+    # per-tier ms only with bandwidths given
+    hb2 = T.hier_exchange_bytes(
+        unique=U, intra=4, inter=2, dim=D, slack=2.0, group_factor=1.5,
+        intra_bw_gbs=100.0, inter_bw_gbs=10.0,
+    )
+    assert hb2["intra_ms"] > 0 and hb2["inter_ms"] > 0

@@ -86,7 +86,9 @@ def main(argv=None):
         pass — K-stacked dispatches when --steps-per-dispatch > 1."""
         if K <= 1:
             return [(batches[i % len(batches)], 1) for i in range(args.steps)]
-        sh = NamedSharding(mesh, P(None, "data"))
+        from deeprec_tpu.parallel.mesh import DATA_AXIS
+
+        sh = NamedSharding(mesh, P(None, DATA_AXIS))
         return [
             (
                 jax.device_put(

@@ -322,6 +322,112 @@ def assert_compiles(json_path: str, budget: int) -> int:
     return 0
 
 
+def assert_hierarchy(json_path: str, inter_ratio: float, tol: float) -> int:
+    """CI gate for the pod-scale 2-D mesh arm (bench.py 'mesh' section,
+    round 19): the hierarchical two-tier exchange must actually put the
+    expensive tier on a diet, exactly, and for free.
+
+    Checks: (1) the modeled inter-tier wire bytes at the reference 2x4
+    shape sit at <= `inter_ratio` x the flat a2a's inter-host bytes AND
+    <= 1/intra of the flat a2a's TOTAL bytes (the hierarchy must beat
+    both the same-tier column and the naive per-link share); (2) the
+    compiled inter bucket equals the model's budget max per bundle
+    (model and program share `ops/traffic.py hier_dest_budgets` — drift
+    means one changed without the other); (3) ZERO budget overflow
+    (group aggregation stayed inside U_g = group_factor x U); (4) ZERO
+    steady-state compiles across every arm's timed windows (the nested
+    pipeline restructure must not retrace); (5) BITWISE first-step loss
+    parity across flat 1-D, hier, and nested arms (the forward under the
+    hierarchy is exact — one contributor per psum_scatter position);
+    (6) the nested K-scan within `tol` of the unpipelined hier K-scan
+    (same discipline as --assert-overlap: on CPU the restructure cost is
+    the enforced bound, the overlap win is the TPU number)."""
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    mesh = rec.get("mesh")
+    if not mesh:
+        print(f"roofline: {json_path} has no 'mesh' record "
+              "(run bench.py with --mesh)", file=sys.stderr)
+        return 1
+    if mesh.get("error"):
+        print(f"roofline: mesh arm failed: {mesh['error']}", file=sys.stderr)
+        return 1
+    arms = mesh.get("arms", {})
+    hier = mesh.get("hier")
+    need = {"1d_a2a", "2d_hier", "2d_nested"}
+    if not need <= set(arms) or not hier:
+        print(f"roofline: mesh record needs arms {sorted(need)} + the "
+              f"'hier' tier model (mode={mesh.get('mode')!r}) — run "
+              "--mesh grid", file=sys.stderr)
+        return 1
+    rc = 0
+    r_inter = hier.get("inter_ratio_vs_flat_inter")
+    if r_inter is None or r_inter > inter_ratio:
+        print(
+            f"roofline: hierarchy gate FAILED — modeled inter-tier bytes "
+            f"are {r_inter}x the flat a2a's inter-host bytes (bound "
+            f"{inter_ratio}): the two-tier exchange no longer diets the "
+            f"expensive tier", file=sys.stderr)
+        rc = 1
+    r_total = hier.get("inter_ratio_vs_flat_total_over_intra")
+    if r_total is None or r_total > 1.0:
+        print(
+            f"roofline: hierarchy gate FAILED — modeled inter-tier bytes "
+            f"are {r_total}x the flat total/intra share (bound 1.0): the "
+            f"hierarchy moves MORE across the expensive tier than each "
+            f"flat link's naive share", file=sys.stderr)
+        rc = 1
+    if not hier.get("buckets_measured_eq_modeled"):
+        print(
+            "roofline: hierarchy gate FAILED — a compiled inter bucket "
+            "diverged from the modeled hier_dest_budgets max "
+            f"(per_bundle: {hier.get('per_bundle')})", file=sys.stderr)
+        rc = 1
+    if mesh.get("overflow", 1) != 0:
+        print(
+            f"roofline: hierarchy gate FAILED — {mesh.get('overflow')} "
+            "budget overflow(s): the group unique budget U_g degraded "
+            "rows (default-served) on this stream", file=sys.stderr)
+        rc = 1
+    compiles = mesh.get("trace_guard", {}).get("steady_state_compiles")
+    if compiles != 0:
+        print(
+            f"roofline: hierarchy gate FAILED — {compiles} steady-state "
+            "XLA compile(s) inside timed windows (contract 0; per arm: "
+            f"{ {a: s.get('steady_compiles') for a, s in arms.items()} })",
+            file=sys.stderr)
+        rc = 1
+    if not mesh.get("first_loss_equal"):
+        print(
+            "roofline: hierarchy gate FAILED — first-step loss diverged "
+            "across arms (forward must be BITWISE identical): "
+            f"{ {a: s.get('first_loss') for a, s in arms.items()} }",
+            file=sys.stderr)
+        rc = 1
+    off_ms = arms["2d_hier"]["scan_ms_per_step"]
+    nested_ms = arms["2d_nested"]["scan_ms_per_step"]
+    if nested_ms > off_ms * (1.0 + tol):
+        print(
+            f"roofline: hierarchy gate FAILED — nested K-scan "
+            f"{nested_ms:.3f} ms vs unpipelined hier {off_ms:.3f} ms "
+            f"(bound {1.0 + tol:.2f}x): the two-tier lookahead "
+            "restructure costs more than tolerance", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        mb = hier.get("modeled_bytes", {})
+        print(
+            f"roofline: hierarchy gate ok — inter tier "
+            f"{mb.get('hier_inter')}B = {r_inter}x flat inter-host "
+            f"(bound {inter_ratio}), {r_total}x flat total/intra, "
+            f"0 overflow, 0 steady compiles, bitwise loss parity, "
+            f"nested scan {nested_ms:.2f}ms vs {off_ms:.2f}ms "
+            f"(bound {1.0 + tol:.2f}x)"
+        )
+    return rc
+
+
 def assert_serving(json_path: str, scale_floor: float,
                    grouped_factor: float, quant_ratio: float) -> int:
     """CI gate for the serving scale-out grid (tools/bench_serving.py
@@ -792,6 +898,23 @@ def main(argv=None):
     p.add_argument("--compiles-budget", type=int, default=0,
                    help="allowed total steady-state compiles across arms "
                         "(default 0 — the contract is exactly zero)")
+    p.add_argument("--assert-hierarchy", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the pod-scale 2-D "
+                        "mesh arm recorded in a bench.py JSON ('mesh' "
+                        "section, --mesh grid): inter-tier modeled bytes "
+                        "<= --hierarchy-inter-ratio x flat a2a inter-host "
+                        "AND <= flat total/intra, compiled buckets == "
+                        "model, 0 overflow, 0 steady compiles, bitwise "
+                        "loss parity, nested K-scan within "
+                        "--hierarchy-tol; CI smoke gate)")
+    p.add_argument("--hierarchy-inter-ratio", type=float, default=0.5,
+                   help="required ceiling on modeled hier inter-tier bytes "
+                        "as a fraction of the flat a2a's inter-host bytes "
+                        "at the reference 2x4 shape (default 0.5)")
+    p.add_argument("--hierarchy-tol", type=float, default=0.5,
+                   help="allowed relative K-scan step-time regression of "
+                        "the nested arm vs the unpipelined hier arm "
+                        "(default 0.5 — same rationale as --overlap-tol)")
     p.add_argument("--assert-imbalance", metavar="BENCH_JSON", default=None,
                    help="don't run the step: validate the skew-aware "
                         "placement arm recorded in a bench.py JSON (the "
@@ -877,6 +1000,10 @@ def main(argv=None):
     if args.assert_compiles:
         sys.exit(assert_compiles(args.assert_compiles,
                                  args.compiles_budget))
+    if args.assert_hierarchy:
+        sys.exit(assert_hierarchy(args.assert_hierarchy,
+                                  args.hierarchy_inter_ratio,
+                                  args.hierarchy_tol))
     if args.assert_imbalance:
         sys.exit(assert_imbalance(args.assert_imbalance,
                                   args.imbalance_factor, args.imbalance_tol))
