@@ -58,6 +58,10 @@ echo "== obs overhead gate, serving arm (telemetry plane ≤2% + /metrics parses
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-obs /tmp/deeprec_serving_smoke.json
 
+echo "== compute-reuse gate (zipf arm ≥2× effective qps, hit-rate floor, bit-identity, publish dip+recovery, 0 steady compiles) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-reuse /tmp/deeprec_serving_smoke.json
+
 echo "== retrieval bench (CPU smoke: 1M-item blocked top-k sweep, int8 + fp32 residency, recall vs exact scan, gather baseline, delta-fold freshness, trace guard) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_retrieval.py --smoke \
     --out /tmp/deeprec_retrieval_smoke.json

@@ -605,6 +605,75 @@ def retrieval_sweep_bytes(
     return float(b)
 
 
+# ------------------------------------------------------- compute reuse
+
+
+def serving_reuse_speedup(
+    *, hit_rate: float, hit_cost_ratio: float = 0.0,
+) -> float:
+    """Modeled effective-qps factor of the serving compute-reuse layer
+    (serving/reuse.py) at a given answer-cache hit rate, closed-loop:
+
+        speedup = 1 / (1 - h + h * c)
+
+    where ``h`` is the hit rate and ``c`` the cost of serving a hit
+    relative to a full evaluation (fingerprint + dict lookup vs a device
+    dispatch; ~0 for the answer cache, larger for the user-tower cache
+    where the candidate-only lane still runs the item tower). Amdahl on
+    the per-request serial cost: at h=0.5, c=0 the tier answers 2x the
+    requests per second from the same compute — the ROADMAP's >=2x
+    target IS this curve at the zipf-population hit rate.
+
+    `tools/bench_serving.py compute_reuse` records the measured factor
+    next to this model and `roofline.py --assert-reuse` gates the
+    measured one; the model is the capacity-planning knob (what hit rate
+    does a target speedup need?)."""
+    h = float(hit_rate)
+    c = float(hit_cost_ratio)
+    if not 0.0 <= h <= 1.0:
+        raise ValueError(f"hit_rate must be in [0, 1], got {h}")
+    if c < 0.0:
+        raise ValueError(f"hit_cost_ratio must be >= 0, got {c}")
+    denom = (1.0 - h) + h * c
+    if denom <= 0.0:
+        raise ValueError("hit_rate 1.0 with zero hit cost: infinite model")
+    return 1.0 / denom
+
+
+def reuse_hit_rate_for_speedup(
+    *, speedup: float, hit_cost_ratio: float = 0.0,
+) -> float:
+    """Inverse of `serving_reuse_speedup`: the answer-cache hit rate a
+    target effective-qps factor requires (capacity planning: size the
+    cache/population so the zipf head clears this rate)."""
+    s = float(speedup)
+    c = float(hit_cost_ratio)
+    if s < 1.0:
+        raise ValueError(f"speedup must be >= 1, got {s}")
+    if c >= 1.0:
+        raise ValueError(f"hit_cost_ratio must be < 1, got {c}")
+    return (1.0 - 1.0 / s) / (1.0 - c)
+
+
+def zipf_expected_hit_rate(*, users: int, alpha: float,
+                           resident: int) -> float:
+    """Expected answer-cache hit rate for a zipf(alpha) population of
+    `users` distinct request keys with the hottest `resident` keys
+    cached (steady state, capacity >= resident): the probability mass of
+    the resident head,
+
+        sum_{r<resident} r^-alpha / sum_{r<users} r^-alpha.
+
+    The shape `bench_serving --user-zipf A --users N` drives; recorded
+    beside the measured hit rate so the bench can show the LRU converges
+    on the head."""
+    if users < 1 or resident < 0:
+        raise ValueError(f"bad population users={users} resident={resident}")
+    ranks = [float(r + 1) ** (-float(alpha)) for r in range(int(users))]  # noqa: DRT002 — host-side analytic model, no device values
+    total = sum(ranks)
+    return sum(ranks[: min(int(resident), int(users))]) / total
+
+
 # ---------------------------------------------------------- pipelining model
 
 

@@ -88,6 +88,11 @@ _FLAG_GROUP_USERS = 1
 # context (two LE u64s: trace id, parent span id) — how a sampled
 # request's trace id crosses the frontend->backend socket hop
 _FLAG_TRACE = 2
+# bit2 (PRED flags byte and the RETR leading flags byte alike): force a
+# real evaluation through a warm compute-reuse cache — no cache read,
+# no write, no in-window memo sharing. The canary/quality-gate probe
+# and parity-test contract (serving/reuse.py, docs/serving.md).
+_FLAG_NO_CACHE = 4
 
 
 # ------------------------------------------------------------ frame helpers
@@ -223,6 +228,7 @@ class BackendServer:
             if not body:
                 raise BadRequest("empty PRED body")
             grouped = bool(body[0] & _FLAG_GROUP_USERS)
+            no_cache = bool(body[0] & _FLAG_NO_CACHE)
             off = 1
             ctx = None
             if body[0] & _FLAG_TRACE:
@@ -235,7 +241,8 @@ class BackendServer:
                 self._inflight += 1
             try:
                 probs, version = self.server.request_versioned(
-                    batch, group_users=grouped, trace_ctx=ctx)
+                    batch, group_users=grouped, trace_ctx=ctx,
+                    no_cache=no_cache)
             finally:
                 with self._conn_lock:
                     self._inflight -= 1
@@ -272,6 +279,7 @@ class BackendServer:
                 raise BadRequest("short RETR body")
             if getattr(self.server, "retrieval", None) is None:
                 raise BadRequest("retrieval not enabled on this backend")
+            no_cache = bool(body[0] & _FLAG_NO_CACHE)
             k = struct.unpack("<I", body[1:5])[0]
             batch = _unpack_arrays(body[5:])
             if not batch:
@@ -279,7 +287,8 @@ class BackendServer:
             with self._conn_lock:
                 self._inflight += 1
             try:
-                res = self.server.retrieve_versioned(batch, int(k))
+                res = self.server.retrieve_versioned(batch, int(k),
+                                                     no_cache=no_cache)
             finally:
                 with self._conn_lock:
                     self._inflight -= 1
@@ -949,7 +958,8 @@ class Frontend:
     def request_versioned(self, features: Dict[str, np.ndarray],
                           timeout: Optional[float] = None,
                           group_users: bool = False,
-                          trace_ctx: Optional[Tuple[int, int]] = None):
+                          trace_ctx: Optional[Tuple[int, int]] = None,
+                          no_cache: bool = False):
         """(result, model_version) through whichever backend answered.
         The version stamps the BACKEND snapshot that served the whole
         request (coalesced neighbors on that backend share it).
@@ -963,6 +973,8 @@ class Frontend:
                 if features else 0)
         sp = obs_trace.span("frontend_dispatch", "serving", ctx=trace_ctx)
         flags = _FLAG_GROUP_USERS if group_users else 0
+        if no_cache:
+            flags |= _FLAG_NO_CACHE
         prefix = b""
         if sp.ctx is not None:
             flags |= _FLAG_TRACE
@@ -999,7 +1011,8 @@ class Frontend:
     # ----------------------------------------------------------- retrieval
 
     def retrieve_versioned(self, features: Dict[str, np.ndarray], k: int,
-                           timeout: Optional[float] = None):
+                           timeout: Optional[float] = None,
+                           no_cache: bool = False):
         """Full-corpus top-k across the fleet: fan one RETR frame to
         EVERY routable member in parallel (each owns a corpus shard) and
         lexsort-merge the per-shard answers at the edge (score desc, item
@@ -1036,8 +1049,8 @@ class Frontend:
         # backed off, try them all anyway (last resort beats failing).
         now = time.monotonic()
         routable = [m for m in members if m.available(now)] or members
-        body = bytes([0]) + struct.pack("<I", int(k)) + \
-            _pack_arrays(features)
+        body = bytes([_FLAG_NO_CACHE if no_cache else 0]) + \
+            struct.pack("<I", int(k)) + _pack_arrays(features)
         slots: List[Optional[Dict]] = [None] * len(routable)
 
         def sweep(i, m):
@@ -1426,6 +1439,7 @@ def backend_argv(
     capacity: int = 1, member_name: str = "", port: int = 0,
     retrieval_shard: Optional[str] = None,
     retrieval_quantize: str = "int8",
+    reuse_mb: float = 0.0,
 ) -> List[str]:
     """The backend CLI argv for one serving process — shared by
     `spawn_backends`, the Supervisor-driven fleet specs (a respawn with
@@ -1447,6 +1461,8 @@ def backend_argv(
     if retrieval_shard:
         argv += ["--retrieval", "--retrieval-shard", retrieval_shard,
                  "--retrieval-quantize", retrieval_quantize]
+    if reuse_mb:
+        argv += ["--reuse-mb", str(reuse_mb)]
     if registry:
         argv += ["--registry", registry]
         if lease_secs is not None:
@@ -1511,6 +1527,7 @@ def spawn_backends(
     capacity: int = 1, member_name: str = "",
     env: Optional[Dict[str, str]] = None, ready_timeout: float = 180.0,
     retrieval: bool = False, retrieval_quantize: str = "int8",
+    reuse_mb: float = 0.0,
 ):
     """Launch `n` backend serving processes on this host and wait for
     their READY lines. Returns (procs, addrs) — pass `addrs` to
@@ -1532,7 +1549,7 @@ def spawn_backends(
             lease_secs=lease_secs, capacity=capacity,
             member_name=(f"{member_name}-{i}" if member_name else ""),
             retrieval_shard=(f"{i}/{n}" if retrieval else None),
-            retrieval_quantize=retrieval_quantize)
+            retrieval_quantize=retrieval_quantize, reuse_mb=reuse_mb)
         p = subprocess.Popen(
             argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env={**os.environ, **(env or {})},
@@ -1630,6 +1647,11 @@ def main(argv=None):
     p.add_argument("--retrieval-shard", default="0/1",
                    help="'i/n': this backend owns corpus shard i of n "
                         "(items hash-partition across the fleet)")
+    p.add_argument("--reuse-mb", type=float, default=0.0,
+                   help="backend mode: compute-reuse cache budget in MiB "
+                        "(serving/reuse.py; 0 = caches off). Sizes the "
+                        "predict answer cache, the user-tower cache and "
+                        "the retrieval candidate cache alike")
     args = p.parse_args(argv)
 
     kwargs = json.loads(args.model_json) if args.model_json else {}
@@ -1654,9 +1676,11 @@ def main(argv=None):
         from deeprec_tpu.serving.predictor import ModelServer, Predictor
 
         pred = Predictor(model, args.ckpt, quantize=args.quantize)
+        reuse_bytes = int(args.reuse_mb * (1 << 20))
         server = ModelServer(pred, max_batch=args.max_batch,
                              max_wait_ms=args.max_wait_ms,
-                             poll_updates_secs=args.poll_secs)
+                             poll_updates_secs=args.poll_secs,
+                             reuse_cache_bytes=reuse_bytes)
         if args.retrieval:
             from deeprec_tpu.serving.retrieval import RetrievalEngine
 
@@ -1666,7 +1690,8 @@ def main(argv=None):
                 block_rows=args.retrieval_block,
                 chunk=args.retrieval_chunk,
                 shard_index=int(si), num_shards=int(sn))  # noqa: DRT002 — parsing a shard-spec config string, not a device value
-            server.attach_retrieval(engine)
+            server.attach_retrieval(engine,
+                                    reuse_cache_bytes=reuse_bytes)
         backend = BackendServer(
             server, host=args.host, port=args.port, registry=registry,
             capacity=args.capacity, member_name=args.member_name,

@@ -251,7 +251,8 @@ class _Handler(BaseHTTPRequestHandler):
             except (TypeError, ValueError) as e:
                 return self._send(400, {"error": str(e)})
             try:
-                res = rv(batch, k)
+                rkw = {"no_cache": True} if payload.get("no_cache") else {}
+                res = rv(batch, k, **rkw)
             except BadRequest as e:
                 return self._send(400, e.details)
             except Exception as e:  # request-level failure, keep serving
@@ -291,6 +292,11 @@ class _Handler(BaseHTTPRequestHandler):
             edge = obs_trace.server_span(
                 "http_predict", "edge",
                 header=self.headers.get(obs_trace.HEADER))
+            # `no_cache` forces a real evaluation through a warm
+            # compute-reuse cache (canary/parity probes) — passed only
+            # when set, so servers without the reuse layer keep their
+            # signature.
+            kw = {"no_cache": True} if payload.get("no_cache") else {}
             if payload.get("group_users"):
                 # sample-aware compression: a <user, N items> request
                 # rides the grouped lane of the coalescing queue — many
@@ -301,13 +307,13 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     with edge:
                         probs, version = server.request_versioned(
-                            batch, group_users=True)
+                            batch, group_users=True, **kw)
                 except (BadRequest, ValueError) as e:  # no tower split
                     return self._send(400, getattr(e, "details",
                                                    {"error": str(e)}))
             else:
                 with edge:
-                    probs, version = server.request_versioned(batch)
+                    probs, version = server.request_versioned(batch, **kw)
             if isinstance(probs, dict):
                 out = {k: np.asarray(v).tolist() for k, v in probs.items()}
             else:

@@ -308,6 +308,10 @@ class Predictor:
         self._predict_grouped_step = jax.jit(
             self._predict_grouped_impl, static_argnums=2
         )
+        self._predict_grouped_uvec_step = jax.jit(
+            self._predict_grouped_uvec_impl, static_argnums=2
+        )
+        self._predict_with_user_step = jax.jit(self._predict_with_user_impl)
         self._forward_step = jax.jit(self._forward_impl)
         self._lookup_step = jax.jit(self._lookup_views)
         # Pre-swap canary (guard/canary.py QualityGate): every update —
@@ -322,6 +326,11 @@ class Predictor:
         # replay folds changed item rows into the resident corpus matrix
         # within the SAME poll round (freshness contract).
         self._retrieval = None
+        # Compute-reuse caches (serving/reuse.py): every publish is the
+        # invalidation edge — entries are keyed by version, so the swap
+        # makes them dead and invalidate_stale() reclaims the bytes
+        # inside the SAME updater round (never a background sweep).
+        self._reuse_caches: List = []
         self._m_gate_rejections = None
         if quality_gate is not None and obs_metrics.metrics_enabled():
             self._m_gate_rejections = obs_metrics.default_registry().counter(
@@ -380,6 +389,12 @@ class Predictor:
         (called by the engine's own constructor)."""
         self._retrieval = engine
 
+    def attach_reuse_cache(self, cache) -> None:
+        """Register a ReuseCache for publish-edge invalidation: every
+        snapshot swap drops the cache's stale-version entries before the
+        updater round ends (serving/reuse.py contract)."""
+        self._reuse_caches.append(cache)
+
     # ----------------------------------------------- pre-swap quality gate
 
     def _gate_probs(self, state: TrainState):
@@ -435,6 +450,11 @@ class Predictor:
         prev = self._snap
         self._snap = _Snapshot(prev.version + 1 if prev else 0, state)
         self._applied = set(applied)
+        # Invalidation-by-version: the swap already made every cached
+        # answer un-hittable (keys carry the version); this reclaims the
+        # bytes and counts the drops on the publish edge.
+        for c in self._reuse_caches:
+            c.invalidate_stale()
 
     def _warm_state(self, state: TrainState) -> None:
         # list(): a concurrent warmup() may register new buckets mid-walk
@@ -736,6 +756,112 @@ class Predictor:
             return {k: jax.nn.sigmoid(v) for k, v in out.items()}
         return jax.nn.sigmoid(out)
 
+    def _predict_grouped_uvec_impl(self, state, batch, num_groups: int):
+        """`_predict_grouped_impl` that ALSO returns the per-row user
+        vectors — the user-tower cache's population path (serving/
+        reuse.py): the batcher stores each request's lead user vector so
+        the next request from that user skips the user tower entirely.
+        Same recipe as the grouped trace, so probabilities are
+        row-for-row identical to it."""
+        from deeprec_tpu import nn as _nn
+
+        m = self.model
+        views, _ = self._lookup_views(state, batch)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+        inputs = self._trainer._build_inputs(embs, views, batch)
+        ucols = jnp.concatenate(
+            [batch[n].reshape(batch[n].shape[0], -1) for n in m.user_feats],
+            axis=1,
+        )
+        _, gids = jnp.unique(
+            ucols, axis=0, size=num_groups, return_inverse=True
+        )
+        uvec = _nn.apply_grouped(
+            lambda ins: m.user_vector(state.dense, ins),
+            inputs,
+            gids.reshape(-1),
+            num_groups,
+        )
+        out = m.apply_with_user(state.dense, uvec, inputs)
+        if isinstance(out, dict):
+            return {k: jax.nn.sigmoid(v) for k, v in out.items()}, uvec
+        return jax.nn.sigmoid(out), uvec
+
+    def _predict_with_user_impl(self, state, batch, uvec):
+        """The candidate-only lane: the user tower never runs — `uvec`
+        (one cached user vector per row) is applied directly. Everything
+        else (lookup, item tower, scoring head, sigmoid) is the grouped
+        recipe, so a cached-user answer is row-for-row identical to the
+        full evaluation that produced the vector."""
+        m = self.model
+        views, _ = self._lookup_views(state, batch)
+        embs = {n: v[0].astype(jnp.float32) for n, v in views.items()}
+        inputs = self._trainer._build_inputs(embs, views, batch)
+        out = m.apply_with_user(state.dense, uvec, inputs)
+        if isinstance(out, dict):
+            return {k: jax.nn.sigmoid(v) for k, v in out.items()}
+        return jax.nn.sigmoid(out)
+
+    def predict_grouped_uvec_versioned(self, batch: Dict[str, np.ndarray]):
+        """(probabilities, per-row user vectors, model_version) — the
+        grouped path through `_predict_grouped_uvec_step`. Bucketing is
+        identical to `predict_versioned(group_users=True)`; the extra
+        output feeds the user-tower cache."""
+        snap = self._snap
+        state = snap.state
+        m = self.model
+        cols = np.concatenate(
+            [
+                np.asarray(batch[n]).reshape(len(np.asarray(batch[n])), -1)  # noqa: DRT002 — group_users host-side dedup is the documented price of sample-aware compression
+                for n in m.user_feats
+            ],
+            axis=1,
+        )
+        b = cols.shape[0]
+        bp = 1 << max(b - 1, 0).bit_length()
+        distinct = len(np.unique(cols, axis=0))
+        g = min(1 << max(distinct - 1, 0).bit_length(), bp)
+
+        def pad(v):
+            v = np.asarray(v)  # noqa: DRT002 — host distinct-user count sizes the compile bucket BEFORE dispatch
+            if bp > b:
+                v = np.concatenate([v, np.repeat(v[-1:], bp - b, axis=0)])
+            return jnp.asarray(v)
+
+        jb = {k: pad(v) for k, v in batch.items()}
+        probs, uvec = self._predict_grouped_uvec_step(state, jb, g)
+        return (
+            jax.tree.map(lambda a: np.asarray(a)[:b], probs),  # noqa: DRT002 — result D2H: the reply must land on the host
+            np.asarray(uvec)[:b],  # noqa: DRT002 — user vectors land host-side to become cache values
+            snap.version,
+        )
+
+    def predict_with_user_versioned(self, batch: Dict[str, np.ndarray],
+                                    uvec: np.ndarray):
+        """(probabilities, model_version) with the user tower skipped:
+        `uvec` carries one user vector per batch row (from the
+        user-tower cache). Rows bucket to powers of two exactly like the
+        grouped path (pad repeats the last row AND its vector, so the
+        pad rows stay self-consistent). The caller must re-check that
+        the returned version equals the version the vectors were cached
+        at — a publish between lookup and dispatch makes the answer
+        stale, and the batcher falls back to the full grouped path."""
+        first = next(iter(batch.values()))
+        b = int(np.asarray(first).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
+        bp = 1 << max(b - 1, 0).bit_length()
+
+        def pad(v):
+            v = np.asarray(v)  # noqa: DRT002 — host pad of request payload, pre-dispatch
+            if bp > b:
+                v = np.concatenate([v, np.repeat(v[-1:], bp - b, axis=0)])
+            return jnp.asarray(v)
+
+        snap = self._snap
+        jb = {k: pad(v) for k, v in batch.items()}
+        juv = pad(np.asarray(uvec, np.float32))
+        probs = self._predict_with_user_step(snap.state, jb, juv)
+        return jax.tree.map(lambda a: np.asarray(a)[:b], probs), snap.version  # noqa: DRT002 — result D2H: the reply must land on the host
+
     def _forward_impl(self, state, views, batch):
         return self._trainer.probs_from_views(state, views, batch)[1]
 
@@ -944,6 +1070,8 @@ class ModelServer:
         request_queue: Optional["queue.Queue"] = None,
         stats: Optional[ServingStats] = None,
         arrivals: Optional[_ArrivalEWMA] = None,
+        reuse_cache_bytes: int = 0,
+        user_cache_bytes: Optional[int] = None,
     ):
         self.predictor = predictor
         self.max_batch = max_batch
@@ -978,6 +1106,36 @@ class ModelServer:
                 lambda: self.predictor.last_apply_lag_seconds,
                 "trainer-commit to serving-swap age of the last applied "
                 "checkpoint")
+        # Compute reuse (serving/reuse.py) — OPT-IN (`reuse_cache_bytes`
+        # > 0): an answer cache keyed (request fp, model version) plus,
+        # for tower models, a user-tower cache keyed (user-features fp,
+        # model version) that routes hits onto the candidate-only lane.
+        # Off by default: caching changes the traffic a bench arm
+        # measures, so every arm opts in explicitly.
+        self.reuse = None
+        self.user_reuse = None
+        self.memo_shared = 0  # in-window memoization: requests served
+        self._m_memo = None   # off a coalesced twin's computation
+        if reuse_cache_bytes > 0:
+            from deeprec_tpu.serving.reuse import ReuseCache
+
+            ub = (user_cache_bytes if user_cache_bytes is not None
+                  else reuse_cache_bytes)
+            self.reuse = ReuseCache(
+                reuse_cache_bytes, "predict", registry=r,
+                version_fn=lambda: self.predictor.version)
+            predictor.attach_reuse_cache(self.reuse)
+            if ub > 0 and hasattr(predictor.model, "apply_with_user"):
+                self.user_reuse = ReuseCache(
+                    ub, "user_tower", registry=r,
+                    version_fn=lambda: self.predictor.version)
+                predictor.attach_reuse_cache(self.user_reuse)
+            if r is not None:
+                self._m_memo = r.counter(
+                    "deeprec_reuse_memo_shared",
+                    "in-flight requests that shared a coalesced twin's "
+                    "computation inside one micro-batch window",
+                    {"cache": "predict"})
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
         self.retrieval = None  # RetrievalServer once attach_retrieval ran
@@ -1017,11 +1175,11 @@ class ModelServer:
         count past max_batch — an overflowing batch falls off the bucket
         ladder and traces a fresh arrival-timing-dependent XLA shape, the
         exact stall class this server exists to prevent — or it disagrees
-        with the batch on `group_users` (a grouped batch dispatches
-        through the sample-aware compressed trace, an ungrouped one
-        through the plain trace: they cannot share a dispatch). The
-        rejected request leads the NEXT batch instead. Returns the new
-        row count (== max_batch signals 'batch is full, dispatch')."""
+        with the batch on its lane (plain / grouped / grouped-with-
+        cached-user dispatch through three different traces: they cannot
+        share a dispatch). The rejected request leads the NEXT batch
+        instead. Returns the new row count (== max_batch signals 'batch
+        is full, dispatch')."""
         if pending and (rows + nxt[1] > self.max_batch
                         or nxt[4] != pending[0][4]):
             self._carry = nxt
@@ -1063,15 +1221,35 @@ class ModelServer:
             self._serve(pending)
 
     def _serve(
-        self, pending: List[Tuple[Dict, int, "queue.Queue", float, bool,
-                                  Optional[tuple]]]
+        self, pending: List[Tuple[Dict, int, "queue.Queue", float, int,
+                                  Optional[tuple], Optional[bytes],
+                                  Optional[bytes], Optional[tuple]]]
     ):
         t0 = time.monotonic()
-        grouped = pending[0][4]  # homogeneous by _take's admission rule
-        for _, _, _, t_enq, _, _ in pending:
-            self.stats.record_stage("queue", t0 - t_enq)
-        reqs = [r for r, _, _, _, _, _ in pending]
-        sizes = [n for _, n, _, _, _, _ in pending]
+        lane = pending[0][4]  # homogeneous by _take's admission rule
+        for p in pending:
+            self.stats.record_stage("queue", t0 - p[3])
+        # In-window memoization: identical in-flight requests (same
+        # answer fingerprint — same features, same lane) share ONE
+        # computation and one answer instead of padding the batch with
+        # duplicate rows. Only the first occurrence rides the batch; its
+        # twins get the same slice. no_cache requests carry fp=None and
+        # never share.
+        leaders = pending
+        dups: Dict[bytes, List] = {}
+        if self.reuse is not None:
+            seen: Dict[bytes, bool] = {}
+            leaders = []
+            for p in pending:
+                fp = p[6]
+                if fp is not None and fp in seen:
+                    dups.setdefault(fp, []).append(p)
+                    continue
+                if fp is not None:
+                    seen[fp] = True
+                leaders.append(p)
+        reqs = [p[0] for p in leaders]
+        sizes = [p[1] for p in leaders]
         batch = {
             k: np.concatenate([np.asarray(r[k]) for r in reqs])  # noqa: DRT002 — micro-batch assembly of host request payloads before the one dispatch
             for k in reqs[0]
@@ -1091,20 +1269,40 @@ class ModelServer:
         self.stats.record_stage("pad", time.monotonic() - t0)
         try:
             t1 = time.monotonic()
-            probs, version = self.predictor.predict_versioned(
-                batch, group_users=grouped
-            )
+            probs, version, uvec_rows = self._dispatch(batch, lane, leaders,
+                                                       sizes, total, bucket)
             t2 = time.monotonic()
             self.stats.record_stage("device", t2 - t1)
             off = 0
-            for (_, _, reply, _, _, _), n in zip(pending, sizes):
+            for p, n in zip(leaders, sizes):
                 sl = (
                     {k: v[off : off + n] for k, v in probs.items()}
                     if isinstance(probs, dict)
                     else probs[off : off + n]
                 )
-                reply.put((sl, version))
+                p[2].put((sl, version))
+                if p[6] is not None:
+                    for d in dups.get(p[6], ()):
+                        d[2].put((sl, version))
+                    # store a COPY: a view would pin the whole padded
+                    # batch output, breaking the byte accounting
+                    self.reuse.put(
+                        p[6], version,
+                        {k: np.ascontiguousarray(v) for k, v in sl.items()}
+                        if isinstance(sl, dict)
+                        else np.ascontiguousarray(sl))
+                if (p[7] is not None and uvec_rows is not None
+                        and self.user_reuse is not None):
+                    # lead row's user vector — the whole request shares
+                    # one user by the grouped-request contract
+                    self.user_reuse.put(p[7], version,
+                                        np.ascontiguousarray(uvec_rows[off]))
                 off += n
+            shared = len(pending) - len(leaders)
+            if shared:
+                self.memo_shared += shared
+                if self._m_memo is not None:
+                    self._m_memo.inc(shared)
             t3 = time.monotonic()
             self.stats.record_stage("post", t3 - t2)
             self.stats.record_batch(len(pending), total)
@@ -1115,7 +1313,8 @@ class ModelServer:
                 # its own queue/pad/device/post children under its
                 # dispatch span. monotonic -> wall via one offset.
                 wall = time.time() - t3
-                for _, _, _, t_enq, _, ctx in pending:
+                for p in pending:
+                    t_enq, ctx = p[3], p[5]
                     if ctx is None:
                         continue
                     for nm, a, b in (("stage_queue", t_enq, t0),
@@ -1127,8 +1326,51 @@ class ModelServer:
                                        parent=ctx[1])
         except Exception as e:
             self.stats.record_error(len(pending))
-            for _, _, reply, _, _, _ in pending:
-                reply.put(e)
+            for p in pending:
+                p[2].put(e)
+
+    def _dispatch(self, batch, lane: int, leaders, sizes, total: int,
+                  bucket: int):
+        """One device dispatch for the assembled batch: per lane, the
+        plain trace, the grouped trace (returning per-row user vectors
+        when the user-tower cache wants them), or the candidate-only
+        trace fed by cached user vectors. Returns (probs, version,
+        per-row user vectors or None). Lane 2 falls back to the full
+        grouped evaluation whenever the cached vectors' version no
+        longer matches the snapshot that answered — a publish between
+        cache lookup and dispatch must never produce a mixed-version
+        answer."""
+        if lane == 0:
+            probs, version = self.predictor.predict_versioned(batch)
+            return probs, version, None
+        if lane == 2:
+            uvers = {p[8][1] for p in leaders}
+            if len(uvers) == 1:
+                urows = np.concatenate([
+                    np.broadcast_to(
+                        np.asarray(p[8][0], np.float32).reshape(1, -1),
+                        (n, np.asarray(p[8][0]).size))
+                    for p, n in zip(leaders, sizes)
+                ])
+                if bucket > total:
+                    urows = np.concatenate(
+                        [urows, np.repeat(urows[-1:], bucket - total,
+                                          axis=0)])
+                probs, version = self.predictor.predict_with_user_versioned(
+                    batch, urows)
+                if version == next(iter(uvers)):
+                    return probs, version, None
+            probs, version = self.predictor.predict_versioned(
+                batch, group_users=True)
+            return probs, version, None
+        if self.user_reuse is not None and any(p[7] is not None
+                                               for p in leaders):
+            probs, uvec_rows, version = (
+                self.predictor.predict_grouped_uvec_versioned(batch))
+            return probs, version, uvec_rows
+        probs, version = self.predictor.predict_versioned(
+            batch, group_users=True)
+        return probs, version, None
 
     def _buckets(self) -> List[int]:
         """The ONE bucket ladder (shared by _serve and warmup — any change
@@ -1170,12 +1412,20 @@ class ModelServer:
             self.predictor.predict(batch)
             if group_users:
                 self.predictor.predict(batch, group_users=True)
+                if self.user_reuse is not None:
+                    # the user-tower-cache lanes: compile the grouped-
+                    # with-uvec trace (population) and the candidate-only
+                    # trace (hits) at this bucket too
+                    _, uv, _ = self.predictor.predict_grouped_uvec_versioned(
+                        batch)
+                    self.predictor.predict_with_user_versioned(batch, uv)
             self.predictor.register_warm_batch(batch)
         return len(sizes)
 
     def submit(self, features: Dict[str, np.ndarray],
                group_users: bool = False,
-               trace_ctx: Optional[tuple] = None) -> "queue.Queue":
+               trace_ctx: Optional[tuple] = None,
+               no_cache: bool = False) -> "queue.Queue":
         """Enqueue one request onto the coalescing queue and return the
         reply queue (a one-shot future: `.get()` yields `(result,
         model_version)` or an Exception). The non-blocking half of
@@ -1187,7 +1437,14 @@ class ModelServer:
         device batch carries many `<user, N items>` requests and the user
         tower runs once per distinct user across all of them. Validated
         here (not at dispatch) so a tower-less model fails this request
-        alone, never a coalesced batch of strangers."""
+        alone, never a coalesced batch of strangers.
+
+        With compute reuse enabled an answer-cache hit at the live model
+        version replies right here — no enqueue, no dispatch; a grouped
+        request whose user vector is cached rides the candidate-only
+        lane instead. `no_cache=True` (the canary/parity probe contract)
+        bypasses reads, writes AND in-window memo sharing: the request
+        is a full evaluation, always."""
         if group_users and not hasattr(self.predictor.model,
                                        "apply_with_user"):
             raise BadRequest(
@@ -1199,10 +1456,32 @@ class ModelServer:
             int(np.asarray(next(iter(features.values()))).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
             if features else 0
         )
+        # Queue-item lanes (homogeneous per batch, _take enforces):
+        # 0 plain, 1 grouped, 2 grouped-with-cached-user-vector. fp keys
+        # the answer cache (None: reuse off or no_cache), ufp marks a
+        # user-tower entry to POPULATE after dispatch, uaux carries a
+        # cached (user vector, version) for lane 2.
+        lane = 1 if group_users else 0
+        fp = ufp = uaux = None
+        if self.reuse is not None and not no_cache:
+            from deeprec_tpu.serving import reuse as _reuse
+
+            fp = _reuse.request_fingerprint(
+                features, extra=b"g" if group_users else b"")
+            hit = self.reuse.get_current(fp)
+            if hit is not None:
+                reply.put(hit)  # (answer, version) — read atomically
+                return reply
+            if group_users and self.user_reuse is not None:
+                ufp = _reuse.request_fingerprint(
+                    features, names=list(self.predictor.model.user_feats))
+                uhit = self.user_reuse.get_current(ufp)
+                if uhit is not None:
+                    uaux, ufp, lane = uhit, None, 2
         t0 = time.monotonic()
         self._arrivals.note(t0, rows)
-        self._q.put((features, rows, reply, t0, bool(group_users),
-                     trace_ctx))
+        self._q.put((features, rows, reply, t0, lane, trace_ctx,
+                     fp, ufp, uaux))
         return reply
 
     def attach_retrieval(self, engine, **kwargs) -> "object":
@@ -1216,12 +1495,13 @@ class ModelServer:
         return self.retrieval
 
     def retrieve_versioned(self, features: Dict[str, np.ndarray], k: int,
-                           timeout: float = 30.0):
+                           timeout: float = 30.0, no_cache: bool = False):
         """Full-corpus top-k for each user row (serving/retrieval.py) —
         the retrieval lane's analog of request_versioned."""
         if self.retrieval is None:
             raise BadRequest("retrieval not enabled on this server")
-        return self.retrieval.request_versioned(features, k, timeout=timeout)
+        return self.retrieval.request_versioned(features, k, timeout=timeout,
+                                                no_cache=no_cache)
 
     def request(self, features: Dict[str, np.ndarray], timeout: float = 30.0,
                 group_users: bool = False):
@@ -1231,6 +1511,7 @@ class ModelServer:
     def request_versioned(
         self, features: Dict[str, np.ndarray], timeout: float = 30.0,
         group_users: bool = False, trace_ctx: Optional[tuple] = None,
+        no_cache: bool = False,
     ):
         """(result, model_version) — the version the whole request was
         served from (one snapshot; coalesced neighbors share it, so a
@@ -1244,7 +1525,7 @@ class ModelServer:
         sp = obs_trace.span("dispatch", "serving", ctx=trace_ctx)
         with sp:
             reply = self.submit(features, group_users=group_users,
-                                trace_ctx=sp.ctx)
+                                trace_ctx=sp.ctx, no_cache=no_cache)
             out = reply.get(timeout=timeout)
         self.stats.record_stage("e2e", time.monotonic() - t0)
         if isinstance(out, Exception):
@@ -1273,6 +1554,17 @@ class ModelServer:
         out["residency"] = p.residency_info()
         if self.retrieval is not None:
             out["retrieval_corpus"] = self.retrieval.engine.sweep_info()
+        reuse = {}
+        if self.reuse is not None:
+            reuse["predict"] = self.reuse.snapshot()
+        if self.user_reuse is not None:
+            reuse["user_tower"] = self.user_reuse.snapshot()
+        if (self.retrieval is not None
+                and getattr(self.retrieval, "reuse", None) is not None):
+            reuse["retrieve"] = self.retrieval.reuse.snapshot()
+        if reuse:
+            reuse["memo_shared"] = self.memo_shared
+            out["reuse"] = reuse
         return out
 
     def metrics_snapshot(self) -> Dict:
@@ -1397,16 +1689,19 @@ class ServerGroup:
     def request_versioned(
         self, features: Dict[str, np.ndarray], timeout: float = 30.0,
         group_users: bool = False, trace_ctx: Optional[tuple] = None,
+        no_cache: bool = False,
     ):
         return self.members[0].request_versioned(
             features, timeout=timeout, group_users=group_users,
-            trace_ctx=trace_ctx)
+            trace_ctx=trace_ctx, no_cache=no_cache)
 
     def submit(self, features: Dict[str, np.ndarray],
                group_users: bool = False,
-               trace_ctx: Optional[tuple] = None) -> "queue.Queue":
+               trace_ctx: Optional[tuple] = None,
+               no_cache: bool = False) -> "queue.Queue":
         return self.members[0].submit(features, group_users=group_users,
-                                      trace_ctx=trace_ctx)
+                                      trace_ctx=trace_ctx,
+                                      no_cache=no_cache)
 
     def warmup(self, example: Dict[str, np.ndarray],
                group_users: bool = False) -> int:

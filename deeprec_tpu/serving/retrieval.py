@@ -233,6 +233,14 @@ class RetrievalEngine:
         self.last_fold: Optional[Dict] = None
         self.folds = 0
         self.rows_folded = 0
+        # Corpus revision: bumps whenever resident item vectors change
+        # WITHOUT a model publish (ingest/upsert re-encodes rows at the
+        # same model version). Together with the model version it is the
+        # candidate cache's version key — `folds` alone cannot serve:
+        # upsert refreshes rows without folding. Attached ReuseCaches
+        # (serving/reuse.py) invalidate on every bump.
+        self.corpus_rev = 0
+        self._reuse_caches: List = []
         # Warm the encode program + learn H off one pad chunk, then
         # allocate the (empty) first block and publish.
         state = predictor._snap.state
@@ -498,6 +506,9 @@ class RetrievalEngine:
             self._sid = self._h_ids[:self._rows][order]
             self._srow = order.astype(np.int64)
             self._refresh_rows(rows_ix, self._pred._snap.state)
+            self.corpus_rev += 1
+        for c in self._reuse_caches:
+            c.invalidate_stale()
         return int(ids.size)
 
     # ---------------------------------------------------------- freshness
@@ -555,6 +566,7 @@ class RetrievalEngine:
                     return
             self._refresh_rows(dirty, state)
             self.folds += 1
+            self.corpus_rev += 1
             self.rows_folded += int(dirty.size)  # noqa: DRT002 — host np scalar, fold bookkeeping
             self.last_fold = {
                 "time": time.time(),
@@ -622,6 +634,13 @@ class RetrievalEngine:
             version=snap.version, partial=False,
             scanned=corpus.rows * B)
 
+    def attach_reuse_cache(self, cache) -> None:
+        """Register a ReuseCache for corpus-edge invalidation: every
+        ingest/fold that moves resident vectors bumps `corpus_rev` and
+        drops the cache's stale entries (model-publish invalidation
+        rides `Predictor.attach_reuse_cache` separately)."""
+        self._reuse_caches.append(cache)
+
     # ----------------------------------------------------------- accounting
 
     def corpus_rows(self) -> int:
@@ -683,7 +702,8 @@ class RetrievalServer:
 
     def __init__(self, engine: RetrievalEngine, *, max_batch: int = 128,
                  max_wait_ms: float = 1.0,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 reuse_cache_bytes: int = 0):
         self.engine = engine
         self.max_batch = int(max_batch)
         self.max_wait = max_wait_ms / 1000.0
@@ -696,22 +716,50 @@ class RetrievalServer:
             r.register_callback(
                 "deeprec_retrieval_corpus_bytes", engine.corpus_bytes,
                 "resident bytes of the corpus sweep's read set")
+        # Candidate cache (serving/reuse.py, OPT-IN): answers keyed
+        # (user fp + k, (model version, corpus_rev)) — a hit can never
+        # serve across a model publish (version component) NOR an item
+        # ingest/fold (corpus_rev component), which is exactly the
+        # freshness contract `train_to_serve_lag_seconds` is pinned on.
+        self.reuse = None
+        if reuse_cache_bytes > 0:
+            from deeprec_tpu.serving.reuse import ReuseCache
+
+            self.reuse = ReuseCache(
+                reuse_cache_bytes, "retrieve", registry=r,
+                version_fn=lambda: (engine._pred._snap.version,
+                                    engine.corpus_rev))
+            engine.attach_reuse_cache(self.reuse)
+            engine._pred.attach_reuse_cache(self.reuse)
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
-    def submit(self, features: Dict[str, np.ndarray],
-               k: int) -> "queue.Queue":
+    def submit(self, features: Dict[str, np.ndarray], k: int,
+               no_cache: bool = False) -> "queue.Queue":
         reply: "queue.Queue" = queue.Queue(maxsize=1)
         rows = int(np.asarray(next(iter(features.values()))).shape[0])  # noqa: DRT002 — host row count of the incoming request payload
-        self._q.put((features, rows, int(k), reply, time.monotonic()))  # noqa: DRT002 — host k scalar from the request
+        fp = None
+        if self.reuse is not None and not no_cache:
+            from deeprec_tpu.serving import reuse as _reuse
+
+            # k is part of the key: the same user at k=10 and k=100 are
+            # different answers
+            fp = _reuse.request_fingerprint(
+                features, extra=b"k%d" % int(k))
+            hit = self.reuse.get_current(fp)
+            if hit is not None:
+                reply.put(hit[0])
+                return reply
+        self._q.put((features, rows, int(k), reply, time.monotonic(), fp))  # noqa: DRT002 — host k scalar from the request
         return reply
 
     def request_versioned(self, features: Dict[str, np.ndarray], k: int,
-                          timeout: float = 30.0) -> RetrievalResult:
+                          timeout: float = 30.0,
+                          no_cache: bool = False) -> RetrievalResult:
         t0 = time.monotonic()
-        out = self.submit(features, k).get(timeout=timeout)
+        out = self.submit(features, k, no_cache=no_cache).get(timeout=timeout)
         self.stats.record_stage("retrieval", time.monotonic() - t0)
         if isinstance(out, Exception):
             raise out
@@ -742,28 +790,63 @@ class RetrievalServer:
 
     def _serve(self, pending):
         try:
-            reqs = [p[0] for p in pending]
-            sizes = [p[1] for p in pending]
-            kmax = max(p[2] for p in pending)
+            # In-window memoization: identical in-flight requests (the
+            # fingerprint covers features AND k) share one sweep slice.
+            leaders = pending
+            dups: Dict[bytes, List] = {}
+            if self.reuse is not None:
+                seen: Dict[bytes, bool] = {}
+                leaders = []
+                for p in pending:
+                    fp = p[5]
+                    if fp is not None and fp in seen:
+                        dups.setdefault(fp, []).append(p)
+                        continue
+                    if fp is not None:
+                        seen[fp] = True
+                    leaders.append(p)
+            reqs = [p[0] for p in leaders]
+            sizes = [p[1] for p in leaders]
+            kmax = max(p[2] for p in leaders)
             batch = {
                 key: np.concatenate([np.asarray(r[key]) for r in reqs])  # noqa: DRT002 — micro-batch assembly of host request payloads before the one sweep
                 for key in reqs[0]
             }
+            rev0 = (self.reuse.current_version()
+                    if self.reuse is not None else None)
             res = self.engine.retrieve(batch, kmax)
             off = 0
             per_row_scan = (res.scanned // max(sum(sizes), 1))
-            for (_, n, k_i, reply, _), _sz in zip(pending, sizes):
-                reply.put(RetrievalResult(
+            # populate only when the (model version, corpus_rev) pair is
+            # unchanged across the sweep AND matches the answer's stamp —
+            # an ingest or publish racing the sweep makes this answer
+            # unstorable (it still serves THIS request correctly)
+            storable = (rev0 is not None
+                        and rev0 == self.reuse.current_version()
+                        and rev0[0] == res.version)
+            for p, _sz in zip(leaders, sizes):
+                _, n, k_i, reply, _ = p[:5]
+                out = RetrievalResult(
                     ids=res.ids[off:off + n, :k_i],
                     scores=res.scores[off:off + n, :k_i],
                     version=res.version, partial=False,
-                    scanned=per_row_scan * n))
+                    scanned=per_row_scan * n)
+                reply.put(out)
+                if p[5] is not None:
+                    for d in dups.get(p[5], ()):
+                        d[3].put(out)
+                    if storable:
+                        self.reuse.put(p[5], rev0, RetrievalResult(
+                            ids=np.ascontiguousarray(out.ids),
+                            scores=np.ascontiguousarray(out.scores),
+                            version=out.version, partial=False,
+                            scanned=out.scanned))
                 off += n
             self.stats.record_retrieval(len(pending), res.scanned)
         except Exception as e:
             self.stats.record_error(len(pending))
-            for _, _, _, reply, _ in pending:
-                reply.put(e)
+            for p in pending:
+                p[3].put(e)
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,481 @@
+"""Compute-reuse layer (serving/reuse.py + ModelServer/RetrievalServer
+wiring): fingerprint contract, byte-bounded LRU, answer-cache hits that
+are bit-identical to evaluation, in-window memoization, publish-edge
+invalidation (a delta swap never serves a mixed-version answer), the
+user-tower candidate-only lane, the retrieval candidate cache keyed on
+(model version, corpus_rev), and `no_cache` end to end (HTTP body field
+and the PRED wire flag) with fleet-merged /metrics series."""
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu.data import SyntheticCriteo, SyntheticTwoTower
+from deeprec_tpu.models import DSSM, WDL
+from deeprec_tpu.optim import Adagrad
+from deeprec_tpu.serving import (
+    BackendServer,
+    Frontend,
+    HttpServer,
+    ModelServer,
+    Predictor,
+    RetrievalEngine,
+)
+from deeprec_tpu.serving.predictor import parse_features
+from deeprec_tpu.serving.retrieval import (
+    RetrievalServer,
+    fill_missing_item_features,
+)
+from deeprec_tpu.serving.reuse import (
+    ReuseCache,
+    request_fingerprint,
+    value_nbytes,
+)
+from deeprec_tpu.training import Trainer
+from deeprec_tpu.training.checkpoint import CheckpointManager
+
+
+def J(b):
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def strip_labels(b):
+    return {k: np.asarray(v) for k, v in b.items() if not k.startswith("label")}
+
+
+def make_trained(tmp_path, steps=3):
+    model = WDL(emb_dim=8, capacity=1 << 12, hidden=(32, 16), num_cat=4,
+                num_dense=2)
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticCriteo(batch_size=64, num_cat=4, num_dense=2, vocab=2000,
+                          seed=13)
+    for _ in range(steps):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+    return model, tr, st, ck, gen
+
+
+@pytest.fixture(scope="module")
+def wdl_ckpt(tmp_path_factory):
+    """One trained WDL checkpoint shared by the read-only cache tests
+    (each spins its OWN ModelServer; tests that land deltas build their
+    own copy via make_trained)."""
+    tmp = tmp_path_factory.mktemp("reuse-wdl")
+    model, tr, st, ck, gen = make_trained(tmp)
+    req = strip_labels(gen.batch())
+    return model, str(tmp), req
+
+
+def reuse_counts(server, cache="predict"):
+    s = server.stats_snapshot()["reuse"][cache]
+    return s["hits"], s["misses"]
+
+
+# --------------------------------------------------------------- primitives
+
+
+def test_request_fingerprint_contract():
+    """Name-bound, order-independent, value/dtype-sensitive; `names`
+    restricts to a subset; `extra` always separates keys."""
+    a = {"x": np.arange(8, dtype=np.int64), "y": np.ones(4, np.float32)}
+    fp = request_fingerprint(a)
+    assert len(fp) == 16
+    # dict insertion order never moves the digest
+    b = {"y": a["y"].copy(), "x": a["x"].copy()}
+    assert request_fingerprint(b) == fp
+    # renaming a feature always does
+    assert request_fingerprint({"x2": a["x"], "y": a["y"]}) != fp
+    # so do a value flip, a dtype change and a reshape
+    mut = {"x": a["x"].copy(), "y": a["y"].copy()}
+    mut["x"][0] += 1
+    assert request_fingerprint(mut) != fp
+    assert request_fingerprint(
+        {"x": a["x"].astype(np.int32), "y": a["y"]}) != fp
+    assert request_fingerprint(
+        {"x": a["x"].reshape(2, 4), "y": a["y"]}) != fp
+    # subset keying (the user-tower cache) ignores the other features
+    fx = request_fingerprint(a, names=["x"])
+    assert fx == request_fingerprint(
+        {"x": a["x"], "y": 7 * a["y"]}, names=["x"])
+    assert fx != fp
+    # extra folds request params (retrieval folds k; grouped folds lane)
+    assert request_fingerprint(a, extra=b"k10") != fp
+    assert request_fingerprint(a, extra=b"k10") != request_fingerprint(
+        a, extra=b"k100")
+
+
+def test_reuse_cache_byte_lru_eviction_and_version_invalidation():
+    """Byte budget (not entry count) bounds residency: cold-end eviction
+    with counters, oversize values never stored, born-stale puts
+    rejected, and `invalidate_stale` drops exactly the old-version
+    entries."""
+    live = [0]
+    val = np.zeros(32, np.float32)  # 128 bytes
+    c = ReuseCache(capacity_bytes=3 * val.nbytes, name="t",
+                   version_fn=lambda: live[0])
+    assert value_nbytes({"a": val, "b": (val, val)}) == 3 * val.nbytes
+    fps = [b"%016d" % i for i in range(5)]
+    for fp in fps[:3]:
+        assert c.put(fp, 0, val.copy())
+    assert len(c) == 3 and c.occupancy_bytes() == 3 * val.nbytes
+    # touch fp0 so fp1 is now the cold end
+    assert c.get_current(fps[0]) is not None
+    assert c.put(fps[3], 0, val.copy())
+    assert c.evictions == 1 and len(c) == 3
+    assert c.get_current(fps[1]) is None          # evicted (LRU order)
+    assert c.get_current(fps[0]) is not None      # survived the refresh
+    # oversize: never resident, nothing evicted for it
+    assert not c.put(b"big", 0, np.zeros(1024, np.float32))
+    assert c.evictions == 1
+    # born stale: produced at version 0 after the publish bumped to 1
+    live[0] = 1
+    assert not c.put(fps[4], 0, val.copy())
+    # every resident entry carries version 0 -> all invalid now
+    n = len(c)
+    assert c.invalidate_stale() == n
+    assert len(c) == 0 and c.occupancy_bytes() == 0
+    assert c.invalidations == n
+    hits_before = c.hits
+    assert c.get_current(fps[0]) is None
+    assert c.hits == hits_before and c.misses > 0
+
+
+# ----------------------------------------------------- answer cache (lane 0)
+
+
+def test_answer_cache_hit_bit_identity_and_no_cache(wdl_ckpt):
+    """A repeat request is served from cache BIT-identically to its
+    first evaluation; `no_cache=True` forces a full evaluation that is
+    also bit-identical and leaves the cache counters untouched."""
+    model, ckpt, req = wdl_ckpt
+    server = ModelServer(Predictor(model, ckpt), max_batch=64,
+                         max_wait_ms=1.0, reuse_cache_bytes=1 << 20)
+    try:
+        r1, v1 = server.request_versioned(req)
+        h, m = reuse_counts(server)
+        assert (h, m) == (0, 1)
+        r2, v2 = server.request_versioned(req)
+        assert reuse_counts(server) == (1, 1)
+        assert v2 == v1
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+        # no_cache: bypasses the read AND the write — counters frozen
+        r3, v3 = server.request_versioned(req, no_cache=True)
+        assert reuse_counts(server) == (1, 1)
+        assert v3 == v1
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r3))
+        snap = server.stats_snapshot()["reuse"]["predict"]
+        assert snap["entries"] == 1
+        assert 0 < snap["occupancy_bytes"] <= snap["capacity_bytes"]
+    finally:
+        server.close()
+
+
+def test_in_window_memoization_shares_one_dispatch(wdl_ckpt):
+    """Identical in-flight requests coalesced into one micro-batch run
+    the model ONCE: twins get the leader's slice (bit-identical, same
+    version) and are counted as memo_shared; a no_cache twin (fp=None)
+    never shares."""
+    model, ckpt, req = wdl_ckpt
+    pred = Predictor(model, ckpt)
+    server = ModelServer(pred, max_batch=256, max_wait_ms=1.0,
+                         reuse_cache_bytes=1 << 20)
+    try:
+        calls = []
+        orig = pred.predict_versioned
+
+        def counting(batch, **kw):
+            calls.append(1)
+            return orig(batch, **kw)
+
+        pred.predict_versioned = counting
+        fp = request_fingerprint(req)
+        replies = [queue.Queue(maxsize=1) for _ in range(4)]
+        t0 = time.monotonic()
+        pending = [
+            (req, 64, replies[0], t0, 0, None, fp, None, None),
+            (req, 64, replies[1], t0, 0, None, fp, None, None),
+            (req, 64, replies[2], t0, 0, None, fp, None, None),
+            # the no_cache twin: fp=None, must ride the batch itself
+            (req, 64, replies[3], t0, 0, None, None, None, None),
+        ]
+        server._serve(pending)
+        assert len(calls) == 1  # one dispatch for all four
+        assert server.memo_shared == 2
+        outs = [q.get(timeout=5) for q in replies]
+        vers = {v for _, v in outs}
+        assert len(vers) == 1
+        for r, _ in outs[1:]:
+            np.testing.assert_array_equal(np.asarray(outs[0][0]),
+                                          np.asarray(r))
+    finally:
+        pred.predict_versioned = orig
+        server.close()
+
+
+def test_publish_boundary_never_mixes_versions(tmp_path):
+    """Delta publish mid-stream of hits: while the swap is gated the
+    cache keeps serving the OLD version; after the swap every old entry
+    is invalidated, the next request is a miss evaluated at the new
+    version, bit-identical to a cold predictor on the same
+    checkpoint."""
+    model, tr, st, ck, gen = make_trained(tmp_path)
+    req = strip_labels(gen.batch())
+    pred = Predictor(model, str(tmp_path))
+    server = ModelServer(pred, max_batch=64, max_wait_ms=1.0,
+                         reuse_cache_bytes=1 << 20)
+    try:
+        r0, v0 = server.request_versioned(req)
+        _, vh = server.request_versioned(req)
+        assert vh == v0 and reuse_counts(server) == (1, 1)
+
+        in_pre_swap = threading.Event()
+        release = threading.Event()
+
+        def gate():
+            in_pre_swap.set()
+            assert release.wait(10)
+
+        pred._pre_swap = gate
+        for _ in range(2):
+            st2, _ = tr.train_step(st, J(gen.batch()))
+            st = st2
+        ck.save_incremental(st)
+        th = threading.Thread(target=pred.poll_updates)
+        th.start()
+        assert in_pre_swap.wait(30)
+        # publish parked right before the swap: hits still serve v0 —
+        # the cache can be AHEAD of a publish, never across one
+        r_mid, v_mid = server.request_versioned(req)
+        assert v_mid == v0
+        np.testing.assert_array_equal(np.asarray(r0), np.asarray(r_mid))
+        release.set()
+        th.join(timeout=30)
+        pred._pre_swap = None
+
+        snap = server.stats_snapshot()["reuse"]["predict"]
+        assert snap["invalidations"] >= 1 and snap["entries"] == 0
+        h0, m0 = reuse_counts(server)
+        r_new, v_new = server.request_versioned(req)
+        assert v_new == v0 + 1
+        assert reuse_counts(server) == (h0, m0 + 1)
+        assert np.abs(np.asarray(r_new) - np.asarray(r0)).max() > 0
+        # post-swap answer == a cold predictor on the same checkpoint
+        cold = np.asarray(Predictor(model, str(tmp_path)).predict(req))
+        np.testing.assert_array_equal(np.asarray(r_new), cold)
+        # and the repeat is a hit AT the new version, bit-identical
+        r_hit, v_hit = server.request_versioned(req)
+        assert v_hit == v_new
+        np.testing.assert_array_equal(np.asarray(r_new), np.asarray(r_hit))
+    finally:
+        server.close()
+
+
+# ------------------------------------------------- user tower (lanes 1 / 2)
+
+
+def test_user_tower_cache_candidate_only_lane(tmp_path):
+    """Grouped requests populate the user-tower cache as a side effect
+    of their own dispatch; the same user's NEXT candidate set (an
+    answer-cache miss) rides the candidate-only lane off the cached
+    user vector and matches the full no_cache evaluation."""
+    model = DSSM(emb_dim=8, capacity=1 << 12, num_user_feats=2,
+                 num_item_feats=2, hidden=(32, 16))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(2e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(batch_size=128, num_user=2, num_item=2,
+                            vocab=500, seed=31)
+    for _ in range(3):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    CheckpointManager(str(tmp_path), tr).save(st)
+    pred = Predictor(model, str(tmp_path))
+    base = strip_labels(gen.batch())
+
+    def user_req(u, lo, n_items=8):
+        out = {}
+        for k, v in base.items():
+            rows = v[lo:lo + n_items].copy()
+            if k in model.user_feats:
+                rows = np.repeat(v[u:u + 1], n_items, axis=0)
+            out[k] = rows
+        return out
+
+    req_a, req_b = user_req(0, 0), user_req(0, 8)  # same user, new items
+    server = ModelServer(pred, max_batch=64, max_wait_ms=1.0,
+                         reuse_cache_bytes=1 << 20)
+    try:
+        assert server.user_reuse is not None  # DSSM has the tower split
+        _, va = server.request_versioned(req_a, group_users=True)
+        uh0, um0 = reuse_counts(server, "user_tower")
+        assert len(server.user_reuse) == 1  # populated by the dispatch
+        rb, vb = server.request_versioned(req_b, group_users=True)
+        uh1, um1 = reuse_counts(server, "user_tower")
+        assert (uh1 - uh0, um1 - um0) == (1, 0)  # rode lane 2
+        assert vb == va
+        rb_full, vf = server.request_versioned(req_b, group_users=True,
+                                               no_cache=True)
+        assert vf == vb
+        np.testing.assert_allclose(np.asarray(rb), np.asarray(rb_full),
+                                   rtol=1e-6, atol=1e-6)
+        # a different user's fingerprint misses the user cache (lane 1)
+        server.request_versioned(user_req(1, 16), group_users=True)
+        uh2, um2 = reuse_counts(server, "user_tower")
+        assert um2 == um1 + 1 and len(server.user_reuse) == 2
+    finally:
+        server.close()
+
+
+# -------------------------------------------------------- retrieval lane
+
+
+def test_retrieval_candidate_cache_versioning_and_k_key(tmp_path):
+    """Candidate-cache hits are byte-identical and keyed on k; an item
+    ingest (corpus_rev bump) AND a model publish each invalidate; a
+    `no_cache` probe never reads or writes."""
+    model = DSSM(emb_dim=8, capacity=1 << 12, num_user_feats=2,
+                 num_item_feats=2, hidden=(16, 8))
+    tr = Trainer(model, Adagrad(lr=0.1), optax.adam(1e-3))
+    st = tr.init(0)
+    gen = SyntheticTwoTower(batch_size=64, num_user=2, num_item=2,
+                            vocab=200, seed=3)
+    for _ in range(3):
+        st, _ = tr.train_step(st, J(gen.batch()))
+    ck = CheckpointManager(str(tmp_path), tr)
+    st, _ = ck.save(st)
+    pred = Predictor(model, str(tmp_path))
+    eng = RetrievalEngine(pred, quantize="fp32", block_rows=256, chunk=128)
+    rng = np.random.default_rng(0)
+    ids = np.arange(1, 257, dtype=np.int64)
+    feats = {"V0": 200 + rng.integers(0, 200, size=256),
+             "V1": 400 + rng.integers(0, 200, size=256)}
+    eng.upsert_items(ids, feats)
+    b = gen.batch()
+    user = {k: np.asarray(v)[:4] for k, v in b.items() if k.startswith("U")}
+    batch = parse_features(pred, fill_missing_item_features(pred, user))
+    rs = RetrievalServer(eng, max_wait_ms=1.0, reuse_cache_bytes=1 << 20)
+    try:
+        r1 = rs.request_versioned(batch, 10)
+        assert (rs.reuse.hits, rs.reuse.misses) == (0, 1)
+        r2 = rs.request_versioned(batch, 10)
+        assert (rs.reuse.hits, rs.reuse.misses) == (1, 1)
+        np.testing.assert_array_equal(r1.ids, r2.ids)
+        np.testing.assert_array_equal(r1.scores, r2.scores)
+        # k is part of the key: same user at k=5 is a different answer
+        r5 = rs.request_versioned(batch, 5)
+        assert rs.reuse.misses == 2 and r5.ids.shape[1] == 5
+        np.testing.assert_array_equal(r5.ids, r1.ids[:, :5])
+        # no_cache: full sweep, counters frozen, same answer
+        h, m = rs.reuse.hits, rs.reuse.misses
+        r_nc = rs.request_versioned(batch, 10, no_cache=True)
+        assert (rs.reuse.hits, rs.reuse.misses) == (h, m)
+        np.testing.assert_array_equal(r1.ids, r_nc.ids)
+        # ingest invalidates: corpus_rev is half the version key
+        rev0 = eng.corpus_rev
+        eng.upsert_items(np.array([999], np.int64),
+                         {"V0": np.array([250]), "V1": np.array([450])})
+        assert eng.corpus_rev == rev0 + 1
+        assert rs.reuse.invalidations >= 1 and len(rs.reuse) == 0
+        rs.request_versioned(batch, 10)
+        assert rs.reuse.misses == m + 1
+        # model publish invalidates too (model version is the other half)
+        for _ in range(2):
+            st, _ = tr.train_step(st, J(gen.batch()))
+        ck.save_incremental(st)
+        assert pred.poll_updates() is True
+        assert len(rs.reuse) == 0
+        r_new = rs.request_versioned(batch, 10)
+        assert r_new.version == r1.version + 1
+    finally:
+        rs.close()
+
+
+# ------------------------------------------------- edges: HTTP, wire, fleet
+
+
+def test_http_no_cache_body_field_and_metrics_render(wdl_ckpt):
+    """`no_cache` as an HTTP body field bypasses a warm cache; /metrics
+    renders the reuse counter/gauge family under the bounded `cache`
+    label."""
+    model, ckpt, req = wdl_ckpt
+    server = ModelServer(Predictor(model, ckpt), max_batch=64,
+                         max_wait_ms=1.0, reuse_cache_bytes=1 << 20)
+    http = HttpServer(server, port=0).start()
+    try:
+        def post(extra):
+            body = json.dumps(dict(
+                {"features": {k: v.tolist() for k, v in req.items()}},
+                **extra)).encode()
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{http.port}/v1/predict", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST"), timeout=30)
+            return json.loads(r.read())["predictions"]
+
+        p1 = post({})
+        p2 = post({})  # hit
+        assert reuse_counts(server) == (1, 1)
+        p3 = post({"no_cache": True})
+        assert reuse_counts(server) == (1, 1)
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p3))
+
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/metrics", timeout=10
+        ).read().decode()
+        for series in ("deeprec_reuse_hits_total", "deeprec_reuse_misses_total",
+                       "deeprec_reuse_invalidations_total",
+                       "deeprec_reuse_occupancy_bytes",
+                       "deeprec_reuse_capacity_bytes",
+                       "deeprec_reuse_entries"):
+            assert series in txt, series
+        assert 'cache="predict"' in txt
+    finally:
+        http.stop()
+        server.close()
+
+
+def test_fleet_wire_no_cache_flag_and_merged_metrics(wdl_ckpt):
+    """Through the socket tier: repeats hit each backend's cache, the
+    PRED wire flag carries no_cache (counters frozen, same answer), and
+    the frontend's merged /metrics re-exports every member's reuse
+    series."""
+    model, ckpt, req = wdl_ckpt
+    backends = [
+        BackendServer(ModelServer(Predictor(model, ckpt), max_batch=64,
+                                  max_wait_ms=1.0,
+                                  reuse_cache_bytes=1 << 20)).start()
+        for _ in range(2)
+    ]
+    fe = Frontend([("127.0.0.1", b.port) for b in backends], model)
+    try:
+        outs = [fe.request_versioned(req) for _ in range(4)]
+        vers = {v for _, v in outs}
+        assert len(vers) == 1
+        for r, _ in outs[1:]:
+            np.testing.assert_array_equal(np.asarray(outs[0][0]),
+                                          np.asarray(r))
+        def totals():
+            hs, ms = zip(*(reuse_counts(b.server) for b in backends))
+            return sum(hs), sum(ms)
+
+        h0, m0 = totals()
+        assert h0 >= 1  # round-robin repeats landed on a warm member
+        r_nc, _ = fe.request_versioned(req, no_cache=True)
+        h1, m1 = totals()
+        assert (h1, m1) == (h0, m0)  # the wire flag reached the backend
+        np.testing.assert_array_equal(np.asarray(outs[0][0]),
+                                      np.asarray(r_nc))
+        txt = fe.metrics_text()
+        assert "deeprec_reuse_hits_total" in txt
+        assert 'cache="predict"' in txt and 'member="' in txt
+    finally:
+        fe.close()
+        for b in backends:
+            b.stop()

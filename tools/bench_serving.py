@@ -37,6 +37,14 @@ The extra grids:
   * `--grouped` — the DSSM two-tower arm: `<user, N items>` requests
     with and without `group_users` (sample-aware user-tower reuse);
     headline metric is candidates/sec.
+  * `--compute-reuse` — the frontend compute-reuse arm (serving/reuse.py):
+    a persistent zipf(`--user-zipf`) population of `--users` distinct
+    request payloads driven closed-loop against the same server with the
+    version-keyed answer cache OFF then ON (`--reuse-mb`). Records hit
+    rates, effective qps per arm (`roofline.py --assert-reuse` gates the
+    ≥2× factor), a mid-load delta publish (hit-rate dip + recovery with
+    zero failed requests), the cache-on/off/no_cache bit-identity probe,
+    and the steady-window compile count under a trace guard.
 
 `--smoke` runs a tiny pass over every grid (CI: group dispatch, a
 2-process socket tier + int8 + grouped arms, one delta update mid-load,
@@ -529,6 +537,294 @@ def grouped_arms(args, results):
         return section
 
 
+def user_payload(req, u, rows):
+    """One user's persistent request features: a `rows`-slice of the
+    example batch with a per-user perturbation on the float (dense)
+    columns and a per-user roll of the integer (categorical) ones —
+    every user owns a DISTINCT fingerprint (the reuse-cache key) while
+    every payload keeps the SAME shape, so the whole population shares
+    one compile bucket."""
+    feats = {}
+    for k, v in req.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            feats[k] = a[:rows] + a.dtype.type(u) * a.dtype.type(1e-3)
+        else:
+            feats[k] = np.roll(a, u, axis=0)[:rows]
+    return feats
+
+
+def make_user_pool(req, users, rows):
+    """The zipf population: one JSON body per user, rank == user id
+    (rank 0 is the hottest user under the zipf sampler)."""
+    return [json.dumps({"features": {
+        k: v.tolist() for k, v in user_payload(req, u, rows).items()
+    }}).encode() for u in range(users)]
+
+
+def drive_sampled(port, pool, probs, seconds, clients, seed=0,
+                  until_event=None):
+    """Closed-loop clients that SAMPLE a payload from `pool` per request
+    with probabilities `probs` (the zipf draw) instead of pinning one
+    body per client — the reuse arms need the request stream itself to
+    carry the popularity skew. Same contract as drive(): any failure
+    aborts loudly, returns [(t_start, latency_s)] sorted by start."""
+    recs = []
+    errors = []
+    lock = threading.Lock()
+    stop = time.monotonic() + seconds
+
+    def keep_going():
+        if errors:
+            return False
+        if time.monotonic() < stop:
+            return True
+        return until_event is not None and not until_event.is_set()
+
+    def worker(i):
+        rng = np.random.default_rng(seed + i)
+        mine = []
+        try:
+            while keep_going():
+                body = pool[int(rng.choice(len(pool), p=probs))]
+                t0 = time.monotonic()
+                r = urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}/v1/predict", data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    ),
+                    timeout=60,
+                )
+                r.read()
+                mine.append((t0, time.monotonic() - t0))
+        except Exception as e:
+            with lock:
+                errors.append(e)
+        finally:
+            with lock:
+                recs.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"{len(errors)} client(s) failed") from errors[0]
+    if not recs:
+        raise RuntimeError("no requests completed within the window")
+    return sorted(recs)
+
+
+def _reuse_counts(server):
+    s = server.stats_snapshot()["reuse"]["predict"]
+    return int(s["hits"]), int(s["misses"])
+
+
+def _hit_rate(after, before):
+    dh, dm = after[0] - before[0], after[1] - before[1]
+    return round(dh / max(dh + dm, 1), 4)
+
+
+def compute_reuse_arms(args, results):
+    """The frontend compute-reuse arms (JSON 'compute_reuse', gated by
+    roofline.py --assert-reuse): a persistent zipf(--user-zipf)
+    population of --users distinct payloads driven closed-loop over HTTP
+    against the SAME model/protocol with the version-keyed answer cache
+    off, then on. The cache-on arm additionally:
+
+      * measures its steady window under a trace guard (a cache hit must
+        never trace — steady compiles are the DRT001 contract, 0);
+      * lands a delta publish MID-LOAD and snapshots the hit rate before
+        the swap, in the window right after (the invalidation dip — a
+        version swap drops every old-version entry, never serves one),
+        and over the remainder (recovery), with zero failed requests;
+      * probes bit-identity: a cold miss, the hit that follows, and a
+        forced `no_cache` re-eval must return byte-identical scores at
+        one version — the cache is a pure memo, never an approximation.
+
+    The arms serve the production-width SCALE_ARGS tower, not the PR 5
+    toy: compute reuse is the regime where tower compute dominates the
+    HTTP/parse constant both arms share (same rationale as the
+    socket-tier grid) — with the toy model the python client stack caps
+    both arms and the factor measures urllib, not reuse. The modeled
+    speedup (ops/traffic.py serving_reuse_speedup) is recorded twice:
+    the zero-hit-cost ceiling, and the factor at the MEASURED hit cost
+    (cache-on p50 over cache-off p50) — the latter must track the
+    measured factor or the model drifted."""
+    import shutil
+    import tempfile as _tempfile
+
+    from deeprec_tpu.analysis.trace_guard import trace_guard
+    from deeprec_tpu.ops.traffic import (
+        serving_reuse_speedup, zipf_expected_hit_rate,
+    )
+    from deeprec_tpu.serving import HttpServer, ModelServer, Predictor
+
+    users, alpha, rows = args.users, args.user_zipf, args.rows
+    cap = int(args.reuse_mb * (1 << 20))
+    reuse_dir = _tempfile.mkdtemp(prefix="deeprec-reuse-")
+    model, req, save_next = build(reuse_dir, margs=SCALE_ARGS)
+    pool = make_user_pool(req, users, rows)
+    ranks = np.arange(1, users + 1, dtype=np.float64) ** -float(alpha)
+    probs = ranks / ranks.sum()
+    section = {
+        "users": users,
+        "zipf_alpha": alpha,
+        "rows_per_request": rows,
+        "capacity_bytes": cap,
+        "arms": {},
+    }
+
+    def sweep(port):
+        # touch EVERY user once so the population is fully resident
+        # before any measured window — the dip/recovery contrast must
+        # come from the version swap, not from cold tail users
+        for body in pool:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/predict", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST"),
+                timeout=60).read()
+
+    for arm, cache_bytes in (("cache_off", 0), ("cache_on", cap)):
+        pred = Predictor(model, reuse_dir)
+        # max_batch bounds the warmup bucket ladder: the measured
+        # concurrency coalesces at most clients*rows rows, and each
+        # extra bucket is one more XLA compile of the wide tower
+        mb = 8
+        while mb < min(256, args.clients * rows):
+            mb <<= 1
+        server = ModelServer(pred, max_batch=mb, max_wait_ms=1.0,
+                             reuse_cache_bytes=cache_bytes)
+        server.warmup({k: np.asarray(v)[:rows] for k, v in req.items()})
+        http = HttpServer(server, port=0).start()
+        try:
+            # prime the delta-replay programs before any guarded window
+            # (same discipline as the quantized arm): the publish phase
+            # below must measure invalidation, not first-replay compiles
+            save_next("delta")
+            pred.poll_updates()
+            sweep(http.port)
+            drive_sampled(http.port, pool, probs, 0.4, args.clients)
+            server.stats.reset()
+            c0 = _reuse_counts(server) if cache_bytes else None
+            with trace_guard(max_compiles=None) as g:
+                recs = drive_sampled(http.port, pool, probs, args.seconds,
+                                     args.clients)
+            out = summarize(f"reuse-{arm}", recs, args.seconds,
+                            args.clients, rows, server=server)
+            out["steady_compiles"] = g.compiles
+            arm_rec = {
+                "rps": out["rps"],
+                "p50_ms": out["p50_ms"],
+                "p99_ms": out["p99_ms"],
+                "steady_compiles": g.compiles,
+            }
+            if cache_bytes:
+                snap = server.stats_snapshot()["reuse"]
+                arm_rec["hit_rate"] = _hit_rate(_reuse_counts(server), c0)
+                arm_rec["memo_shared"] = snap["memo_shared"]
+                arm_rec["occupancy_bytes"] = snap["predict"][
+                    "occupancy_bytes"]
+                arm_rec["entries"] = snap["predict"]["entries"]
+                out["reuse"] = snap["predict"]
+                section["hit_rate"] = arm_rec["hit_rate"]
+                section["steady_compiles"] = g.compiles
+                section["occupancy_within_capacity"] = (
+                    snap["predict"]["occupancy_bytes"] <= cap)
+
+                # ---- mid-load delta publish: dip + recovery ----------
+                window = {}
+                done = threading.Event()
+
+                def updater():
+                    try:
+                        time.sleep(args.seconds / 3)
+                        window["pre"] = _reuse_counts(server)
+                        step = save_next("delta")
+                        changed = pred.poll_updates()
+                        window["pub"] = _reuse_counts(server)
+                        window["changed"] = changed
+                        window["new_step"] = step
+                        time.sleep(max(0.25, args.seconds / 6))
+                        window["dip"] = _reuse_counts(server)
+                    except Exception as e:
+                        window["error"] = e
+                    finally:
+                        done.set()
+
+                p0 = _reuse_counts(server)
+                th = threading.Thread(target=updater)
+                th.start()
+                drive_sampled(http.port, pool, probs, args.seconds,
+                              args.clients, seed=101, until_event=done)
+                th.join()
+                if "error" in window:
+                    raise RuntimeError("reuse publish phase failed") \
+                        from window["error"]
+                # a dedicated recovery window: the updater's train+save
+                # can eat the tail of the mid-load drive, so the
+                # post-dip rate gets its own guaranteed request stream
+                drive_sampled(http.port, pool, probs,
+                              max(0.4, args.seconds / 3), args.clients,
+                              seed=202)
+                p1 = _reuse_counts(server)
+                inval = server.stats_snapshot()["reuse"]["predict"][
+                    "invalidations"]
+                section["publish"] = {
+                    "pre_hit_rate": _hit_rate(window["pre"], p0),
+                    "dip_hit_rate": _hit_rate(window["dip"],
+                                              window["pub"]),
+                    "recovered_hit_rate": _hit_rate(p1, window["dip"]),
+                    "invalidations": inval,
+                    "version_advanced": bool(window["changed"]),
+                }
+
+                # ---- bit-identity probe: miss, hit, forced re-eval ---
+                probe = user_payload(req, users + 7, rows)
+                r1, v1 = server.request_versioned(probe)
+                r2, v2 = server.request_versioned(probe)
+                r3, v3 = server.request_versioned(probe, no_cache=True)
+                section["bit_identical"] = bool(
+                    v1 == v2 == v3
+                    and np.array_equal(np.asarray(r1), np.asarray(r2))
+                    and np.array_equal(np.asarray(r1), np.asarray(r3)))
+            section["arms"][arm] = arm_rec
+            results.append(out)
+            print(json.dumps(out), flush=True)
+        finally:
+            http.stop()
+            server.close()
+
+    shutil.rmtree(reuse_dir, ignore_errors=True)
+    off, on = section["arms"]["cache_off"], section["arms"]["cache_on"]
+    section["effective_qps_factor"] = round(
+        on["rps"] / max(off["rps"], 1e-9), 2)
+    hr = section.get("hit_rate", 0.0)
+    # hit cost relative to a full eval, as the client saw it: the
+    # cache-on arm's p50 is ~all hits, the off arm's all real evals
+    c = min(on["p50_ms"] / max(off["p50_ms"], 1e-9), 0.999)
+    section["modeled"] = {
+        "zipf_hit_rate": round(zipf_expected_hit_rate(
+            users=users, alpha=alpha, resident=users), 4),
+        "speedup_ceiling": round(
+            serving_reuse_speedup(hit_rate=min(hr, 0.999)), 2),
+        "speedup_at_measured_hit_cost": round(serving_reuse_speedup(
+            hit_rate=min(hr, 0.999), hit_cost_ratio=c), 2),
+        "hit_cost_ratio": round(c, 4),
+    }
+    # drive()/drive_sampled() abort the whole bench on ANY failed
+    # request, so a completed section IS the zero-failures assertion
+    section["zero_failed_requests"] = True
+    print(json.dumps({"config": "compute-reuse", **{
+        k: v for k, v in section.items() if k != "arms"}}), flush=True)
+    return section
+
+
 def obs_overhead_section(args, tmp, model, req, payloads):
     """Telemetry-plane cost on the serving path (JSON 'obs_overhead',
     gated by roofline.py --assert-obs): one single-process server driven
@@ -628,6 +924,17 @@ def main():
                     help="run the DSSM two-tower grouped/ungrouped arm")
     ap.add_argument("--grouped-rows", type=int, default=128,
                     help="candidate items per <user, N items> request")
+    ap.add_argument("--compute-reuse", action="store_true",
+                    help="run the zipf compute-reuse arms (answer cache "
+                         "off vs on; serving/reuse.py)")
+    ap.add_argument("--user-zipf", type=float, default=1.1,
+                    help="zipf exponent of the persistent user "
+                         "population driving the reuse arms")
+    ap.add_argument("--users", type=int, default=64,
+                    help="distinct users (distinct request fingerprints) "
+                         "in the zipf population")
+    ap.add_argument("--reuse-mb", type=float, default=64.0,
+                    help="answer-cache budget (MiB) for the cache-on arm")
     ap.add_argument("--out", default=None,
                     help="also write the result list to this JSON file")
     ap.add_argument("--smoke", action="store_true",
@@ -643,6 +950,9 @@ def main():
         # compressed-vs-plain ratio is the contract the serving gate
         # pins, and it only exists where the user tower dominates
         args.grouped, args.grouped_rows = True, 128
+        # reuse arm: a smaller population keeps the full-coverage sweep
+        # cheap while the zipf head still dominates the stream
+        args.compute_reuse, args.users = True, 32
     groups = [int(g) for g in args.groups.split(",") if g]
 
     from deeprec_tpu.serving import (
@@ -710,6 +1020,8 @@ def main():
                 args, tmp, model, req, payloads, save_next, results)
         if args.grouped:
             sections["grouped"] = grouped_arms(args, results)
+        if args.compute_reuse:
+            sections["compute_reuse"] = compute_reuse_arms(args, results)
         sections["obs_overhead"] = obs_overhead_section(
             args, tmp, model, req, payloads)
 
@@ -761,6 +1073,14 @@ def check_smoke_sections(sections):
     assert "serving_compiles" in qa["int8"], qa
     gr = sections["grouped"]
     assert gr.get("grouped_cps") and gr.get("ungrouped_cps"), gr
+    cr = sections["compute_reuse"]
+    assert cr["arms"]["cache_off"]["rps"] and \
+        cr["arms"]["cache_on"]["rps"], cr
+    assert cr["bit_identical"] is True, cr
+    assert cr["publish"]["invalidations"] >= 1, cr
+    assert cr["publish"]["version_advanced"], cr
+    assert "effective_qps_factor" in cr and "hit_rate" in cr, cr
+    assert cr["zero_failed_requests"] is True, cr
     ob = sections["obs_overhead"]
     assert ob["arms"]["on"]["rps"] and ob["arms"]["off"]["rps"], ob
     me = ob["metrics_endpoint"]

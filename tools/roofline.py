@@ -724,6 +724,121 @@ def assert_retrieval(json_path: str, recall_floor: float,
     return rc
 
 
+def assert_reuse(json_path: str, qps_factor: float,
+                 hit_floor: float) -> int:
+    """CI gate for the frontend compute-reuse layer (tools/bench_serving.py
+    --compute-reuse JSON, serving/reuse.py):
+
+      * effective qps — the zipf arm with the version-keyed answer cache
+        ON must reach `qps_factor`× the cache-off arm's measured qps on
+        the SAME request stream (the ROADMAP's ≥2× headline; the
+        ops/traffic.py serving_reuse_speedup model is the recorded
+        zero-hit-cost ceiling).
+      * hit rate — the steady window must hold `hit_floor` (the zipf
+        head is resident; below this the population/capacity drifted and
+        the qps factor is measuring noise).
+      * correctness — the miss/hit/`no_cache` probe must be
+        byte-identical at one version (the cache is a pure memo), and a
+        steady-window cache hit must compile ZERO XLA programs.
+      * version boundary — the mid-load delta publish must show the
+        invalidation dip (dip < pre) AND recovery (recovered > dip) with
+        ≥1 invalidation and the version advanced: entries die exactly at
+        the swap, never by sweep, and never serve across it.
+      * memory — recorded occupancy must sit within the byte capacity.
+    """
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    cr = rec.get("compute_reuse")
+    if not cr:
+        print(f"roofline: {json_path} has no 'compute_reuse' record "
+              "(run bench_serving with --compute-reuse)", file=sys.stderr)
+        return 1
+    rc = 0
+    arms = cr.get("arms", {})
+    if "cache_on" not in arms or "cache_off" not in arms:
+        print("roofline: compute_reuse needs cache_on and cache_off arms, "
+              f"got {sorted(arms)}", file=sys.stderr)
+        return 1
+    factor = cr.get("effective_qps_factor")
+    if factor is None or factor < qps_factor:
+        print(
+            f"roofline: reuse gate FAILED — effective qps factor {factor} "
+            f"under the {qps_factor:.1f}× floor (cache on "
+            f"{arms['cache_on'].get('rps')} vs off "
+            f"{arms['cache_off'].get('rps')} rps at hit rate "
+            f"{cr.get('hit_rate')}; modeled ceiling "
+            f"{cr.get('modeled', {}).get('speedup_ceiling_at_hit_rate')})",
+            file=sys.stderr,
+        )
+        rc = 1
+    hr = cr.get("hit_rate")
+    if hr is None or hr < hit_floor:
+        print(
+            f"roofline: reuse gate FAILED — steady hit rate {hr} under "
+            f"the {hit_floor:.2f} floor (zipf α={cr.get('zipf_alpha')}, "
+            f"{cr.get('users')} users): the resident head no longer "
+            f"covers the stream", file=sys.stderr,
+        )
+        rc = 1
+    if cr.get("bit_identical") is not True:
+        print(
+            "roofline: reuse gate FAILED — miss/hit/no_cache probe was "
+            "not byte-identical: the cache is serving answers a fresh "
+            "eval would not produce", file=sys.stderr,
+        )
+        rc = 1
+    if cr.get("steady_compiles", -1) != 0:
+        print(
+            f"roofline: reuse gate FAILED — {cr.get('steady_compiles')} "
+            "XLA compile(s) inside the guarded cache-on steady window "
+            "(a cache hit must never trace; must be 0)", file=sys.stderr,
+        )
+        rc = 1
+    pub = cr.get("publish") or {}
+    pre, dip, recov = (pub.get("pre_hit_rate"), pub.get("dip_hit_rate"),
+                       pub.get("recovered_hit_rate"))
+    if pre is None or dip is None or recov is None or \
+            not (dip < pre and recov > dip):
+        print(
+            f"roofline: reuse gate FAILED — publish window did not show "
+            f"the invalidation dip + recovery (pre {pre} → dip {dip} → "
+            f"recovered {recov}): the version swap is not the "
+            f"invalidation edge", file=sys.stderr,
+        )
+        rc = 1
+    if pub.get("invalidations", 0) < 1 or not pub.get("version_advanced"):
+        print(
+            f"roofline: reuse gate FAILED — the mid-load delta publish "
+            f"invalidated {pub.get('invalidations')} entries with "
+            f"version_advanced={pub.get('version_advanced')} (the swap "
+            f"must drop every old-version entry)", file=sys.stderr,
+        )
+        rc = 1
+    if not cr.get("occupancy_within_capacity"):
+        print(
+            f"roofline: reuse gate FAILED — cache occupancy "
+            f"{arms.get('cache_on', {}).get('occupancy_bytes')}B exceeds "
+            f"the {cr.get('capacity_bytes')}B budget (the byte bound is "
+            f"the memory contract)", file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: reuse gate ok — {factor:.2f}× effective qps "
+            f"(floor {qps_factor:.1f}×; on {arms['cache_on'].get('rps')} "
+            f"vs off {arms['cache_off'].get('rps')} rps), hit rate "
+            f"{hr:.3f} (floor {hit_floor:.2f}), bit-identical probe, "
+            f"0 steady compiles, publish dip {pre:.3f}→{dip:.3f}→"
+            f"{recov:.3f} with {pub.get('invalidations')} "
+            f"invalidation(s), occupancy "
+            f"{arms['cache_on'].get('occupancy_bytes')}B ≤ "
+            f"{cr.get('capacity_bytes')}B"
+        )
+    return rc
+
+
 def assert_obs(json_path: str, tol: float) -> int:
     """CI gate for the telemetry plane (bench.py / tools/bench_serving.py
     'obs_overhead' section): both arms (instrumented vs DEEPREC_OBS=off)
@@ -963,6 +1078,23 @@ def main(argv=None):
                    default=2.0,
                    help="bound on ingest->retrievable as a multiple of "
                         "the pinned train_to_serve lag (default 2.0)")
+    p.add_argument("--assert-reuse", metavar="SERVING_JSON", default=None,
+                   help="don't run the step: validate the frontend "
+                        "compute-reuse record written by "
+                        "tools/bench_serving.py --compute-reuse "
+                        "(cache-on effective qps ≥ --reuse-qps-factor × "
+                        "cache-off on the zipf stream, steady hit rate ≥ "
+                        "--reuse-hit-floor, miss/hit/no_cache probe "
+                        "byte-identical, zero steady compiles, mid-load "
+                        "publish dip + recovery with ≥1 invalidation, "
+                        "occupancy within the byte budget; CI smoke gate)")
+    p.add_argument("--reuse-qps-factor", type=float, default=2.0,
+                   help="required cache-on/cache-off effective-qps factor "
+                        "on the zipf arm (default 2.0 — the ROADMAP "
+                        "headline)")
+    p.add_argument("--reuse-hit-floor", type=float, default=0.5,
+                   help="required steady-window answer-cache hit rate "
+                        "(default 0.5 — the zipf head must be resident)")
     p.add_argument("--assert-obs", metavar="BENCH_JSON", default=None,
                    help="don't run the step: validate the telemetry-plane "
                         "cost recorded in a bench.py or bench_serving.py "
@@ -1017,6 +1149,9 @@ def main(argv=None):
                                   args.retrieval_recall_floor,
                                   args.retrieval_sweep_factor,
                                   args.retrieval_freshness_factor))
+    if args.assert_reuse:
+        sys.exit(assert_reuse(args.assert_reuse, args.reuse_qps_factor,
+                              args.reuse_hit_floor))
     if args.assert_obs:
         sys.exit(assert_obs(args.assert_obs, args.obs_tol))
     if args.assert_guard:
