@@ -39,6 +39,12 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_dedup.py --smoke
 echo "== traffic-diet microbench (CPU smoke: diet + legacy-apply arms) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --traffic --smoke
 
+echo "== fused sparse step (CPU smoke: interpret-mode parity + modeled HBM diet gate) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --fused-step \
+    --smoke --dim 128 --out /tmp/deeprec_fused_smoke.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-fused /tmp/deeprec_fused_smoke.json
+
 echo "== checkpoint choreography microbench (CPU smoke: sync + async paths) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
 

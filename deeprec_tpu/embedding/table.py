@@ -289,6 +289,46 @@ class EmbeddingTable:
             self.cfg.kernel == "auto" and AUTO_TRUSTS_BF16_PAIR
         )
 
+    @property
+    def fused_step(self) -> bool:
+        """Single-pass fused step kernels (fused_sparse_forward /
+        fused_sparse_backward: dedup-probe + gather + combine forward,
+        segment-sum + optimizer + scatter backward): on for explicit
+        kernel="pallas"; "auto" keeps the split-phase path until a
+        hardware bench crowns them (AUTO_TRUSTS_FUSED_STEP — the same
+        measured-winners policy as the pair kernels)."""
+        from deeprec_tpu.ops.fused_lookup import AUTO_TRUSTS_FUSED_STEP
+
+        return self.cfg.kernel == "pallas" or (
+            self.cfg.kernel == "auto" and AUTO_TRUSTS_FUSED_STEP
+        )
+
+    def bag_forward(self, state: TableState, row_ix: jnp.ndarray, *,
+                    combiner: str = "mean", unique_size: int,
+                    interpret: bool = False):
+        """Single-pass bag lookup over RESOLVED slot indices [B, L]
+        (< 0 = pad): hash-probe dedup + unique-row gather + segment
+        combine in one fused op (ops/fused_lookup.fused_sparse_forward),
+        dispatched through the same kernel= gate as the row kernels.
+        Returns a FusedBags; pair it with optim.apply.apply_bag_gradients
+        for the fused backward. Packed small-dim layouts keep the
+        split-phase lookup — the fused kernels address whole logical
+        rows."""
+        from deeprec_tpu.ops import fused_lookup as fl
+        from deeprec_tpu.ops.packed import is_unpacked
+
+        if not is_unpacked(state.values, state.capacity):
+            raise NotImplementedError(
+                "bag_forward: packed small-dim layouts keep the "
+                "split-phase lookup (the fused step kernels address "
+                "whole logical rows)"
+            )
+        return fl.fused_sparse_forward(
+            state.values, row_ix, combiner=combiner,
+            unique_size=unique_size, interpret=interpret,
+            use_pallas=self.fused_step,
+        )
+
     def pack_width(self, width: int, capacity: Optional[int] = None) -> int:
         """Pack factor for a [C, width] per-row array under this table's
         layout policy. cfg.packed="auto" packs only where the layout can

@@ -76,6 +76,16 @@ def log_full_fallback(name: str, n: int) -> None:
     if name in _logged_full_fallback:
         return
     _logged_full_fallback.add(name)
+    try:  # same counter family as the Pallas dispatch rejections
+        from deeprec_tpu.obs.metrics import default_registry
+
+        default_registry().counter(
+            "deeprec_pallas_fallback",
+            help="Pallas kernel dispatches that fell back to XLA, by cause",
+            labels={"kernel": "dedup", "reason": "no_budget"},
+        ).inc()
+    except Exception:  # obs must never break the lookup path
+        pass
     logger.info(
         "table %s: no unique budget resolved — dedup falls back to U=N=%d "
         "(sort-based, every downstream op at batch size). Set "
@@ -143,6 +153,10 @@ def hash_dedup(
         pending = pending & ~hit
         # Claim race on empty scratch slots: scatter all claimants, the
         # re-gather reveals the one winner; losers advance a probe offset.
+        # (The fused step kernel — ops/fused_lookup.fused_sparse_forward —
+        # replaces this whole O(N)-lane scatter round with a sequential
+        # in-VMEM slot write per id, so the ~50x-a-gather cost below never
+        # appears on the fused path.)
         want = pending & (k == sent)
         claim_pos = jnp.where(want, pos, S)  # S = out of bounds -> dropped
         scratch = scratch.at[claim_pos].set(flat, mode="drop")

@@ -34,6 +34,8 @@ where the in-kernel SR branches are also covered).
 from __future__ import annotations
 
 
+from typing import Dict, NamedTuple, Tuple
+
 import jax
 import jax.numpy as jnp
 
@@ -81,6 +83,59 @@ def _dma_pair_ok(shape, dtype) -> bool:
 # are consulted by EmbeddingTable.use_pallas / .pair_kernels.
 AUTO_TRUSTS_F32_ROW = True     # measured round 2: +37% gather, +54% scatter
 AUTO_TRUSTS_BF16_PAIR = False  # pending hardware window
+AUTO_TRUSTS_FUSED_STEP = False  # single-pass step kernels: pending hardware
+
+
+# ------------------------------------------------ fallback observability
+#
+# Every dispatch predicate above can silently reject a kernel="pallas"
+# request and take the XLA path — correct, but invisible: a table that
+# was supposed to ride the DMA kernels can spend its life on the
+# fallback because of one misaligned dim. Mirror dedup.log_full_fallback:
+# note each distinct rejection exactly once per (kernel, reason, shape,
+# dtype) on the obs registry, where /metrics renders it as
+# deeprec_pallas_fallback_total{kernel,reason}.
+
+_fallback_noted: set = set()
+
+
+def _note_fallback(kernel: str, reason: str, shape, dtype) -> None:
+    """Count a Pallas→XLA dispatch rejection. Runs at TRACE time (shapes
+    and dtypes are static), so the counter costs nothing inside the
+    compiled step and dedup keeps a steady-state loop from re-counting
+    the same miss on every retrace."""
+    key = (kernel, reason, tuple(shape), str(jnp.dtype(dtype)))
+    if key in _fallback_noted:
+        return
+    _fallback_noted.add(key)
+    from deeprec_tpu.obs.metrics import default_registry
+
+    default_registry().counter(
+        "deeprec_pallas_fallback",
+        help="Pallas kernel dispatches that fell back to XLA, by cause",
+        labels={"kernel": kernel, "reason": reason},
+    ).inc()
+
+
+def _row_reason(dim: int, dtype) -> str:
+    """Why _on_tpu() + _dma_ok rejected a single-row-DMA dispatch."""
+    if not _on_tpu():
+        return "not_tpu"
+    if dim % _LANES != 0:
+        return "dim_unaligned"
+    return "dtype"
+
+
+def _pair_reason(shape, dtype) -> str:
+    """Why _on_tpu() + _dma_pair_ok rejected a pair-granule dispatch."""
+    if not _on_tpu():
+        return "not_tpu"
+    _, dim = shape
+    if dim % _LANES != 0:
+        return "dim_unaligned"
+    if jnp.dtype(dtype) != jnp.bfloat16:
+        return "dtype"
+    return "odd_capacity"
 
 
 def _pad_rows(ix: jnp.ndarray, block: int, fill: int = 0) -> jnp.ndarray:
@@ -160,6 +215,9 @@ def gather_rows_pair(values: jnp.ndarray, ix: jnp.ndarray, *,
     if not interpret and not (
         _on_tpu() and _dma_pair_ok(values.shape, values.dtype)
     ):
+        _note_fallback("gather_rows_pair",
+                       _pair_reason(values.shape, values.dtype),
+                       values.shape, values.dtype)
         return values.at[ix].get(mode="clip")
 
     from jax.experimental import pallas as pl
@@ -230,6 +288,9 @@ def apply_rows_sr_pair(values: jnp.ndarray, slot_ix: jnp.ndarray,
     if not interpret and not (
         _on_tpu() and _dma_pair_ok(values.shape, values.dtype)
     ):
+        _note_fallback("apply_rows_sr_pair",
+                       _pair_reason(values.shape, values.dtype),
+                       values.shape, values.dtype)
         return apply_rows_sr(values, slot_ix, new_rows, seed,
                              use_pallas=False, interpret=False)
 
@@ -317,6 +378,9 @@ def gather_rows(values: jnp.ndarray, ix: jnp.ndarray, *,
     ):
         return gather_rows_pair(values, ix, block=block, interpret=interpret)
     if not interpret and not (_on_tpu() and _dma_ok(values.shape[1], values.dtype)):
+        _note_fallback("gather_rows",
+                       _row_reason(values.shape[1], values.dtype),
+                       values.shape, values.dtype)
         return values.at[ix].get(mode="clip")
 
     from jax.experimental import pallas as pl
@@ -395,6 +459,8 @@ def fused_gather_combine(values: jnp.ndarray, row_ix: jnp.ndarray,
     if not pair and not interpret and not (
         _on_tpu() and _dma_ok(D, values.dtype)
     ):
+        _note_fallback("fused_gather_combine", _row_reason(D, values.dtype),
+                       values.shape, values.dtype)
         e = values.at[jnp.clip(row_ix, 0, C - 1)].get(mode="clip")
         w = jnp.where(row_ix >= 0, weights, 0.0)
         return jnp.sum(e.astype(jnp.float32) * w[..., None], axis=1)
@@ -514,6 +580,11 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
         return apply_rows_sr_pair(values, slot_ix, new_rows, seed,
                                   interpret=interpret)
     if not interpret and not (use_pallas and _on_tpu() and _dma_ok(D, values.dtype)):
+        if use_pallas:
+            # only a *rejected* Pallas request is a fallback worth noting;
+            # use_pallas=False callers asked for the XLA scatter.
+            _note_fallback("apply_rows_sr", _row_reason(D, values.dtype),
+                           values.shape, values.dtype)
         if values.dtype == jnp.bfloat16:
             key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
             rows = stochastic_round(new_rows, key)
@@ -592,3 +663,599 @@ def apply_rows_sr(values: jnp.ndarray, slot_ix: jnp.ndarray,
         compiler_params=_compiler_params(pltpu, has_side_effects=True),
         interpret=interpret,
     )(ixp, new_rows, bits, values)
+
+
+# ------------------------------------------------------- fused sparse step
+#
+# The single-pass per-table step kernels (docs/kernels.md). Forward: one
+# Pallas pass runs the hash-probe dedup inline (the scratch table lives in
+# VMEM, so the claim-scatter that costs ~50x a gather as an [S]-lane XLA
+# scatter — ops/dedup.py's compaction comment — becomes a plain in-kernel
+# slot write), DMAs each unique row from HBM exactly once, and
+# segment-combines straight into the [B, D] output: the [U, D] unique-rows
+# buffer never round-trips through HBM. Backward: one pass segment-sums the
+# per-example output gradient into unique-row space in VMEM, stages the
+# touched value/slot rows in, applies the optimizer row-function, and
+# DMA-scatters the results back — the [U, D] gradient buffer never exists
+# outside the kernel either. Both are oracle-tested on CPU via
+# interpret=True against the XLA composition below (bit-identical fp32,
+# same-bits SR equality bf16; tests/test_fused_step.py).
+
+
+class FusedBags(NamedTuple):
+    """Everything fused_sparse_forward produced / the backward consumes.
+
+    out      [B, D] f32 pooled bags (always f32: rows are cast up before
+             the combine on BOTH paths, so bf16 tables pool exactly).
+    uids     [U] int32 unique row indices; uids[0] == -1 (reserved
+             sentinel, the hash_dedup contract). NOTE the ORDER of uids is
+             path-dependent (kernel claims in first-occurrence order, the
+             XLA fallback compacts in scratch-slot order); `out` and the
+             uids↔inverse correspondence are order-independent.
+    inverse  [B, L] int32 position -> unique slot (0 = pad/overflow).
+    counts   [U] int32 occurrences per unique slot (counts[0] == 0).
+    overflow [] int32 distinct ids past the budget + unresolved probes.
+    """
+
+    out: jnp.ndarray
+    uids: jnp.ndarray
+    inverse: jnp.ndarray
+    counts: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def _sr_bits_rows(seed, uids: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Row-KEYED stochastic-rounding bits: a pure integer hash of
+    (seed, row id, column). The positional `_sr_bits` stream would hand a
+    row different noise depending on the order dedup emitted it — and the
+    fused kernel and the XLA fallback emit uids in different (equally
+    valid) orders — so bf16 parity across paths needs bits that are a
+    function of the ROW, not its position in the unique set."""
+    from deeprec_tpu.utils import hashing
+
+    s = hashing.mix32(jnp.asarray(seed).astype(jnp.uint32))
+    base = hashing.mix32(hashing.fold64(uids) ^ s)  # [U]
+    col = hashing.mix32(
+        jnp.arange(dim, dtype=jnp.uint32) * jnp.uint32(0x9E3779B9)
+    )  # [D]
+    return hashing.mix32(base[:, None] ^ col[None, :])  # [U, D]
+
+
+def _sr_round_bits(x: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """XLA stochastic rounding from caller-supplied bits — the same
+    twiddle as stochastic_round / _sr_round_in_kernel, so the fallback
+    and the kernel are bit-interchangeable when fed the same bits."""
+    u = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    u = u + (bits & jnp.uint32(0xFFFF))
+    u = u & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(u, jnp.float32).astype(jnp.bfloat16)
+
+
+def _bag_denominator(mask: jnp.ndarray, combiner: str) -> jnp.ndarray:
+    """Per-bag combine denominator [B, 1] f32: 1 for sum, max(n,1) for
+    mean, sqrt(max(n,1)) for sqrtn. Always applied OUTSIDE the
+    kernel-vs-fallback branch (forward epilogue and backward grad
+    pre-scaling), so the division is one shared traced computation: XLA's
+    algebraic simplifier rewrites x/sqrt(n) into x*rsqrt(n) in some graph
+    contexts and not others (1-ulp apart — observed on CPU), and a
+    division INSIDE the branch would let the two paths drift by exactly
+    that rewrite."""
+    n = jnp.sum(mask.astype(jnp.float32), axis=1, keepdims=True)
+    if combiner == "sum":
+        return jnp.ones_like(n)
+    if combiner == "mean":
+        return jnp.maximum(n, 1.0)
+    if combiner == "sqrtn":
+        return jnp.sqrt(jnp.maximum(n, 1.0))
+    raise ValueError(f"unknown combiner: {combiner}")
+
+
+def _combine_epilogue(bags: "FusedBags", ids: jnp.ndarray,
+                      combiner: str) -> "FusedBags":
+    """mean/sqrtn scaling over the raw per-bag sums, shared by both
+    forward paths (see _bag_denominator for why it must live out here)."""
+    if combiner == "sum":
+        return bags
+    return bags._replace(
+        out=bags.out / _bag_denominator(ids >= 0, combiner)
+    )
+
+
+def fusable_optimizer(opt, dim: int) -> bool:
+    """The fused backward stages slot rows in VMEM as [U, dim] tiles: an
+    optimizer qualifies iff every slot is a full-width (dim,) row — no
+    per-table scalars (AdamAsync's beta powers), no (1,)-wide rows
+    (AdagradDecay's decay_period). sgd/adagrad/adam/adamw/ftrl qualify;
+    the rest keep the split-phase apply_gradients path."""
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    for name, (shape, _) in opt.slot_specs(dim).items():
+        if name.startswith(SCALAR_PREFIX) or tuple(shape) != (dim,):
+            return False
+    return True
+
+
+def fused_sparse_forward(values: jnp.ndarray, ids: jnp.ndarray, *,
+                         combiner: str = "sum", unique_size: int,
+                         max_probes: int = 64, interpret: bool = False,
+                         use_pallas: bool = True) -> FusedBags:
+    """Single-pass budgeted lookup: dedup-probe + unique-row gather +
+    segment-combine, one kernel per table.
+
+    values [C, D]; ids [B, L] int32 ROW indices into values (< 0 = pad);
+    unique_size the static dedup budget U (>= 2; index 0 is the reserved
+    sentinel slot — use dedup.resolve_size). Returns FusedBags.
+
+    Off-TPU (and for any shape _dma_ok rejects) this is the identical-
+    semantics XLA composition hash_dedup -> gather -> combiners.combine,
+    which doubles as the oracle for the interpret-mode kernel tests.
+    When `overflow > 0` the SET of budgeted ids is path-dependent (claim
+    order vs scratch-slot order) — both satisfy the budget contract.
+    """
+    B, L = ids.shape
+    C, D = values.shape
+    U = int(unique_size)
+    N = B * L
+    flat = jnp.where(ids >= 0, ids, -1).reshape(-1).astype(jnp.int32)
+
+    if not interpret and not (
+        use_pallas and _on_tpu() and _dma_ok(D, values.dtype)
+    ):
+        if use_pallas:
+            _note_fallback("fused_sparse_forward",
+                           _row_reason(D, values.dtype),
+                           values.shape, values.dtype)
+        from deeprec_tpu.embedding import combiners
+        from deeprec_tpu.ops import dedup
+
+        uids, inverse, counts, overflow = dedup.hash_dedup(
+            flat, U, sentinel=-1, max_probes=max_probes
+        )
+        emb = values.at[jnp.clip(uids, 0, C - 1)].get(mode="clip").astype(
+            jnp.float32
+        )
+        emb = jnp.where((uids >= 0)[:, None], emb, 0.0)
+        out = combiners.combine(emb, inverse.reshape(B, L), ids >= 0,
+                                "sum")
+        return _combine_epilogue(
+            FusedBags(out, uids, inverse.reshape(B, L), counts, overflow),
+            ids, combiner,
+        )
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from deeprec_tpu.ops import dedup
+    from deeprec_tpu.utils import hashing
+
+    # Probe table sizing: same load-factor policy as the XLA engine, but
+    # laid out (S // 128, 128) so slot access is a dynamic SUBLANE slice
+    # plus an iota-select over lanes (a dynamic LANE index is not
+    # expressible on TPU). Floor of one full lane row.
+    S = max(dedup.scratch_size(N), _LANES)
+
+    def kernel(ids_ref, values_ref, out_ref, uids_ref, inv_ref, cnt_ref,
+               ovf_ref, ubuf, lbuf, tabk, tabu, usm, sem):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+        mask_s = jnp.uint32(S - 1)
+
+        def tab_read(ref, pos):
+            row = ref[pl.ds(pos // _LANES, 1), :]
+            return jnp.sum(jnp.where(lane == (pos % _LANES), row, 0))
+
+        def tab_write(ref, pos, val):
+            r = pos // _LANES
+            row = ref[pl.ds(r, 1), :]
+            ref[pl.ds(r, 1), :] = jnp.where(lane == (pos % _LANES), val, row)
+
+        tabk[...] = jnp.full_like(tabk[...], -1)
+        tabu[...] = jnp.zeros_like(tabu[...])
+        cnt_ref[...] = jnp.zeros_like(cnt_ref[...])
+        uids_ref[...] = jnp.full_like(uids_ref[...], -1)
+        # Only row 0 (the sentinel every pad/overflow position points at)
+        # is ever read without having been DMA'd; zero the lot anyway so
+        # no uninitialized VMEM can leak through a future indexing bug.
+        ubuf[...] = jnp.zeros_like(ubuf[...])
+
+        # ---- phase 1: sequential hash-probe insert — ops/dedup.py's
+        # claim-scatter as an in-kernel slot write (the insert loop is
+        # serial in here, so there is no claim race to re-check and no
+        # O(N)-lane scatter to pay for).
+        def insert(n, carry):
+            nu, ovf = carry
+            idv = ids_ref[n]
+            valid = idv >= 0
+            h0 = hashing.mix32(hashing.fold64(idv))
+
+            def cond(c):
+                return jnp.logical_and(~c[1], c[0] < max_probes)
+
+            def body(c):
+                p_step, done, u, nu, ovf = c
+                pos = ((h0 + p_step.astype(jnp.uint32)) & mask_s).astype(
+                    jnp.int32
+                )
+                k = tab_read(tabk, pos)
+                hit = k == idv
+                empty = k == -1
+                u = jnp.where(hit, tab_read(tabu, pos), u)
+                new_u = jnp.where(nu < jnp.int32(U), nu, 0)
+
+                @pl.when(empty)
+                def _():
+                    tab_write(tabk, pos, idv)
+                    tab_write(tabu, pos, new_u)
+
+                @pl.when(empty & (nu < jnp.int32(U)))
+                def _():
+                    uids_ref[pl.ds(new_u, 1), :] = idv.reshape(1, 1)
+                    usm[new_u] = idv
+
+                u = jnp.where(empty, new_u, u)
+                ovf = ovf + jnp.where(
+                    empty & (nu >= jnp.int32(U)), 1, 0
+                ).astype(jnp.int32)
+                nu = nu + jnp.where(
+                    empty & (nu < jnp.int32(U)), 1, 0
+                ).astype(jnp.int32)
+                done = done | hit | empty
+                return p_step + 1, done, u, nu, ovf
+
+            _, done, u, nu, ovf = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), ~valid, jnp.int32(0), nu, ovf),
+            )
+            # probe chain exhausted: same per-position overflow accounting
+            # as hash_dedup's `sum(failed)`.
+            ovf = ovf + jnp.where(valid & ~done, 1, 0).astype(jnp.int32)
+            inv_ref[pl.ds(n, 1), :] = u.reshape(1, 1)
+
+            @pl.when(u > 0)
+            def _():
+                cnt_ref[pl.ds(u, 1), :] = cnt_ref[pl.ds(u, 1), :] + 1
+
+            return nu, ovf
+
+        _, ovf = jax.lax.fori_loop(
+            0, N, insert, (jnp.int32(1), jnp.int32(0))
+        )
+        ovf_ref[...] = ovf.reshape(1, 1)
+
+        # ---- phase 2: DMA each unique row HBM -> VMEM once (2-deep
+        # pipeline, same idiom as gather_rows). Unclaimed tail slots
+        # fetch a clamped row unconditionally so start/wait stay paired.
+        def fetch(slot, u):
+            idx = jnp.clip(usm[u], 0, C - 1)
+            return pltpu.make_async_copy(
+                values_ref.at[idx], ubuf.at[u], sem.at[slot]
+            )
+
+        if U > 1:
+            def fbody(u, _):
+                @pl.when(u + 1 < U)
+                def _():
+                    fetch((u + 1) % 2, u + 1).start()
+
+                fetch(u % 2, u).wait()
+                return 0
+
+            fetch(1, 1).start()
+            jax.lax.fori_loop(1, U, fbody, 0)
+
+        # Re-zero rows nobody claimed (their DMA fetched a clamped row):
+        # inverse never points at them, but uids/counts are public and
+        # tests reconstruct embeddings from the buffer's contract.
+        def clear(u, _):
+            @pl.when(usm[u] < 0)
+            def _():
+                ubuf[pl.ds(u, 1), :] = jnp.zeros_like(
+                    ubuf[pl.ds(u, 1), :]
+                )
+
+            return 0
+
+        jax.lax.fori_loop(1, U, clear, 0)
+
+        # ---- phase 3: segment-sum into [B, D], mirroring
+        # combiners.combine(..., "sum") term by term (per-position
+        # multiply, one axis-reduction per bag) so fp32 output is
+        # bit-identical to the fallback; the mean/sqrtn division happens
+        # in the shared _combine_epilogue outside the kernel.
+        def bag(b, _):
+            def pos(loc, nb):
+                j = b * L + loc
+                w = jnp.where(ids_ref[j] >= 0, 1.0, 0.0).astype(
+                    jnp.float32
+                )
+                u = jnp.sum(inv_ref[pl.ds(j, 1), :])
+                row = ubuf[pl.ds(u, 1), :].astype(jnp.float32)
+                lbuf[pl.ds(loc, 1), :] = row * w
+                return nb + w
+
+            jax.lax.fori_loop(0, L, pos, jnp.float32(0.0))
+            out_ref[pl.ds(b, 1), :] = jnp.sum(
+                lbuf[...], axis=0, keepdims=True
+            )
+            return 0
+
+        jax.lax.fori_loop(0, B, bag, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec((B, D), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((U, 1), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, 1), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((U, 1), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((U, D), values.dtype),          # unique rows
+            pltpu.VMEM((max(L, 1), D), jnp.float32),   # one bag's terms
+            pltpu.VMEM((S // _LANES, _LANES), jnp.int32),  # probe keys
+            pltpu.VMEM((S // _LANES, _LANES), jnp.int32),  # probe -> uid
+            pltpu.SMEM((U,), jnp.int32),  # uids mirror: scalar DMA indices
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out, uids, inv, cnt, ovf = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, D), jnp.float32),
+            jax.ShapeDtypeStruct((U, 1), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),
+            jax.ShapeDtypeStruct((U, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        compiler_params=_compiler_params(pltpu, has_side_effects=True),
+        interpret=interpret,
+    )(flat, values)
+    return _combine_epilogue(
+        FusedBags(out, uids[:, 0], inv[:, 0].reshape(B, L), cnt[:, 0],
+                  ovf[0, 0]),
+        ids, combiner,
+    )
+
+
+def fused_sparse_backward(values: jnp.ndarray,
+                          slots: Dict[str, jnp.ndarray],
+                          grad_out: jnp.ndarray, ids: jnp.ndarray,
+                          res: FusedBags, opt, *, combiner: str = "sum",
+                          step=0, lr=None, seed=0,
+                          grad_averaging: bool = False,
+                          interpret: bool = False,
+                          use_pallas: bool = True,
+                          ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Single-pass backward: segment-sum per-example grads to unique rows
+    and apply the optimizer update, fused into the scatter.
+
+    values [C, D]; slots {name: [C, D] f32} (the optimizer's row slots —
+    must satisfy fusable_optimizer, else the XLA composition runs even
+    under interpret); grad_out [B, D] grad w.r.t. the forward's `out`;
+    ids/res from the matching fused_sparse_forward call. bf16 tables
+    stochastic-round with ROW-keyed bits (_sr_bits_rows), so kernel and
+    fallback round identically regardless of uid order. Returns
+    (new_values, new_slots).
+    """
+    B, L = ids.shape
+    C, D = values.shape
+    U = res.uids.shape[0]
+    N = B * L
+    step = jnp.asarray(step, jnp.int32)
+    lr = jnp.asarray(opt.lr if lr is None else lr, jnp.float32)
+    mask = ids >= 0
+    sr = values.dtype == jnp.bfloat16
+    bits = _sr_bits_rows(seed, res.uids, D) if sr else None
+    # Combiner scaling happens HERE, shared by both paths (see
+    # _bag_denominator for why the division can't live inside the branch).
+    gs = grad_out.astype(jnp.float32) / _bag_denominator(mask, combiner)
+    fusable = fusable_optimizer(opt, D)
+    snames = sorted(slots)
+    for name in snames:
+        if slots[name].shape != (C, D):
+            # A silent fallback here would gather WRONG rows (a packed
+            # slot's row space is C // P) — reject loudly instead.
+            raise ValueError(
+                f"fused_sparse_backward: slot {name!r} has shape "
+                f"{slots[name].shape}, want {(C, D)} — packed slot "
+                "layouts keep the split-phase apply_gradients path"
+            )
+
+    if not fusable or (
+        not interpret and not (use_pallas and _on_tpu()
+                               and _dma_ok(D, values.dtype))
+    ):
+        if use_pallas and not interpret:
+            _note_fallback(
+                "fused_sparse_backward",
+                "optimizer" if not fusable else _row_reason(D, values.dtype),
+                values.shape, values.dtype,
+            )
+        g = gs  # [B, D], combiner-scaled above
+        w = mask.astype(jnp.float32)[..., None]
+        contrib = (jnp.broadcast_to(g[:, None, :], (B, L, D)) * w).reshape(
+            N, D
+        )
+        grad_u = jnp.zeros((U, D), jnp.float32).at[
+            res.inverse.reshape(-1)
+        ].add(contrib)
+        grad_u = grad_u.at[0].set(0.0)
+        if grad_averaging:
+            grad_u = grad_u / jnp.maximum(
+                res.counts.astype(jnp.float32), 1.0
+            )[:, None]
+        ok = res.uids >= 0
+        safe = jnp.where(ok, jnp.clip(res.uids, 0, C - 1), 0)
+        value = values.at[safe].get(mode="clip").astype(jnp.float32)
+        row_slots = {
+            name: slots[name].at[safe].get(mode="clip").astype(jnp.float32)
+            for name in snames
+        }
+        new_value, new_slots = opt.update(value, row_slots, grad_u,
+                                          res.counts, step, lr)
+        rows = (_sr_round_bits(new_value, bits) if sr
+                else new_value.astype(values.dtype))
+        drop = jnp.where(ok, safe, C)
+        out_values = values.at[drop].set(rows, mode="drop")
+        out_slots = {
+            name: slots[name].at[drop].set(
+                new_slots[name].astype(slots[name].dtype), mode="drop"
+            )
+            for name in snames
+        }
+        return out_values, out_slots
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K = len(snames)
+    if sr:
+        bits_in, bits_dim = bits, D
+    else:
+        # f32 path never reads the bits: ship a 1-wide dummy, not U*D zeros.
+        bits_in = jnp.zeros((U, 1), jnp.uint32)  # noqa: DRT003 — deliberate 1-wide dummy: f32 path never reads it
+        bits_dim = 1
+
+    def kernel(*refs):
+        (uids_ref, inv_ref, m_ref, step_ref, lr_ref,
+         g_ref, cnt_ref, bits_ref) = refs[:8]
+        # refs[8 : 9+K] are the aliased value/slot inputs — read through
+        # the output refs below (aliasing makes them the same buffers).
+        vout = refs[9 + K]
+        souts = refs[10 + K:10 + 2 * K]
+        gbuf = refs[10 + 2 * K]
+        vstage = refs[11 + 2 * K]
+        stgs = refs[12 + 2 * K:12 + 3 * K]
+        sem = refs[12 + 3 * K]
+
+        # ---- phase A: segment-sum grads into unique-row space, same
+        # accumulation order (flat position order) as the XLA scatter-add.
+        gbuf[...] = jnp.zeros_like(gbuf[...])
+
+        def accum(n, _):
+            u = inv_ref[n]
+            w = jnp.where(m_ref[n] > 0, 1.0, 0.0).astype(jnp.float32)
+            b = n // L
+            row = g_ref[pl.ds(b, 1), :]  # combiner-scaled by the caller
+            gbuf[pl.ds(u, 1), :] = gbuf[pl.ds(u, 1), :] + row * w
+            return 0
+
+        jax.lax.fori_loop(0, N, accum, 0)
+        gbuf[pl.ds(0, 1), :] = jnp.zeros_like(gbuf[pl.ds(0, 1), :])
+        if grad_averaging:
+            gbuf[...] = gbuf[...] / jnp.maximum(
+                cnt_ref[...].astype(jnp.float32), 1.0
+            )
+
+        # ---- phase B: stage the touched value + slot rows VMEM-side
+        # (one DMA per row per array; unclaimed tail rows fetch a clamped
+        # row that phase D never writes back).
+        def stage(u, _):
+            idx = jnp.clip(uids_ref[u], 0, C - 1)
+            cps = [pltpu.make_async_copy(
+                vout.at[idx], vstage.at[u], sem.at[0]
+            )]
+            for k in range(K):
+                cps.append(pltpu.make_async_copy(
+                    souts[k].at[idx], stgs[k].at[u], sem.at[1 + k]
+                ))
+            for c in cps:
+                c.start()
+            for c in cps:
+                c.wait()
+            return 0
+
+        jax.lax.fori_loop(1, U, stage, 0)
+
+        # ---- phase C: the optimizer row-function over the whole [U, D]
+        # stage — the SAME update() the unfused apply calls, so numerics
+        # agree by construction; bf16 adds row-keyed SR before downcast.
+        new_value, new_slots = opt.update(
+            vstage[...].astype(jnp.float32),
+            {snames[k]: stgs[k][...] for k in range(K)},
+            gbuf[...],
+            cnt_ref[...][:, 0],
+            step_ref[0],
+            lr_ref[0],
+        )
+        if sr:
+            new_value = _sr_round_in_kernel(new_value, bits_ref[...])
+        vstage[...] = new_value.astype(vstage.dtype)
+        for k in range(K):
+            stgs[k][...] = new_slots[snames[k]].astype(stgs[k].dtype)
+
+        # ---- phase D: DMA-scatter the updated rows back (guarded: the
+        # sentinel row and unclaimed tail slots are never written).
+        def unstage(u, _):
+            @pl.when(uids_ref[u] >= 0)
+            def _():
+                idx = jnp.clip(uids_ref[u], 0, C - 1)
+                cps = [pltpu.make_async_copy(
+                    vstage.at[u], vout.at[idx], sem.at[0]
+                )]
+                for k in range(K):
+                    cps.append(pltpu.make_async_copy(
+                        stgs[k].at[u], souts[k].at[idx], sem.at[1 + k]
+                    ))
+                for c in cps:
+                    c.start()
+                for c in cps:
+                    c.wait()
+
+            return 0
+
+        jax.lax.fori_loop(1, U, unstage, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((B, D), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((U, 1), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((U, bits_dim), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in range(K)],
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pl.ANY) for _ in range(1 + K)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((U, D), jnp.float32),   # grad_u (never leaves VMEM)
+            pltpu.VMEM((U, D), values.dtype),  # value stage
+        ] + [pltpu.VMEM((U, D), jnp.float32) for _ in range(K)]
+        + [pltpu.SemaphoreType.DMA((1 + K,))],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=tuple(
+            [jax.ShapeDtypeStruct(values.shape, values.dtype)]
+            + [jax.ShapeDtypeStruct(slots[n].shape, slots[n].dtype)
+               for n in snames]
+        ),
+        input_output_aliases={8 + i: i for i in range(1 + K)},
+        compiler_params=_compiler_params(pltpu, has_side_effects=True),
+        interpret=interpret,
+    )(
+        jnp.clip(res.uids, -1, C - 1).astype(jnp.int32),
+        res.inverse.reshape(-1).astype(jnp.int32),
+        mask.reshape(-1).astype(jnp.int32),
+        step.reshape(1),
+        lr.reshape(1),
+        gs,
+        res.counts.reshape(U, 1),
+        bits_in,
+        values,
+        *[slots[n] for n in snames],
+    )
+    return outs[0], {snames[k]: outs[1 + k] for k in range(K)}

@@ -57,6 +57,13 @@ def row_factor(arr, capacity: int) -> int:
     return 1
 
 
+def is_unpacked(arr, capacity: int) -> bool:
+    """True when `arr` stores one logical row per physical row — the layout
+    the fused-step kernels (ops/fused_lookup.fused_sparse_*) require, since
+    their per-row DMAs address whole logical rows."""
+    return row_factor(arr, capacity) == 1
+
+
 def pack_array(arr: jnp.ndarray, p: int) -> jnp.ndarray:
     """[C, w] -> [C // p, p * w] (row-major; a relayout copy on device,
     a free view on host numpy)."""

@@ -504,6 +504,61 @@ def table_step_traffic(
     }
 
 
+def fused_sparse_step_traffic(
+    *,
+    positions: int,
+    batch: int,
+    unique: int,
+    dim: int,
+    value_bytes: int = 4,
+    key_bytes: int = 4,
+    slot_widths: Sequence[int] = (0,),
+    fused: bool = True,
+) -> Dict[str, float]:
+    """Modeled HBM bytes of one fwd+bwd sparse bag step (lookup + combine
+    + optimizer apply) for one table — the quantity `roofline.py
+    --assert-fused` gates on.
+
+    `positions` is the flattened id-stream length N = B·L, `batch` the bag
+    count B, `unique` the budgeted U. The split-phase model
+    (`fused=False`) counts every HBM materialization the XLA path makes,
+    including the O(N·D) expansion terms the fused kernels eliminate: the
+    `emb_u[inverse]` gather that materializes [N, D] before the combine
+    reduction, and the mirrored [N, D] per-position grad contributions the
+    backward `.at[inverse].add` expands before segment-summing. The fused
+    model (`fused=True`) keeps only the irreducible stream: ids in, unique
+    rows DMA'd once, bags out, grads in, unique value/slot rows
+    read-modify-written once — the [U, D] and [N, D] intermediates live
+    and die in VMEM.
+    """
+    N, B, U, D = positions, batch, unique, dim
+    vb, kb = value_bytes, key_bytes
+    slot_b = sum(w * 4 for w in slot_widths)
+
+    if not fused:
+        hbm = 2 * kb * N  # dedup: key gather + claim scatter over N lanes
+        hbm += U * D * vb  # unique row gather (read)
+        hbm += 2 * U * D * vb  # [U, D] emb_u round-trip (write, re-read)
+        hbm += N * D * vb  # combine: emb_u[inverse] expands to [N, D]
+        hbm += B * D * 4  # combined bags out (f32)
+        hbm += B * D * 4  # backward: bag grads in (f32)
+        hbm += N * D * 4  # per-position grad contribs expand to [N, D]
+        hbm += 2 * U * D * 4  # [U, D] grad_u round-trip (scatter, re-read)
+        hbm += 2 * U * D * vb  # apply: value row gather + scatter
+        hbm += 2 * slot_b * U  # apply: slot gather + scatter
+    else:
+        hbm = kb * N  # forward reads the id stream once; probe is in VMEM
+        hbm += U * D * vb  # unique rows DMA'd HBM -> VMEM once
+        hbm += B * D * 4  # combined bags out (f32)
+        hbm += B * D * 4  # backward: bag grads in (f32)
+        hbm += kb * N  # backward re-reads ids/inverse
+        hbm += 2 * U * D * vb  # value rows: DMA in + updated DMA out
+        hbm += 2 * slot_b * U  # slot rows: DMA in + out
+        if vb == 2:
+            hbm += U * D * 4  # row-keyed SR bits (u32) for bf16 tables
+    return {"hbm_bytes": float(hbm)}
+
+
 def dlrm_reference_traffic(
     *,
     batch: int = 2048,

@@ -136,3 +136,60 @@ def apply_gradients(
         meta = meta.at[META_DIRTY, drop_ix].set(1, mode="drop")
         return state.replace(values=values, slots=slots, meta=meta)
     return state.replace(values=values, slots=slots)
+
+
+def apply_bag_gradients(
+    table: EmbeddingTable,
+    state: TableState,
+    opt: SparseOptimizer,
+    res,  # ops.fused_lookup.FusedBags from a matching bag_forward
+    grad_out: jnp.ndarray,  # [B, D] grads w.r.t. res.out
+    row_ix: jnp.ndarray,  # [B, L] resolved slot indices fed to bag_forward
+    *,
+    combiner: str = "mean",
+    step: jnp.ndarray | int = 0,
+    lr: Optional[jnp.ndarray | float] = None,
+    grad_averaging: bool = False,
+    interpret: bool = False,
+    stamp_meta: bool = True,
+) -> TableState:
+    """The fused-step analog of apply_gradients: one pass segment-sums the
+    per-bag grads [B, D] into unique-row space and applies the optimizer
+    update fused into the scatter (ops/fused_lookup.fused_sparse_backward),
+    so per-row grads never materialize outside the kernel.
+
+    `res` must come from `table.bag_forward(state, row_ix, ...)` with the
+    SAME combiner; `row_ix` is the [B, L] resolved slot indices (< 0 = pad)
+    that produced it. Requires a fusable optimizer (no scalar slots, all
+    slots [dim]-shaped — fused_lookup.fusable_optimizer) and the unpacked
+    row layout; callers outside that envelope use apply_gradients.
+    """
+    from deeprec_tpu.ops import fused_lookup as fl
+    from deeprec_tpu.ops.packed import is_unpacked
+
+    if not fl.fusable_optimizer(opt, state.dim):
+        raise NotImplementedError(
+            f"apply_bag_gradients: optimizer {type(opt).__name__} has "
+            "scalar or non-[dim] slots; use apply_gradients"
+        )
+    if not is_unpacked(state.values, state.capacity):
+        raise NotImplementedError(
+            "apply_bag_gradients: packed small-dim layouts keep the "
+            "split-phase apply_gradients path"
+        )
+    values, slots = fl.fused_sparse_backward(
+        state.values, dict(state.slots), grad_out, row_ix, res, opt,
+        combiner=combiner, step=step, lr=lr, seed=step,
+        grad_averaging=grad_averaging, interpret=interpret,
+        use_pallas=table.fused_step,
+    )
+    if stamp_meta:
+        from deeprec_tpu.embedding.table import META_DIRTY, META_VERSION
+
+        # uids[0] is the reserved sentinel (-1) and overflow rows stay
+        # negative — route both to the dropped C lane.
+        drop_ix = jnp.where(res.uids >= 0, res.uids, state.capacity)
+        meta = state.meta.at[META_VERSION, drop_ix].set(step, mode="drop")
+        meta = meta.at[META_DIRTY, drop_ix].set(1, mode="drop")
+        return state.replace(values=values, slots=slots, meta=meta)
+    return state.replace(values=values, slots=slots)

@@ -1,0 +1,279 @@
+"""Fused single-pass sparse step vs the split-phase oracle (interpret mode).
+
+The contract (ops/fused_lookup.fused_sparse_forward/backward): the fused
+Pallas kernel and the XLA fallback produce the SAME combined bags / updated
+rows — bit-identical at fp32, seeded-SR bitwise at bf16 — with BOTH sides
+under jax.jit. The jit is part of the contract, not a convenience: eager
+op-by-op execution skips the FMA contraction XLA applies inside a compiled
+(interpret-mode) kernel, so un-jitted comparisons show 1-ulp float diffs
+that vanish in every production context (docs/kernels.md).
+
+uids ORDER is path-dependent (kernel claims in stream order, the XLA
+fallback compacts in scratch-slot order), so uids/counts compare as
+multisets and `out` — order-independent by construction — compares bitwise.
+Overflowed batches keep COUNT parity only: WHICH distinct ids make the
+budget is path-dependent (both answers valid), so bitwise cases pin
+overflow == 0.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeprec_tpu.ops import fused_lookup as fl
+from deeprec_tpu.ops.dedup import resolve_size
+from deeprec_tpu.optim.sparse import REGISTRY
+
+B, L = 4, 4
+N = B * L
+
+
+def _ids(rng, vocab, *, pads=True):
+    ids = rng.integers(0, vocab, (B, L))
+    if pads:
+        ids[0, :] = -1            # empty bag
+        ids[1, :] = ids[1, 0]     # all-duplicate bag
+        ids[2, 2:] = -1           # pad inside a bag
+    return jnp.asarray(ids, jnp.int32)
+
+
+def _fwd(fused, *, combiner, U):
+    return jax.jit(lambda v, i: fl.fused_sparse_forward(
+        v, i, combiner=combiner, unique_size=U,
+        interpret=fused, use_pallas=fused,
+    ))
+
+
+def _step(fused, opt, *, combiner, U, seed=7):
+    def fn(v, s, i):
+        res = fl.fused_sparse_forward(
+            v, i, combiner=combiner, unique_size=U,
+            interpret=fused, use_pallas=fused,
+        )
+        g = res.out * 0.25 + 1.0
+        return fl.fused_sparse_backward(
+            v, s, g, i, res, opt, combiner=combiner, step=3, seed=seed,
+            interpret=fused, use_pallas=fused,
+        )
+    return jax.jit(fn)
+
+
+def _table(rng, C, D, dtype):
+    return jnp.asarray(rng.normal(0, 0.5, (C, D)), dtype)
+
+
+def _slots(opt, C, D):
+    return {
+        name: jnp.full((C, D), init, jnp.float32)
+        for name, (shape, init) in opt.slot_specs(D).items()
+    }
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+@pytest.mark.parametrize("dim", [128, 96, 1])
+def test_forward_parity(dtype, combiner, dim):
+    seed = sum(map(ord, dtype + combiner)) * 1000 + dim  # hash() is salted
+    rng = np.random.default_rng(seed)
+    C, U = 32, resolve_size(8, N)
+    vals = _table(rng, C, dim, jnp.dtype(dtype))
+    ids = _ids(rng, 8)  # vocab 8 < budget: overflow == 0 guaranteed
+    ru = _fwd(False, combiner=combiner, U=U)(vals, ids)
+    rf = _fwd(True, combiner=combiner, U=U)(vals, ids)
+
+    assert int(ru.overflow) == 0 and int(rf.overflow) == 0
+    # out is order-independent: bitwise across paths, f32 both ways.
+    assert ru.out.dtype == rf.out.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(ru.out), np.asarray(rf.out))
+    # uids/counts are order-path-dependent: multiset equality.
+    for r in (ru, rf):
+        assert int(r.uids[0]) == -1 and int(r.counts[0]) == 0
+        # inverse reconstructs the id stream wherever it points past the
+        # sentinel slot.
+        rec = np.asarray(r.uids)[np.asarray(r.inverse)]
+        inv = np.asarray(r.inverse)
+        np.testing.assert_array_equal(
+            rec[inv > 0], np.asarray(ids)[inv > 0]
+        )
+    zu = sorted(zip(np.asarray(ru.uids), np.asarray(ru.counts)))
+    zf = sorted(zip(np.asarray(rf.uids), np.asarray(rf.counts)))
+    assert zu == zf
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad", "adam", "adamw",
+                                      "ftrl"])
+def test_backward_parity_f32(opt_name):
+    rng = np.random.default_rng(1)
+    C, D, U = 32, 128, resolve_size(8, N)
+    opt = REGISTRY[opt_name]()
+    vals, slots = _table(rng, C, D, jnp.float32), _slots(opt, C, D)
+    ids = _ids(rng, 8)
+    (vu, su) = _step(False, opt, combiner="mean", U=U)(vals, slots, ids)
+    (vf, sf) = _step(True, opt, combiner="mean", U=U)(vals, slots, ids)
+    np.testing.assert_array_equal(np.asarray(vu), np.asarray(vf))
+    assert sorted(su) == sorted(sf)
+    for k in su:
+        np.testing.assert_array_equal(np.asarray(su[k]), np.asarray(sf[k]))
+    # the step actually trained: touched rows moved, untouched rows didn't.
+    touched = np.unique(np.asarray(ids)[np.asarray(ids) >= 0])
+    moved = np.flatnonzero(
+        np.any(np.asarray(vu) != np.asarray(vals), axis=1)
+    )
+    assert set(moved) == set(touched)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "sqrtn"])
+def test_backward_parity_bf16_sr(combiner):
+    """bf16 tables: the fused backward rounds with the same row-keyed SR
+    bit stream as the fallback (order-independent hash of (seed, row id,
+    column)), so updated values match BITWISE, not just statistically."""
+    rng = np.random.default_rng(2)
+    C, D, U = 32, 128, resolve_size(8, N)
+    opt = REGISTRY["adagrad"]()
+    vals, slots = _table(rng, C, D, jnp.bfloat16), _slots(opt, C, D)
+    ids = _ids(rng, 8)
+    (vu, su) = _step(False, opt, combiner=combiner, U=U)(vals, slots, ids)
+    (vf, sf) = _step(True, opt, combiner=combiner, U=U)(vals, slots, ids)
+    assert vu.dtype == vf.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(vu).view(np.uint16), np.asarray(vf).view(np.uint16)
+    )
+    for k in su:  # slots stay exact f32
+        np.testing.assert_array_equal(np.asarray(su[k]), np.asarray(sf[k]))
+    # different seed -> different rounding: SR is actually engaged.
+    (vu2, _) = _step(False, opt, combiner=combiner, U=U, seed=8)(
+        vals, slots, ids
+    )
+    assert not np.array_equal(
+        np.asarray(vu).view(np.uint16), np.asarray(vu2).view(np.uint16)
+    )
+
+
+def test_forward_edge_bags():
+    rng = np.random.default_rng(3)
+    C, D, U = 32, 128, resolve_size(8, N)
+    vals = _table(rng, C, D, jnp.float32)
+    ids = _ids(rng, 8)
+    for combiner in ("sum", "mean", "sqrtn"):
+        r = _fwd(True, combiner=combiner, U=U)(vals, ids)
+        # empty bag -> zeros under every combiner (denominator clamps at 1).
+        np.testing.assert_array_equal(np.asarray(r.out[0]), 0.0)
+    # all-duplicate bag under mean == the row itself.
+    r = _fwd(True, combiner="mean", U=U)(vals, ids)
+    np.testing.assert_array_equal(
+        np.asarray(r.out[1]), np.asarray(vals[int(ids[1, 0])], np.float32)
+    )
+
+
+def test_overflow_count_parity():
+    """Past the budget both paths must agree on HOW MANY distinct ids
+    overflowed (the budget contract), even though WHICH ids made the cut
+    is path-dependent."""
+    rng = np.random.default_rng(4)
+    C, D = 64, 128
+    U = resolve_size(4, N)  # tiny budget, wide vocab -> guaranteed spill
+    vals = _table(rng, C, D, jnp.float32)
+    ids = _ids(rng, 60, pads=False)
+    ru = _fwd(False, combiner="sum", U=U)(vals, ids)
+    rf = _fwd(True, combiner="sum", U=U)(vals, ids)
+    assert int(ru.overflow) == int(rf.overflow) > 0
+
+
+def test_non_fusable_optimizers_rejected():
+    # Scalar slots (adam_async) and non-[dim] slots (adagrad_decay's
+    # (1,)-wide decay counter) keep the split-phase apply.
+    assert not fl.fusable_optimizer(REGISTRY["adam_async"](), 128)
+    assert not fl.fusable_optimizer(REGISTRY["adagrad_decay"](), 128)
+    for name in ("sgd", "adagrad", "adam", "adamw", "ftrl"):
+        assert fl.fusable_optimizer(REGISTRY[name](), 128)
+
+
+def test_packed_slot_layout_rejected():
+    rng = np.random.default_rng(5)
+    C, D, U = 32, 128, resolve_size(8, N)
+    opt = REGISTRY["adagrad"]()
+    vals = _table(rng, C, D, jnp.float32)
+    ids = _ids(rng, 8)
+    res = _fwd(False, combiner="sum", U=U)(vals, ids)
+    g = jnp.ones((B, D), jnp.float32)
+    with pytest.raises(ValueError, match="packed slot"):
+        fl.fused_sparse_backward(
+            vals, {"accum": jnp.zeros((C // 2, 2 * D))}, g, ids, res, opt,
+            combiner="sum", use_pallas=False,
+        )
+
+
+def test_cpu_dispatch_falls_back_and_counts():
+    """On CPU without interpret=True the use_pallas request self-gates to
+    XLA (bitwise-identical result) and the rejection shows up on /metrics
+    as deeprec_pallas_fallback_total{reason=...} — the silent-fallback
+    observability contract."""
+    rng = np.random.default_rng(6)
+    C, D, U = 32, 128, resolve_size(8, N)
+    vals = _table(rng, C, D, jnp.float32)
+    ids = _ids(rng, 8)
+    a = _fwd(False, combiner="mean", U=U)(vals, ids)
+    b = jax.jit(lambda v, i: fl.fused_sparse_forward(
+        v, i, combiner="mean", unique_size=U, use_pallas=True,
+    ))(vals, ids)
+    np.testing.assert_array_equal(np.asarray(a.out), np.asarray(b.out))
+
+    from deeprec_tpu.obs.metrics import default_registry
+
+    text = default_registry().render_prometheus()
+    assert "deeprec_pallas_fallback_total" in text
+    assert 'kernel="fused_sparse_forward"' in text
+    assert 'reason="not_tpu"' in text
+
+
+def test_dedup_full_fallback_counter():
+    from deeprec_tpu.obs.metrics import default_registry
+    from deeprec_tpu.ops.dedup import log_full_fallback
+
+    log_full_fallback("fused_step_test_table", 4096)
+    text = default_registry().render_prometheus()
+    assert 'kernel="dedup"' in text and 'reason="no_budget"' in text
+
+
+def test_table_bag_forward_and_apply_wiring():
+    from deeprec_tpu.embedding.table import EmbeddingTable, TableConfig
+    from deeprec_tpu.ops.packed import pack_array
+    from deeprec_tpu.optim.apply import apply_bag_gradients, ensure_slots
+
+    rng = np.random.default_rng(7)
+    C, D = 64, 128
+    tbl = EmbeddingTable(TableConfig(name="t", dim=D, capacity=C))
+    opt = REGISTRY["adagrad"]()
+    state = ensure_slots(tbl, tbl.create(), opt)
+    state = state.replace(values=_table(rng, C, D, jnp.float32))
+    ids = _ids(rng, 8)
+    U = resolve_size(8, N)
+    res = tbl.bag_forward(state, ids, combiner="mean", unique_size=U,
+                          interpret=True)
+    g = jnp.ones((B, D), jnp.float32)
+    ns = apply_bag_gradients(tbl, state, opt, res, g, ids, combiner="mean",
+                             step=5, interpret=True)
+    touched = np.unique(np.asarray(ids)[np.asarray(ids) >= 0])
+    moved = np.flatnonzero(np.any(
+        np.asarray(ns.values) != np.asarray(state.values), axis=1
+    ))
+    assert set(moved) == set(touched)
+    # meta stamps mirror apply_gradients: version=step, dirty=1, touched
+    # rows only.
+    from deeprec_tpu.embedding.table import META_DIRTY, META_VERSION
+
+    meta = np.asarray(ns.meta)
+    assert all(meta[META_VERSION, r] == 5 for r in touched)
+    assert all(meta[META_DIRTY, r] == 1 for r in touched)
+    untouched = sorted(set(range(C)) - set(touched.tolist()))
+    assert all(meta[META_VERSION, r] != 5 for r in untouched)
+
+    # packed value layouts keep the split-phase path, loudly.
+    tiny = EmbeddingTable(TableConfig(name="p", dim=16, capacity=C))
+    st = ensure_slots(tiny, tiny.create(), opt)
+    st = st.replace(values=pack_array(st.values, 8))
+    with pytest.raises(NotImplementedError, match="packed"):
+        tiny.bag_forward(st, ids, combiner="mean", unique_size=U)
+    with pytest.raises(NotImplementedError, match="scalar"):
+        apply_bag_gradients(tbl, state, REGISTRY["adam_async"](), res, g,
+                            ids)

@@ -56,9 +56,21 @@ def main(argv=None):
     p.add_argument("--zipf", type=float, default=1.05,
                    help="--traffic: zipf exponent of the id stream")
     p.add_argument("--smoke", action="store_true",
-                   help="--traffic: tiny shapes/iters so CI just proves "
-                        "both arms compile and the diet removes scatters")
+                   help="--traffic/--fused-step: tiny shapes/iters so CI "
+                        "just proves both arms compile and the gates hold")
+    p.add_argument("--fused-step", action="store_true",
+                   help="single-pass fused sparse step (probe+gather+"
+                        "combine fwd, segment-sum+apply bwd; ops/"
+                        "fused_lookup.fused_sparse_*) vs the split-phase "
+                        "XLA path: step time, interpret-mode parity, and "
+                        "the modeled HBM bytes roofline.py --assert-fused "
+                        "gates on")
+    p.add_argument("--out", default=None,
+                   help="--fused-step: merge the record into this JSON "
+                        "file (BENCH_r07.json for the committed run)")
     args = p.parse_args(argv)
+    if args.fused_step:
+        return main_fused_step(args)
     if args.traffic:
         return main_traffic(args)
     if args.packed:
@@ -247,6 +259,167 @@ def main_traffic(args):
     if not args.smoke and speed < 1.0:
         print("WARNING: diet arm measured slower — investigate before "
               "trusting the removed ops on this backend", file=sys.stderr)
+
+
+def main_fused_step(args):
+    """Fused single-pass sparse step vs the split-phase XLA path.
+
+    Both arms run the SAME contract (fused_sparse_forward/backward): the
+    unfused arm takes the XLA fallback (hash_dedup -> gather -> combine;
+    expand -> segment-add -> gather/update/scatter), the fused arm the
+    Pallas kernel — interpret=True off-TPU, so off-TPU step times say
+    nothing about the TPU answer and the verdict here is (a) parity and
+    (b) the modeled HBM-byte ratio `roofline.py --assert-fused` gates on.
+    Both arms are jitted (the parity contract: matching XLA FMA
+    contraction — see docs/kernels.md) and timed interleaved best-of.
+    """
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeprec_tpu.data.synthetic import zipf_ids
+    from deeprec_tpu.ops import dedup
+    from deeprec_tpu.ops import fused_lookup as fl
+    from deeprec_tpu.ops.traffic import fused_sparse_step_traffic
+    from deeprec_tpu.optim import Adagrad
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"WARNING: running on {backend}; fused arm runs "
+              "interpret=True — times say nothing about TPU",
+              file=sys.stderr)
+    if args.smoke:
+        B, L, cap_log2, budget, iters, rounds = 32, 4, 9, 31, 2, 1
+    else:
+        B, L, cap_log2, budget, iters, rounds = 256, 4, 12, 127, 8, 3
+    D, C, N = args.dim, 1 << cap_log2, B * L
+    U = dedup.resolve_size(budget, N)
+    dt = jnp.dtype(args.dtype)
+    interp = backend != "tpu"
+    combiner = "mean"
+    opt = Adagrad(lr=0.05)
+    slot_widths = tuple(
+        shape[0] for name, (shape, _) in opt.slot_specs(D).items()
+    )
+
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(rng.normal(0, 0.05, (C, D)), dt)
+    slots = {
+        name: jnp.full((C, D), init, jnp.float32)
+        for name, (shape, init) in opt.slot_specs(D).items()
+    }
+    # vocab < budget so overflow == 0: with overflow, WHICH distinct ids
+    # make the budget is path-dependent (both answers valid), and the
+    # bitwise parity probe below would compare two different samples. The
+    # heavy duplication this produces is also the regime the dedup engine
+    # exists for (zipf-skewed bag features).
+    ids = np.asarray(zipf_ids(rng, max(budget // 2, 4), args.zipf, (B, L)))
+    ids[rng.random((B, L)) < 0.1] = -1  # pads, like real bag features
+    ids = jnp.asarray(ids, jnp.int32)
+
+    def make_fwd(fused):
+        def fn(v, i):
+            return fl.fused_sparse_forward(
+                v, i, combiner=combiner, unique_size=U,
+                interpret=fused and interp, use_pallas=fused,
+            )
+        return jax.jit(fn)  # noqa: DRT001 — built once per bench invocation, reused across the timed loop
+
+    def make_step(fused):
+        def fn(v, s, i):
+            res = fl.fused_sparse_forward(
+                v, i, combiner=combiner, unique_size=U,
+                interpret=fused and interp, use_pallas=fused,
+            )
+            g = res.out + 1.0  # any grad; keeps fwd in the timed graph
+            return fl.fused_sparse_backward(
+                v, s, g, i, res, opt, combiner=combiner, step=1, seed=7,
+                interpret=fused and interp, use_pallas=fused,
+            )
+        return jax.jit(fn)  # noqa: DRT001 — built once per bench invocation, reused across the timed loop
+
+    # --- parity probe (the oracle contract, both sides jitted) ---
+    out_u = make_fwd(False)(values, ids)
+    out_f = make_fwd(True)(values, ids)
+    fwd_ok = bool(jnp.array_equal(out_u.out, out_f.out))
+    (v_u, s_u), (v_f, s_f) = (
+        make_step(False)(values, slots, ids),
+        make_step(True)(values, slots, ids),
+    )
+    bwd_ok = bool(jnp.array_equal(v_u, v_f)) and all(
+        bool(jnp.array_equal(s_u[k], s_f[k])) for k in s_u
+    )
+    vb16 = values.astype(jnp.bfloat16)
+    vb_u, _ = make_step(False)(vb16, slots, ids)
+    vb_f, _ = make_step(True)(vb16, slots, ids)
+    sr_ok = bool(jnp.array_equal(vb_u, vb_f))
+
+    # --- timing: interleaved best-of, like --traffic ---
+    arms = {"unfused": make_step(False), "fused": make_step(True)}
+    for fn in arms.values():
+        bench(fn, values, slots, ids, iters=1, warmup=2)
+    times = {name: [] for name in arms}
+    for _ in range(rounds):
+        for name, fn in arms.items():
+            times[name].append(
+                bench(fn, values, slots, ids, iters=iters, warmup=1)
+            )
+    times = {name: min(ts) for name, ts in times.items()}
+
+    model = {
+        arm: fused_sparse_step_traffic(
+            positions=N, batch=B, unique=U, dim=D, value_bytes=dt.itemsize,
+            slot_widths=slot_widths, fused=(arm == "fused"),
+        )["hbm_bytes"]
+        for arm in ("unfused", "fused")
+    }
+    ratio = model["fused"] / model["unfused"]
+    for name in arms:
+        print(f"{name:10s} {times[name] * 1e3:9.3f} ms/step (best)   "
+              f"modeled {model[name] / 1e3:10.1f} KB/step/table")
+    print(
+        f"verdict[fused-step]: modeled HBM {ratio:.3f}x unfused "
+        f"(gate <= 0.6); parity fwd={fwd_ok} bwd={bwd_ok} bf16_sr={sr_ok} "
+        f"on {backend}" + (" (interpret)" if interp else "")
+    )
+    record = {
+        "fused_step": {
+            "shapes": {
+                "batch": B, "bag": L, "positions": N, "unique": U,
+                "dim": D, "capacity": C, "dtype": str(dt),
+                "optimizer": "adagrad", "combiner": combiner,
+                "slot_widths": list(slot_widths),
+            },
+            "arms": {n: {"ms": times[n] * 1e3} for n in arms},
+            "modeled": {
+                "unfused_hbm_bytes": model["unfused"],
+                "fused_hbm_bytes": model["fused"],
+                "ratio": ratio,
+            },
+            "parity": {
+                "forward_bitwise": fwd_ok,
+                "backward_bitwise": bwd_ok,
+                "bf16_sr_bitwise": sr_ok,
+            },
+            "backend": backend + ("/interpret" if interp else ""),
+        }
+    }
+    if args.out:
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged.update(record)
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"recorded -> {args.out}")
+    if not (fwd_ok and bwd_ok and sr_ok):
+        print("ERROR: fused step lost oracle parity vs the split-phase "
+              "path", file=sys.stderr)
+        sys.exit(1)
 
 
 def main_packed(args):

@@ -839,6 +839,89 @@ def assert_reuse(json_path: str, qps_factor: float,
     return rc
 
 
+def assert_fused(json_path: str, ratio_bound: float) -> int:
+    """CI gate for the fused sparse step (tools/bench_lookup.py
+    --fused-step JSON, ops/fused_lookup.fused_sparse_*):
+
+      * HBM diet — modeled fused-path bytes ≤ `ratio_bound`× the
+        split-phase path at the recorded bench shapes. Both arms are
+        RECOMPUTED here from the recorded shape params through
+        ops/traffic.fused_sparse_step_traffic and must equal the recorded
+        numbers — so neither the bench nor the model can drift away from
+        the other and silently keep passing.
+      * parity — the interpret-mode oracle probe (forward bitwise,
+        backward bitwise at fp32, seeded-SR bitwise at bf16, both sides
+        jitted) must have passed when the record was made.
+    """
+    import json
+
+    from deeprec_tpu.ops.traffic import fused_sparse_step_traffic
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    fs = rec.get("fused_step")
+    if not fs:
+        print(f"roofline: {json_path} has no 'fused_step' record "
+              "(run bench_lookup with --fused-step --out)", file=sys.stderr)
+        return 1
+    rc = 0
+    sh, modeled = fs.get("shapes", {}), fs.get("modeled", {})
+    try:
+        model = {
+            fused: fused_sparse_step_traffic(
+                positions=sh["positions"], batch=sh["batch"],
+                unique=sh["unique"], dim=sh["dim"],
+                value_bytes={"float32": 4, "bfloat16": 2}[sh["dtype"]],
+                slot_widths=tuple(sh["slot_widths"]), fused=fused,
+            )["hbm_bytes"]
+            for fused in (False, True)
+        }
+    except KeyError as e:
+        print(f"roofline: fused_step record is missing shape param {e} — "
+              "regenerate with the current bench_lookup", file=sys.stderr)
+        return 1
+    for arm, fused in (("unfused", False), ("fused", True)):
+        got = modeled.get(f"{arm}_hbm_bytes")
+        if got != model[fused]:
+            print(
+                f"roofline: fused gate FAILED — recorded {arm} model "
+                f"{got} B != recomputed {model[fused]} B at the recorded "
+                "shapes: bench and traffic model drifted apart",
+                file=sys.stderr,
+            )
+            rc = 1
+    ratio = model[True] / model[False]
+    if ratio > ratio_bound:
+        print(
+            f"roofline: fused gate FAILED — modeled fused HBM "
+            f"{ratio:.3f}× unfused exceeds the {ratio_bound:.2f}× bound "
+            f"(fused {model[True] / 1e3:.1f} vs unfused "
+            f"{model[False] / 1e3:.1f} KB/step at U={sh.get('unique')} "
+            f"N={sh.get('positions')} D={sh.get('dim')})", file=sys.stderr,
+        )
+        rc = 1
+    parity = fs.get("parity", {})
+    bad = [k for k in ("forward_bitwise", "backward_bitwise",
+                       "bf16_sr_bitwise") if parity.get(k) is not True]
+    if bad:
+        print(
+            f"roofline: fused gate FAILED — oracle parity flags {bad} "
+            f"not true in the record (backend {fs.get('backend')}): the "
+            "fused kernels no longer match the split-phase path",
+            file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: fused gate ok — modeled fused HBM {ratio:.3f}× "
+            f"unfused (bound {ratio_bound:.2f}×; fused "
+            f"{model[True] / 1e3:.1f} vs unfused {model[False] / 1e3:.1f} "
+            f"KB/step/table at the bench shapes), parity "
+            f"fwd/bwd/bf16-SR all bitwise on {fs.get('backend')}"
+        )
+    return rc
+
+
 def assert_obs(json_path: str, tol: float) -> int:
     """CI gate for the telemetry plane (bench.py / tools/bench_serving.py
     'obs_overhead' section): both arms (instrumented vs DEEPREC_OBS=off)
@@ -1095,6 +1178,19 @@ def main(argv=None):
     p.add_argument("--reuse-hit-floor", type=float, default=0.5,
                    help="required steady-window answer-cache hit rate "
                         "(default 0.5 — the zipf head must be resident)")
+    p.add_argument("--assert-fused", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the fused-sparse-"
+                        "step record written by tools/bench_lookup.py "
+                        "--fused-step --out (modeled fused-path HBM "
+                        "bytes ≤ --fused-ratio × the split-phase path at "
+                        "the recorded shapes, model recomputed here so "
+                        "bench and ops/traffic.py can't drift apart, and "
+                        "the interpret-mode oracle parity flags all "
+                        "true; CI smoke gate)")
+    p.add_argument("--fused-ratio", type=float, default=0.6,
+                   help="required fused/unfused modeled HBM-byte bound "
+                        "(default 0.6 — the no-[U,D]-round-trip, "
+                        "no-[N,D]-expansion diet)")
     p.add_argument("--assert-obs", metavar="BENCH_JSON", default=None,
                    help="don't run the step: validate the telemetry-plane "
                         "cost recorded in a bench.py or bench_serving.py "
@@ -1152,6 +1248,8 @@ def main(argv=None):
     if args.assert_reuse:
         sys.exit(assert_reuse(args.assert_reuse, args.reuse_qps_factor,
                               args.reuse_hit_floor))
+    if args.assert_fused:
+        sys.exit(assert_fused(args.assert_fused, args.fused_ratio))
     if args.assert_obs:
         sys.exit(assert_obs(args.assert_obs, args.obs_tol))
     if args.assert_guard:
