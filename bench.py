@@ -1041,6 +1041,180 @@ def _ckpt_report():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _tier_paging_report():
+    """Overlapped tier paging arm (round 20): rotated-zipf stream sized to
+    force demotion, hot set rotated each maintain window so demoted keys
+    reappear MID-window. Two arms on the identical stream — paging off
+    (promotes only at maintain cadence) vs paging on (TierPrefetcher
+    gathers + dispatch-boundary folds) — recording the fresh-init
+    (optimizer-state-loss) rate, fold bytes, training-thread stall, step
+    time, and steady-state fold compiles. Gated by tools/roofline.py
+    --assert-tier: loss rate >=10x lower, 0 steady compiles, fold stall
+    <= the async-round stall, step time parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeprec_tpu.analysis import trace_guard
+    from deeprec_tpu.config import EmbeddingVariableOption, StorageOption
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    B = 256 if smoke else 512
+    warm_steps = 10
+    timed_steps = 24 if smoke else 48
+    maintain_every = 8
+    capacity = 1 << 11
+    vocab = 8000
+    window = 3000  # uniques live in a rotating zipf window of this width
+    rotate = 500   # window shift per maintain window
+
+    steps = warm_steps + timed_steps
+    rng = np.random.default_rng(7)
+
+    def zipf_ids(n):
+        # a=1.1: flat-tailed — each window's distinct set overruns the
+        # demote watermark, and the cold tail keeps re-appearing so the
+        # off arm pays fresh re-inits mid-window
+        z = rng.zipf(1.1, size=n)
+        return (z - 1) % window
+
+    batches = []
+    for t in range(steps):
+        base = (t // maintain_every) * rotate
+        cats = [
+            ((zipf_ids(B) + base) % vocab).astype(np.int32)
+            for _ in range(2)
+        ]
+        batches.append({
+            "label": rng.integers(0, 2, B).astype(np.float32),
+            "I1": rng.normal(size=(B, 1)).astype(np.float32),
+            "I2": rng.normal(size=(B, 1)).astype(np.float32),
+            "C1": cats[0], "C2": cats[1],
+        })
+
+    def build():
+        ev = EmbeddingVariableOption(
+            storage=StorageOption(storage_type="hbm_dram")
+        )
+        model = WDL(emb_dim=16, capacity=capacity, hidden=(32,),
+                    num_cat=2, num_dense=2, ev=ev)
+        tr = Trainer(model, Adagrad(lr=0.1))
+        return tr, tr.init(0)
+
+    def resident_keys(tr, cache):
+        """Tier-resident key set, recomputed only when a boundary/fold
+        changed the stores (revision-keyed — the same discipline the row
+        cache uses)."""
+        rev = sum(mt._tier_rev for mt in getattr(tr, "_tiers", {}).values())
+        if cache.get("rev") != rev:
+            keys = set()
+            for mt in getattr(tr, "_tiers", {}).values():
+                if mt.host is not None:
+                    keys.update(int(k) for k in mt.host.export()[0])
+                if mt.disk is not None:
+                    keys.update(int(k) for k in mt.disk.index)
+            cache["rev"], cache["keys"] = rev, keys
+        return cache["keys"]
+
+    def run_arm(paging):
+        tr, st = build()
+        pager = tr.enable_tier_paging(depth=16, chunk=256) if paging else None
+        res_cache = {}
+        loss_touches = positions = 0
+        step_ms = []
+        steady_compiles = 0
+        warmed = False
+        try:
+            src = tr.stage(iter(batches), depth=2)
+            for i, b in enumerate(src):
+                timed = i >= warm_steps
+                if paging and timed and not warmed:
+                    # pre-compile the fold programs: the first demote (and
+                    # so the first real fold) may land inside the timed
+                    # window, and a cold compile is not a steady-state one
+                    tr.warm_tier_folds(st)
+                    warmed = True
+                if pager is not None:
+                    pager.drain(10.0)
+                if paging:
+                    # guard ONLY the fold path: the fixed-chunk compile
+                    # contract is the fold program's, not maintain's
+                    # (demote shapes recompile at their own cadence)
+                    if timed:
+                        with trace_guard(
+                            max_compiles=None, note="tier paging fold"
+                        ) as g:
+                            st, _ = tr.fold_tier_prefetch(st)
+                        steady_compiles += g.compiles
+                    else:
+                        st, _ = tr.fold_tier_prefetch(st)
+                # step timing excludes the fold — fold cost is reported
+                # separately as fold_stall_ms
+                t0 = time.perf_counter()
+                st, mets = tr.train_step(st, b)
+                jax.block_until_ready(mets["loss"])
+                if timed:
+                    step_ms.append((time.perf_counter() - t0) * 1e3)
+                # fresh-init accounting AFTER the folds this step saw:
+                # a batch position hitting a tier-resident key trains from
+                # a re-initialized row — lost optimizer state
+                if timed:
+                    ids = np.concatenate([
+                        np.asarray(jax.device_get(b["C1"])),
+                        np.asarray(jax.device_get(b["C2"])),
+                    ]).astype(np.int64)
+                    res = resident_keys(tr, res_cache)
+                    if res:
+                        loss_touches += int(np.isin(
+                            ids, np.fromiter(res, np.int64, len(res))
+                        ).sum())
+                    positions += ids.size
+                if (i + 1) % maintain_every == 0:
+                    st, _ = tr.maintain(st, tier_async=True)
+            for mt in getattr(tr, "_tiers", {}).values():
+                mt._settle()  # join any in-flight round before reading stalls
+            rec = {
+                "fresh_init_rate": round(loss_touches / max(positions, 1), 6),
+                "loss_touches": loss_touches,
+                "positions": positions,
+                "step_ms": round(float(np.mean(step_ms)), 3),
+                "sync_stall_ms": round(tr.tier_stall_ms(), 3),
+            }
+            if paging:
+                stats = tr.tier_paging_stats()
+                rec.update(
+                    fold_stall_ms=round(stats["fold_stall_ms"], 3),
+                    folded_rows=int(stats["folded_rows"]),
+                    fold_bytes=int(stats["fold_bytes"]),
+                    gather_errors=int(stats["gather_errors"]),
+                    dropped_batches=int(stats["dropped_batches"]),
+                    steady_compiles=steady_compiles,
+                )
+            return rec
+        finally:
+            if pager is not None:
+                tr.close_tier_paging()
+
+    off = run_arm(paging=False)
+    on = run_arm(paging=True)
+    r0, r1 = off["fresh_init_rate"], on["fresh_init_rate"]
+    return {
+        "stream": {
+            "batch": B, "timed_steps": timed_steps, "vocab": vocab,
+            "zipf_window": window, "rotate_per_window": rotate,
+            "maintain_every": maintain_every, "capacity": capacity,
+        },
+        "off": off,
+        "on": on,
+        # the headline: optimizer-state-loss suppression from paging
+        "loss_factor": round(r0 / r1, 2) if r1 > 0 else None,
+        "step_time_ratio": round(on["step_ms"] / max(off["step_ms"], 1e-9), 4),
+    }
+
+
 def _profile_phases(trainer, batches):
     """Host-timed per-phase breakdown (training/profiler.py): jitted
     sub-programs isolate the sparse phases, deltas attribute the rest."""
@@ -1366,6 +1540,14 @@ def workload():
     traffic = _traffic_report(trainer, budget_mode, dedup_stats)
     obs_overhead = _obs_overhead_report(trainer, batches, B, smoke)
     ckpt = _ckpt_report()
+    # Overlapped tier paging arm (round 20): rotated-zipf demotion stream,
+    # paging off vs on — fresh-init (state-loss) rate, fold bytes, stalls,
+    # step parity. Gated in CI by tools/roofline.py --assert-tier.
+    tier_paging = (
+        _tier_paging_report()
+        if os.environ.get("BENCH_TIER", "off") != "off"
+        else None
+    )
     # In-step pipelining grid: measured off/lookahead(/chunked) arms +
     # the overlap model + overlap efficiency (round 11). "off" skips it.
     pipeline_arg = os.environ.get("BENCH_PIPELINE", "grid")
@@ -1460,6 +1642,11 @@ def workload():
                 # the incremental-save transfer diet (dirty-compacted vs
                 # full-table device->host bytes).
                 "ckpt": ckpt,
+                # Overlapped tier paging (round 20): rotated-zipf paging
+                # off/on arms — fresh-init (optimizer-state-loss) rate,
+                # fold bytes/stall, step parity, steady fold compiles —
+                # gated by tools/roofline.py --assert-tier in CI smoke.
+                **({"tier_paging": tier_paging} if tier_paging else {}),
                 # In-step pipelining (round 11): per-mode K-scan step time,
                 # phase decomposition (route / dense / other), the overlap
                 # model and its efficiency vs measurement — gated by
@@ -1535,6 +1722,13 @@ def main():
                         "two-tier exchange (+ nested lookahead K-scan) "
                         "with the per-tier wire model (JSON 'mesh'); "
                         "'1d'/'2d' run one side; 'off' (default) skips")
+    p.add_argument("--tier-paging", action="store_true",
+                   default=os.environ.get("BENCH_TIER", "off") != "off",
+                   help="add the overlapped tier paging arm: rotated-zipf "
+                        "stream forcing demotion mid-window, paging off vs "
+                        "on — fresh-init (state-loss) rate, fold bytes, "
+                        "training-thread stall and step-time parity (JSON "
+                        "'tier_paging'); gated by roofline --assert-tier")
     p.add_argument("--profile", action="store_true",
                    help="add a per-phase step breakdown (lookup / sparse "
                         "apply / dense+overhead, training/profiler.py) to "
@@ -1556,6 +1750,7 @@ def main():
     os.environ["BENCH_PIPELINE"] = str(args.pipeline_mode)
     os.environ["BENCH_PLACEMENT"] = str(args.placement)
     os.environ["BENCH_MESH"] = str(args.mesh)
+    os.environ["BENCH_TIER"] = "on" if args.tier_paging else "off"
     if args.profile:
         os.environ["BENCH_PROFILE"] = "1"
     if args.smoke:

@@ -89,7 +89,7 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 
 echo "== bench (CPU smoke; real numbers come from TPU) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu BENCH_FORCED=1 BENCH_SMOKE=1 \
-    BENCH_PIPELINE=grid python bench.py --placement --mesh --smoke \
+    BENCH_PIPELINE=grid python bench.py --placement --mesh --tier-paging --smoke \
     | tee /tmp/deeprec_bench_smoke.out
 tail -n 1 /tmp/deeprec_bench_smoke.out > /tmp/deeprec_bench_smoke.json
 
@@ -108,6 +108,11 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
 echo "== pod-scale 2-D mesh gate (hier inter-tier wire diet vs flat a2a, bitwise loss parity, zero overflow/steady compiles, nested K-scan bound) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-hierarchy /tmp/deeprec_bench_smoke.json
+
+echo "== overlapped tier paging gate (fresh-init loss ≥10× lower with paging on, 0 steady fold compiles, fold stall ≤ sync stall; step tol loose on single-core CI, --overlap-tol precedent) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-tier /tmp/deeprec_bench_smoke.json \
+    --tier-step-tol 0.5
 
 echo "== steady-state retrace gate (compiles inside timed windows fail the smoke) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu \

@@ -15,6 +15,11 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_a2a.py::test_a2a_matches_allgather_and_local \
   tests/test_checkpoint.py::test_full_save_restore_roundtrip \
   tests/test_multi_tier.py \
+  tests/test_tier_paging.py::test_fold_loses_to_newer_device_row_bit_exact \
+  tests/test_tier_paging.py::test_fold_inserts_missing_keys_ahead_of_lookup \
+  tests/test_tier_paging.py::test_pump_killed_mid_gather_leaves_stores_consistent \
+  tests/test_tier_paging.py::test_lookup_with_fallback_dedup_parity \
+  tests/test_tier_paging.py::test_row_cache_never_crosses_a_sync_boundary_that_changed_the_row \
   tests/test_serving.py::test_http_server_end_to_end \
   tests/test_serving.py::test_protobuf_wire_end_to_end \
   tests/test_processor_cabi.py \

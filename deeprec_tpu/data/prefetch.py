@@ -33,6 +33,7 @@ class Prefetcher:
         transform: Optional[Callable] = None,
         on_consume: Optional[Callable] = None,
         sharding=None,
+        peek: Optional[Callable] = None,
     ):
         """on_consume: invoked (in the CONSUMER thread) each time a batch is
         delivered from __next__. The ring runs `depth` batches ahead of the
@@ -48,7 +49,15 @@ class Prefetcher:
         mesh inside the step). Pass the mesh sharding (or use
         Trainer.stage, whose transform already places mesh-wide) so the
         staged transfer lands split across devices. Ignored when an
-        explicit `transform` is given."""
+        explicit `transform` is given.
+
+        peek: invoked (in the PRODUCER thread) on each RAW host batch
+        before `transform` runs — i.e. while the batch still sits in the
+        host queue, before any `device_put`. This is the tier-paging tap
+        (TierPrefetcher.observe probes upcoming ids against the host/disk
+        key indexes while the batch waits). Must be cheap and must not
+        raise: an exception here surfaces to the consumer as a reader
+        error."""
         self.source = iter(source)
         self.depth = max(1, depth)
         if transform is None:
@@ -59,6 +68,7 @@ class Prefetcher:
             )
         self.transform = transform
         self.on_consume = on_consume
+        self.peek = peek
         self.q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -81,6 +91,8 @@ class Prefetcher:
             for batch in self.source:
                 if self._stop.is_set():
                     return
+                if self.peek is not None:
+                    self.peek(batch)
                 # device_put returns immediately; the transfer overlaps the
                 # consumer's compute.
                 if not self._put(self.transform(batch)):
@@ -128,9 +140,9 @@ class Prefetcher:
 
 
 def staged(source, depth: int = 2, transform=None,
-           on_consume=None, sharding=None) -> Prefetcher:
+           on_consume=None, sharding=None, peek=None) -> Prefetcher:
     """tf.staged analog: `for batch in staged(reader): ...`. Pass
     `sharding` when feeding a sharded trainer without a custom transform
     so batches land mesh-split instead of on device 0."""
     return Prefetcher(source, depth=depth, transform=transform,
-                      on_consume=on_consume, sharding=sharding)
+                      on_consume=on_consume, sharding=sharding, peek=peek)

@@ -35,7 +35,9 @@ import numpy as np
 from deeprec_tpu.analysis.annotations import not_thread_safe
 from deeprec_tpu.config import StorageType
 from deeprec_tpu.embedding.table import (
+    META_DIRTY,
     META_FREQ,
+    META_VERSION,
     EmbeddingTable,
     TableState,
     empty_key,
@@ -315,10 +317,91 @@ def _demote_extract_jit(table, size: int, state: TableState, n_out):
 
 @jax.jit
 def _tier_snapshot_jit(state: TableState):
-    """Fresh-buffer copies of (keys, freq) for the background promote scan
-    — the live leaves may be donated by the next train dispatch while the
-    worker is still reading."""
-    return jnp.copy(state.keys), jnp.copy(state.freq)
+    """Fresh-buffer copies of (keys, freq, version) for the background
+    promote scan — the live leaves may be donated by the next train
+    dispatch while the worker is still reading. `version` (last-touched
+    step per row) drives the promote-scan diet: only rows touched since
+    the previous round can have re-entered the device while a tier copy
+    exists."""
+    return jnp.copy(state.keys), jnp.copy(state.freq), jnp.copy(state.version)
+
+
+@_ft.partial(jax.jit, static_argnums=(0,))
+def _fold_chunk_jit(table, state: TableState, keys_p, rows_p, freqs_p,
+                    vers_p):
+    """Compiled fold half of the paging engine: resolve one fixed-size
+    chunk of prefetched packed tier rows against the CURRENT device table
+    — inserting keys not yet resident (the whole point of paging: the
+    row lands BEFORE the lookup that would have fresh-initialized it) —
+    and scatter the survivors' values + per-row optimizer slots + meta in
+    one program.
+
+    Revalidation is the PR 4 ambiguous-key rule applied at fold time: an
+    already-resident key folds only while the current device freq has not
+    passed the tier copy's freq (`freq_now <= host_freq` — a freshly
+    re-created row). A row that trained past its tier copy while the
+    gather was in flight is never clobbered. Freshly INSERTED keys always
+    fold (nothing trained there) and take the tier copy's freq/version
+    outright — the import_rows restore semantics — plus the dirty bit, so
+    an incremental checkpoint between the fold and the key's first lookup
+    still saves the row. A key that fails to insert (probe-chain
+    exhaustion) is skipped whole: its tier copy stays for the next scan.
+
+    The chunk size is part of the trace signature, so a FIXED chunk
+    compiles exactly once per table — the `import_rows(chunk=)` compile
+    discipline: 0 steady-state compiles no matter how candidate counts
+    vary (short chunks arrive sentinel-padded; sentinel entries never
+    insert)."""
+    from deeprec_tpu.embedding.table import probe_jit
+    from deeprec_tpu.ops.packed import scatter_rows_any
+    from deeprec_tpu.optim.sparse import SCALAR_PREFIX
+
+    cfg = table.cfg
+    C = state.capacity
+    sent = jnp.asarray(empty_key(cfg), state.keys.dtype)
+    real = keys_p != sent
+    new_keys, slot_ix, created, _failed = probe_jit(
+        table, state.keys, keys_p, real
+    )
+    state = state.replace(keys=new_keys)
+    present = (slot_ix >= 0) & real
+    created = created & present
+    freq_now = jnp.where(
+        created, 0, state.freq[jnp.clip(slot_ix, 0)]
+    )
+    refreshed = present & (freq_now <= freqs_p)
+    ix = jnp.where(refreshed, slot_ix, -1).astype(jnp.int32)  # -1 = skip
+    D = cfg.dim
+    state = state.replace(
+        values=scatter_rows_any(state.values, ix, rows_p[:, :D], C)
+    )
+    off = D
+    slots = dict(state.slots)
+    for name in sorted(slots):
+        if name.startswith(SCALAR_PREFIX):
+            continue  # per-table scalars are not per-row state
+        w = int(np.prod(slots[name].shape)) // C
+        slots[name] = scatter_rows_any(slots[name], ix, rows_p[:, off:off + w], C)
+        off += w
+    state = state.replace(slots=slots)
+    # meta: re-created rows MERGE freq (device touches since re-creation
+    # stay counted); inserted rows take the tier copy's freq/version and
+    # raise the dirty bit (nothing on device knew them before)
+    meta = state.meta
+    add_ix = jnp.where(refreshed & ~created, slot_ix, C)
+    meta = meta.at[META_FREQ, add_ix].add(
+        freqs_p.astype(jnp.int32), mode="drop"
+    )
+    new_ix = jnp.where(refreshed & created, slot_ix, C)
+    meta = meta.at[META_FREQ, new_ix].set(
+        freqs_p.astype(jnp.int32), mode="drop"
+    )
+    meta = meta.at[META_VERSION, new_ix].set(
+        vers_p.astype(jnp.int32), mode="drop"
+    )
+    meta = meta.at[META_DIRTY, new_ix].set(1, mode="drop")
+    state = state.replace(meta=meta)
+    return state, refreshed, present
 
 
 class MultiTierTable:
@@ -337,6 +420,8 @@ class MultiTierTable:
         low_watermark: float = 0.6,
         storage_path: Optional[str] = None,
         slot_fills: Optional[tuple] = None,
+        scan_diet: bool = True,
+        row_cache_bytes: int = 0,
     ):
         cfg = table.cfg
         self.table = table
@@ -369,6 +454,58 @@ class MultiTierTable:
         self._pending: Optional[dict] = None
         self.sync_stall_ms: float = 0.0
         self.on_io = None
+        # Tier-store serialization for the paging engine: the background
+        # TierPrefetcher gather (probe_rows) may run CONCURRENTLY with the
+        # tier-IO worker round or a training-thread boundary, and
+        # HostKV/DiskKV have no internal synchronization. The RLock
+        # serializes every store touch; the worker holds it for its whole
+        # round (gathers simply land before or after the round), while the
+        # training thread only ever takes it after _settle() — so it never
+        # waits behind long IO, only behind one in-flight gather.
+        self._store_lock = threading.RLock()
+        # Tier revision: bumped at every boundary that can change store
+        # contents (sync/sync_async/drain-with-erase/fold-erase/load).
+        # It version-keys BOTH reuse surfaces: in-flight prefetch packages
+        # (fold_candidates drops a package gathered at an older revision)
+        # and the serving row cache below (the PR 17 discipline — a cached
+        # row can never be served across a boundary that changed it).
+        self._tier_rev = 0
+        # Gather generation: the subset of revision bumps that make an
+        # in-flight gather UNSAFE — boundaries that WRITE or replace tier
+        # rows (demote at sync/sync_async, load). Pure erasures (fold /
+        # promote erase) bump only `_tier_rev`: a package gathered before
+        # an erase still holds bit-identical row content, and the fold's
+        # device-freq revalidation rejects anything that trained since —
+        # so folds don't retire each other's upcoming packages.
+        self._gather_gen = 0
+        # Fold erases deferred while a background round owns the stores;
+        # drained (under the lock) at the next boundary, BEFORE the next
+        # promote scan — which keeps the scan-diet invariant intact.
+        self._pending_erase: list = []
+        # Promote-scan diet: host∩device keys only arise when a demoted
+        # key is looked up again (demotion removed it from the device), so
+        # every promote candidate was TOUCHED since its demotion. Scanning
+        # only rows with version >= the previous round's step — plus the
+        # retry set of async/fold-ambiguous keys whose tier copy was
+        # deliberately kept — is bit-identical to the full device-key scan.
+        self.scan_diet = scan_diet
+        self._scan_watermark: Optional[int] = None  # None = full scan
+        self._retry_keys: set = set()
+        # Paging-engine accounting (bench.py --tier-paging reads these).
+        self.fold_stall_ms: float = 0.0
+        self.folded_rows: int = 0
+        self.fold_bytes: int = 0
+        # Serving row cache: byte-bounded LRU over the D-wide value slice
+        # of host/disk-resident rows, keyed (id, tier revision). Off by
+        # default — lookup_with_fallback behaves exactly as before.
+        self.row_cache = None
+        if row_cache_bytes > 0:
+            from deeprec_tpu.serving.reuse import ReuseCache
+
+            self.row_cache = ReuseCache(
+                int(row_cache_bytes), f"tier_rows_{cfg.name}",
+                version_fn=lambda: self._tier_rev,
+            )
         # obs plane: per-table tier movement counters + occupancy gauges
         # (table label = config name, a bounded set). No-op singletons
         # when DEEPREC_OBS=off.
@@ -390,6 +527,24 @@ class MultiTierTable:
         self._m_stall = reg.gauge(
             "deeprec_tier_sync_stall_ms",
             "cumulative caller-side tier sync stall", lab)
+        # Paging-engine counters (DRT007: the only label is the table
+        # name, a bounded set fixed at construction).
+        self._m_pf_probed = reg.counter(
+            "deeprec_tier_prefetch_probed",
+            "unique upcoming ids probed against the tier stores", lab)
+        self._m_pf_hits = reg.counter(
+            "deeprec_tier_prefetch_hits",
+            "probed ids found resident in the host/disk tiers", lab)
+        self._m_pf_folds = reg.counter(
+            "deeprec_tier_prefetch_folds",
+            "prefetched tier rows folded into the device table", lab)
+        self._m_pf_stale = reg.counter(
+            "deeprec_tier_prefetch_stale_dropped",
+            "prefetched rows dropped by fold revalidation "
+            "(stale revision or device row trained past the copy)", lab)
+        self._m_pf_lag = reg.gauge(
+            "deeprec_tier_prefetch_fold_lag_ms",
+            "gather-to-fold latency of the last folded package", lab)
 
     def _publish_obs(self, stats: "TierStats") -> None:
         """Fold one sync round's TierStats into the obs plane — values
@@ -504,8 +659,13 @@ class MultiTierTable:
         # sync()'s own promote scan rediscovers anything the round found
         # (the worker never erases), so pending candidates simply drop.
         self._settle()
+        # A dropped pending round's candidates were found by a scan whose
+        # watermark already advanced; rediscovering them needs a FULL scan
+        # this round, not the diet window.
+        full_scan = self._pending is not None
         self._pending = None
         stats.spilled += self._take_spilled()
+        self._drain_pending_erase()
         self._ensure_tiers(state)
         keys = np.asarray(state.keys)
         occ = keys != empty_key(self.table.cfg)
@@ -513,23 +673,33 @@ class MultiTierTable:
         version = np.asarray(state.version)
 
         # -------- promote: device rows re-created while a host (or disk)
-        # copy exists
-        dev_keys = keys[occ].astype(np.int64)
+        # copy exists. The scan diet restricts the probe to rows touched
+        # since the last round (see __init__) — bit-identical outcomes,
+        # O(window) native calls instead of O(device keys).
+        occ_nz = np.nonzero(occ)[0]
+        dev_keys_all = keys[occ].astype(np.int64)
+        scan = self._scan_mask(dev_keys_all, version[occ],
+                               self._take_retry(), self._scan_watermark,
+                               full_scan)
+        dev_keys = dev_keys_all[scan]
         if len(dev_keys):
-            h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
-            if self.disk is not None and (~found).any():
-                # second-chance from the disk tier (disk hits re-enter the
-                # device directly; their disk record is dropped)
-                miss = ~found
-                d_vals, d_freq, d_ver, d_found = self.disk.get(dev_keys[miss])
-                if d_found.any():
-                    mix = np.nonzero(miss)[0][d_found]
-                    h_vals[mix] = d_vals[d_found]
-                    h_freq[mix] = d_freq[d_found]
-                    h_ver[mix] = d_ver[d_found]
-                    found[mix] = True
-                    self.disk.erase(dev_keys[mix])
-            dev_ix = np.nonzero(occ)[0][found]
+            with self._store_lock:
+                h_vals, h_freq, h_ver, found = self.host.get(dev_keys)
+                if self.disk is not None and (~found).any():
+                    # second-chance from the disk tier (disk hits re-enter
+                    # the device directly; their disk record is dropped)
+                    miss = ~found
+                    d_vals, d_freq, d_ver, d_found = self.disk.get(
+                        dev_keys[miss]
+                    )
+                    if d_found.any():
+                        mix = np.nonzero(miss)[0][d_found]
+                        h_vals[mix] = d_vals[d_found]
+                        h_freq[mix] = d_freq[d_found]
+                        h_ver[mix] = d_ver[d_found]
+                        found[mix] = True
+                        self.disk.erase(dev_keys[mix])
+            dev_ix = occ_nz[scan][found]
             if dev_ix.size:
                 hf = h_freq[found]
                 hv = h_vals[found]
@@ -552,7 +722,8 @@ class MultiTierTable:
                     )
                     stats.promoted = int(refreshed.sum())
                 # either way the host copy is now stale: drop it
-                self.host.erase(dev_keys[found])
+                with self._store_lock:
+                    self.host.erase(dev_keys[found])
 
         # -------- demote: bring occupancy under the low watermark
         C = state.capacity
@@ -567,12 +738,10 @@ class MultiTierTable:
                 order = np.argsort(freq[occ_ix])  # coldest first
             out_ix = occ_ix[order[:n_out]]
             out_keys = keys[out_ix].astype(np.int64)
-            self.host.put(
-                out_keys,
-                self._pack_rows(state, out_ix),
-                freq[out_ix],
-                version[out_ix],
-            )
+            packed = self._pack_rows(state, out_ix)
+            with self._store_lock:
+                self.host.put(out_keys, packed, freq[out_ix],
+                              version[out_ix])
             keep = np.ones(C, bool)
             keep[out_ix] = False
             state = self.table.rebuild(
@@ -596,21 +765,29 @@ class MultiTierTable:
             and self.host_capacity
             and len(self.host) > self.host_capacity
         ):
-            n_spill = len(self.host) - self.host_capacity
-            ks, vs, fs, vers = self.host.export()
-            order = (
-                np.argsort(vers) if self.cache_strategy == "lru"
-                else np.argsort(fs)
-            )
-            out = order[:n_spill]
-            self.disk.put(ks[out], vs[out], fs[out], vers[out])
-            self.host.erase(ks[out])
+            with self._store_lock:
+                n_spill = len(self.host) - self.host_capacity
+                ks, vs, fs, vers = self.host.export()
+                order = (
+                    np.argsort(vers) if self.cache_strategy == "lru"
+                    else np.argsort(fs)
+                )
+                out = order[:n_spill]
+                self.disk.put(ks[out], vs[out], fs[out], vers[out])
+                self.host.erase(ks[out])
             stats.spilled += int(n_spill)
 
         stats.host_size = len(self.host)
         stats.device_size = int(self.table.size(state))
         if self.disk is not None:
             stats.disk_size = len(self.disk)
+        # Boundary bookkeeping: the stores changed — retire in-flight
+        # prefetch packages and cached serving rows, advance the diet
+        # window (every promote candidate up to `step` was just resolved:
+        # sync() erases every found tier copy, so no retry set survives).
+        self._tier_rev += 1
+        self._gather_gen += 1  # demotes WROTE rows — gathers are unsafe
+        self._scan_watermark = int(step)
         self._publish_obs(stats)
         return state, stats
 
@@ -638,6 +815,7 @@ class MultiTierTable:
         self._ensure_tiers(state)
         state, stats.promoted = self._apply_pending(state)
         stats.spilled = self._take_spilled()  # last round's host->disk moves
+        self._drain_pending_erase()  # fold erases deferred past the round
 
         C = state.capacity
         live = int(self.table.size(state))  # the one host-side scalar read
@@ -666,8 +844,20 @@ class MultiTierTable:
         stats.device_size = live - stats.demoted
         if self.disk is not None:
             stats.disk_size = len(self.disk)
+        # Boundary bookkeeping BEFORE the round starts: the worker is
+        # about to mutate the stores, so any prefetch package gathered at
+        # the old revision must die at its fold, and the diet window for
+        # the round's scan is [previous watermark, step). The retry set is
+        # consumed here on the training thread — the worker only reads
+        # its own argument copy.
+        self._tier_rev += 1
+        self._gather_gen += 1  # the round demotes — gathers are unsafe
+        retry = self._take_retry()
+        watermark = self._scan_watermark
+        self._scan_watermark = int(step)
         self._worker = threading.Thread(
-            target=self._worker_main, args=(demote_pkg, snap), daemon=True,
+            target=self._worker_main, args=(demote_pkg, snap, retry,
+                                            watermark), daemon=True,
             name=f"tier-io-{self.table.cfg.name}-{step}",
         )
         self._worker.start()
@@ -699,6 +889,66 @@ class MultiTierTable:
         n, self._spilled_bg = getattr(self, "_spilled_bg", 0), 0
         return n
 
+    # ------------------------------------------------- paging coordination
+
+    def _take_retry(self) -> np.ndarray:
+        """Consume the ambiguous-key retry set (training thread only):
+        keys whose tier copy was deliberately kept because the device row
+        trained past it mid-flight. They re-enter exactly one scan — the
+        one that consumes them — and re-add themselves if still ambiguous,
+        so the set can never grow without bound."""
+        taken, self._retry_keys = self._retry_keys, set()
+        return np.fromiter(taken, np.int64, len(taken))
+
+    def _scan_mask(self, occ_keys: np.ndarray, occ_version: np.ndarray,
+                   retry: np.ndarray, watermark: Optional[int],
+                   full: bool) -> np.ndarray:
+        """Promote-scan diet filter over the occupied device keys: rows
+        touched since `watermark` (version is the last-touched step,
+        stamped at lookup) plus the retry set. Correctness: a tier copy
+        for a device-resident key only exists because the key was looked
+        up again AFTER its demotion — every candidate is window-touched
+        or explicitly carried in `retry`."""
+        if full or not self.scan_diet or watermark is None:
+            return np.ones(len(occ_keys), bool)
+        m = occ_version >= watermark
+        if len(retry):
+            m |= np.isin(occ_keys, retry)
+        return m
+
+    def _erase_tier_rows(self, keys: np.ndarray,
+                         disk_keys: np.ndarray) -> None:
+        """Erase folded (promoted) tier copies. While a background IO
+        round owns the stores the erase is deferred to the next boundary
+        — the training thread must never block behind the round's IO; the
+        deferral keeps the copy visible a little longer, which fold
+        revalidation already tolerates (a re-gathered copy loses to the
+        now-fresher device row)."""
+        if self._worker is not None and self._worker.is_alive():
+            self._pending_erase.append((keys, disk_keys))
+            return
+        with self._store_lock:
+            self.host.erase(keys)
+            if self.disk is not None and len(disk_keys):
+                self.disk.erase(disk_keys)
+        self._tier_rev += 1
+
+    def _drain_pending_erase(self) -> None:
+        """Apply fold erases deferred past a background round. Runs at
+        every boundary AFTER _settle()/_apply_pending and BEFORE the next
+        promote scan, so a folded row's lingering tier copy never
+        survives into the next round's candidate set."""
+        if not self._pending_erase:
+            return
+        pend, self._pending_erase = self._pending_erase, []
+        hk = np.concatenate([p[0] for p in pend])
+        dk = np.concatenate([p[1] for p in pend])
+        with self._store_lock:
+            self.host.erase(hk)
+            if self.disk is not None and len(dk):
+                self.disk.erase(dk)
+        self._tier_rev += 1
+
     def drain(self, state: TableState) -> tuple[TableState, TierStats]:
         """Finish the in-flight background round and apply its promotions
         now (checkpoint/serving boundaries). No-op when idle."""
@@ -706,6 +956,7 @@ class MultiTierTable:
         stats = TierStats()
         state, stats.promoted = self._apply_pending(state)
         stats.spilled = self._take_spilled()
+        self._drain_pending_erase()
         stats.host_size = len(self.host) if self.host is not None else 0
         stats.device_size = int(self.table.size(state))
         if self.disk is not None:
@@ -714,70 +965,79 @@ class MultiTierTable:
         self._publish_obs(stats)
         return state, stats
 
-    def _worker_main(self, demote_pkg, snap) -> None:
+    def _worker_main(self, demote_pkg, snap, retry, watermark) -> None:
         """Background IO round: put demoted rows, scan for promotion
         candidates against the post-rebuild key snapshot, spill host
         overflow. READ-only on promotion sources — erasure happens at
-        apply time on the training thread."""
+        apply time on the training thread. Holds `_store_lock` for the
+        whole round: the only other store toucher while a round is in
+        flight is the TierPrefetcher gather (probe_rows), which simply
+        lands before or after the round; the training thread never takes
+        the lock without `_settle()` first."""
         try:
             from deeprec_tpu.obs import trace as obs_trace
 
             t0w = time.time()
             if self.on_io is not None:
                 self.on_io()  # test seam (ordering-based overlap tests)
-            if demote_pkg is not None:
-                ext, n_out = demote_pkg
-                self.host.put(  # noqa: DRT004 — worker owns the tier stores until _settle(); every other path drains first
-                    np.asarray(ext["keys"])[:n_out].astype(np.int64),
-                    np.asarray(ext["rows"])[:n_out],
-                    np.asarray(ext["freqs"])[:n_out],
-                    np.asarray(ext["versions"])[:n_out],
-                )
-            keys_snap = np.asarray(snap[0])
-            freq_snap = np.asarray(snap[1])
-            occ = keys_snap != empty_key(self.table.cfg)
-            dev_keys = keys_snap[occ].astype(np.int64)
-            pending = None
-            if len(dev_keys):
-                h_vals, h_freq, h_ver, found = self.host.get(dev_keys)  # noqa: DRT004 — read-only promote scan under the same round-exclusive ownership
-                from_disk = np.zeros(len(dev_keys), bool)
-                if self.disk is not None and (~found).any():
-                    miss = ~found
-                    d_vals, d_freq, d_ver, d_found = self.disk.get(  # noqa: DRT004 — disk second-chance read, round-exclusive ownership
-                        dev_keys[miss]
+            with self._store_lock:
+                if demote_pkg is not None:
+                    ext, n_out = demote_pkg
+                    self.host.put(  # noqa: DRT004 — worker owns the tier stores until _settle(); every other path drains first
+                        np.asarray(ext["keys"])[:n_out].astype(np.int64),
+                        np.asarray(ext["rows"])[:n_out],
+                        np.asarray(ext["freqs"])[:n_out],
+                        np.asarray(ext["versions"])[:n_out],
                     )
-                    if d_found.any():
-                        mix = np.nonzero(miss)[0][d_found]
-                        h_vals[mix] = d_vals[d_found]
-                        h_freq[mix] = d_freq[d_found]
-                        h_ver[mix] = d_ver[d_found]
-                        found[mix] = True
-                        from_disk[mix] = True
-                if found.any():
-                    pending = {
-                        "keys": dev_keys[found],
-                        "rows": h_vals[found],
-                        "freqs": h_freq[found],
-                        "snap_freq": freq_snap[occ][found],
-                        "from_disk": from_disk[found],
-                    }
-            self._pending = pending
-            # spill: bounded host tier overflows to the disk tier
-            if (
-                self.disk is not None
-                and self.host_capacity
-                and len(self.host) > self.host_capacity
-            ):
-                n_spill = len(self.host) - self.host_capacity
-                ks, vs, fs, vers = self.host.export()  # noqa: DRT004 — spill export, round-exclusive ownership
-                order = (
-                    np.argsort(vers) if self.cache_strategy == "lru"
-                    else np.argsort(fs)
-                )
-                out = order[:n_spill]
-                self.disk.put(ks[out], vs[out], fs[out], vers[out])  # noqa: DRT004 — spill write, round-exclusive ownership
-                self.host.erase(ks[out])  # noqa: DRT004 — spill erase, round-exclusive ownership
-                self._spilled_bg = int(n_spill)
+                keys_snap = np.asarray(snap[0])
+                freq_snap = np.asarray(snap[1])
+                ver_snap = np.asarray(snap[2])  # noqa: DRT002 — snapshot copy read on the BACKGROUND worker, off the training thread by design
+                occ = keys_snap != empty_key(self.table.cfg)
+                dev_all = keys_snap[occ].astype(np.int64)
+                scan = self._scan_mask(dev_all, ver_snap[occ], retry,
+                                       watermark, False)
+                dev_keys = dev_all[scan]
+                pending = None
+                if len(dev_keys):
+                    h_vals, h_freq, h_ver, found = self.host.get(dev_keys)  # noqa: DRT004 — read-only promote scan under the same round-exclusive ownership
+                    from_disk = np.zeros(len(dev_keys), bool)
+                    if self.disk is not None and (~found).any():
+                        miss = ~found
+                        d_vals, d_freq, d_ver, d_found = self.disk.get(  # noqa: DRT004 — disk second-chance read, round-exclusive ownership
+                            dev_keys[miss]
+                        )
+                        if d_found.any():
+                            mix = np.nonzero(miss)[0][d_found]
+                            h_vals[mix] = d_vals[d_found]
+                            h_freq[mix] = d_freq[d_found]
+                            h_ver[mix] = d_ver[d_found]
+                            found[mix] = True
+                            from_disk[mix] = True
+                    if found.any():
+                        pending = {
+                            "keys": dev_keys[found],
+                            "rows": h_vals[found],
+                            "freqs": h_freq[found],
+                            "snap_freq": freq_snap[occ][scan][found],
+                            "from_disk": from_disk[found],
+                        }
+                self._pending = pending
+                # spill: bounded host tier overflows to the disk tier
+                if (
+                    self.disk is not None
+                    and self.host_capacity
+                    and len(self.host) > self.host_capacity
+                ):
+                    n_spill = len(self.host) - self.host_capacity
+                    ks, vs, fs, vers = self.host.export()  # noqa: DRT004 — spill export, round-exclusive ownership
+                    order = (
+                        np.argsort(vers) if self.cache_strategy == "lru"
+                        else np.argsort(fs)
+                    )
+                    out = order[:n_spill]
+                    self.disk.put(ks[out], vs[out], fs[out], vers[out])  # noqa: DRT004 — spill write, round-exclusive ownership
+                    self.host.erase(ks[out])  # noqa: DRT004 — spill erase, round-exclusive ownership
+                    self._spilled_bg = int(n_spill)
             # obs timeline span: one background tier-IO round (demote put
             # + promote scan + spill) — the "tier worker" track of the
             # training timeline. No-op unless DEEPREC_TRACE is set.
@@ -836,38 +1096,257 @@ class MultiTierTable:
             )
         drop = refreshed | stale
         if drop.any():
-            self.host.erase(keys[drop])
-            if self.disk is not None and (r["from_disk"] & drop).any():
-                self.disk.erase(keys[r["from_disk"] & drop])
+            with self._store_lock:
+                self.host.erase(keys[drop])
+                if self.disk is not None and (r["from_disk"] & drop).any():
+                    self.disk.erase(keys[r["from_disk"] & drop])
+            self._tier_rev += 1
+        # Ambiguous keys (device trained past the tier copy DURING the
+        # overlap) keep their copy for the next round — the scan diet
+        # would otherwise never revisit them once their touch window
+        # passes, so they ride the retry set into exactly the next scan.
+        ambiguous = present & ~drop
+        if ambiguous.any():
+            self._retry_keys.update(int(x) for x in keys[ambiguous])
         return state, k
+
+    # ------------------------------------------------------ paging engine
+
+    def probe_rows(self, ids) -> Optional[dict]:
+        """Gather half of the demand-driven paging engine, called from the
+        TierPrefetcher thread while upcoming batches still sit in the host
+        prefetch queue: dedup the batch ids and gather any host/disk-
+        resident packed rows (values + slots + freq). READ-only on the
+        tier stores — a gather killed at any point leaves them untouched —
+        and serialized against the tier-IO worker and training-thread
+        boundaries by `_store_lock`.
+
+        Returns None before anything was ever demoted or when nothing
+        hit; otherwise a candidate package stamped with the gather-time
+        GATHER GENERATION (`_gather_gen`). `fold_candidates` drops the
+        whole package when a row-WRITING boundary (demote, load) ran in
+        between — the PR 17 version-keyed reuse discipline applied to
+        in-flight gathers. Pure erasures don't retire packages: their
+        content is still bit-identical and fold revalidation rejects
+        anything the device trained past."""
+        if self.host is None and self.disk is None:
+            return None
+        uniq = np.unique(np.asarray(ids).reshape(-1).astype(np.int64))  # noqa: DRT002 — host batch ids on the PREFETCH thread, pre-device_put by design
+        if not len(uniq):
+            return None
+        t0 = time.perf_counter()
+        with self._store_lock:
+            rev = self._gather_gen
+            if self.host is not None:
+                vals, freqs, vers, found = self.host.get(uniq)  # noqa: DRT004 — read-only gather under _store_lock; mutators hold the same lock
+            else:
+                vals = np.zeros((len(uniq), self.disk.dim), np.float32)
+                freqs = np.zeros(len(uniq), np.int32)
+                vers = np.zeros(len(uniq), np.int32)
+                found = np.zeros(len(uniq), bool)
+            vers = np.asarray(vers, np.int32).copy()  # noqa: DRT002 — host store metadata on the prefetch thread, no device sync
+            from_disk = np.zeros(len(uniq), bool)
+            if self.disk is not None and (~found).any():
+                miss = ~found
+                d_vals, d_freq, d_ver, d_found = self.disk.get(uniq[miss])  # noqa: DRT004 — read-only disk gather under _store_lock
+                if d_found.any():
+                    mix = np.nonzero(miss)[0][d_found]
+                    vals[mix] = d_vals[d_found]
+                    freqs[mix] = d_freq[d_found]
+                    vers[mix] = d_ver[d_found]
+                    found[mix] = True
+                    from_disk[mix] = True
+        self._m_pf_probed.inc(len(uniq))
+        hits = int(found.sum())  # noqa: DRT002 — numpy reduction on the prefetch thread, no device sync
+        if not hits:
+            return None
+        self._m_pf_hits.inc(hits)
+        return {
+            "keys": uniq[found],
+            "rows": vals[found],
+            "freqs": freqs[found],
+            "vers": vers[found],
+            "from_disk": from_disk[found],
+            "rev": rev,
+            "ts": t0,
+        }
+
+    def fold_candidates(self, state: TableState, cand: dict,
+                        chunk: int = 256) -> tuple[TableState, int, int]:
+        """Fold a gathered candidate package into the device table at a
+        dispatch boundary (training thread). Candidates run through
+        `_fold_chunk_jit` in fixed-size sentinel-padded chunks — ONE
+        compiled shape per table, 0 steady-state compiles — where keys
+        not yet device-resident are INSERTED with the tier copy (the row
+        lands before the lookup that would have fresh-initialized it)
+        and already-resident keys are revalidated against the CURRENT
+        device freq before their values/slots scatter and freq merges
+        (see _fold_chunk_jit).
+
+        Folded rows' tier copies are erased (deferred past an in-flight
+        background round); rows whose device copy trained past the tier
+        copy are dropped and their keys ride the retry set into the next
+        promote scan. A package gathered at an older gather generation is
+        dropped whole — a demote/load WROTE rows under it. Returns
+        (state, folded, dropped)."""
+        t0 = time.perf_counter()
+        n_all = len(cand["keys"])
+        if cand["rev"] != self._gather_gen:
+            # A demote/load wrote rows since the gather. The package's
+            # CONTENT is dead, but its keys are a ready-made probe list:
+            # re-gather them at the current generation (cheap numpy reads)
+            # instead of losing the fold — unless a background round owns
+            # the stores (the re-probe would stall the training thread for
+            # the whole round; those keys come back via the post-boundary
+            # requeue instead).
+            idle = self._worker is None or not self._worker.is_alive()
+            fresh = self.probe_rows(cand["keys"]) if idle else None
+            if fresh is None:
+                self._m_pf_stale.inc(n_all)
+                return state, 0, n_all
+            self._m_pf_stale.inc(n_all - len(fresh["keys"]))
+            cand = fresh
+            n_all = len(cand["keys"])
+        self._ensure_tiers(state)
+        keys = np.asarray(cand["keys"], np.int64)
+        rows = np.asarray(cand["rows"], np.float32)
+        freqs = np.asarray(cand["freqs"], np.int32)
+        vers = np.asarray(
+            cand.get("vers", np.zeros(n_all, np.int32)), np.int32
+        )
+        from_disk = np.asarray(cand["from_disk"], bool)
+        sent = empty_key(self.table.cfg)
+        kdtype = np.dtype(state.keys.dtype)
+        folded = dropped = 0
+        erase_h, erase_d = [], []
+        for off in range(0, n_all, chunk):
+            n = min(chunk, n_all - off)
+            kp = np.full((chunk,), sent, kdtype)
+            kp[:n] = keys[off:off + n]
+            rp = np.zeros((chunk, rows.shape[1]), np.float32)
+            rp[:n] = rows[off:off + n]
+            fp = np.zeros((chunk,), np.int32)
+            fp[:n] = freqs[off:off + n]
+            vp = np.zeros((chunk,), np.int32)
+            vp[:n] = vers[off:off + n]
+            state, refreshed, present = _fold_chunk_jit(
+                self.table, state, jnp.asarray(kp), jnp.asarray(rp),
+                jnp.asarray(fp), jnp.asarray(vp),
+            )
+            refreshed = np.asarray(refreshed)[:n]
+            present = np.asarray(present)[:n]
+            folded += int(refreshed.sum())
+            ambiguous = present & ~refreshed
+            dropped += int(ambiguous.sum())
+            if ambiguous.any():
+                self._retry_keys.update(
+                    int(x) for x in keys[off:off + n][ambiguous]
+                )
+            if refreshed.any():
+                ck = keys[off:off + n]
+                erase_h.append(ck[refreshed])
+                erase_d.append(
+                    ck[refreshed & from_disk[off:off + n]]
+                )
+        if folded:
+            self._erase_tier_rows(
+                np.concatenate(erase_h), np.concatenate(erase_d)
+            )
+            self._m_pf_folds.inc(folded)
+            self._m_promoted.inc(folded)
+            self.folded_rows += folded
+            self.fold_bytes += folded * rows.shape[1] * 4
+        if dropped:
+            self._m_pf_stale.inc(dropped)
+        self._m_pf_lag.set((t0 - cand["ts"]) * 1e3)
+        self.fold_stall_ms += (time.perf_counter() - t0) * 1e3
+        return state, folded, dropped
+
+    def warm_fold(self, state: TableState, chunk: int = 256) -> None:
+        """Pre-compile the fixed-chunk fold program for this table (warm
+        phases — bench / serving bring-up): run one ALL-SENTINEL chunk
+        through `_fold_chunk_jit`, a bit-exact no-op on the state (no key
+        is real, so nothing inserts, scatters, or touches meta). After
+        this, the first REAL fold pays zero compiles even when the first
+        demote only lands inside the measured steady-state window."""
+        self._ensure_tiers(state)
+        sent = empty_key(self.table.cfg)
+        kp = np.full((chunk,), sent, np.dtype(state.keys.dtype))
+        rp = np.zeros((chunk, self._packed_dim), np.float32)
+        zp = np.zeros((chunk,), np.int32)
+        _fold_chunk_jit(
+            self.table, state, jnp.asarray(kp), jnp.asarray(rp),
+            jnp.asarray(zp), jnp.asarray(zp),
+        )
 
     # ------------------------------------------------------------- serving
 
     def lookup_with_fallback(self, state: TableState, ids) -> jnp.ndarray:
         """Readonly lookup that also consults the host tier (then the disk
         tier) for misses — the serving-path equivalent of HbmDram's
-        CopyEmbeddingsFromCPUToGPU."""
+        CopyEmbeddingsFromCPUToGPU.
+
+        Ids are deduplicated before the native probe (one `get` over the
+        uniques + inverse expand — a repeat-heavy bag stream pays one
+        native call per DISTINCT row, not per position), and when the
+        table was built with `row_cache_bytes` a byte-bounded LRU serves
+        hot demoted rows without touching the stores at all. Cache
+        entries are keyed (id, tier revision) — every boundary that can
+        change a tier row bumps the revision, so a cached row is never
+        served across a sync boundary that changed it. Both paths are
+        bit-identical to the pre-dedup lookup."""
         self._settle()  # the worker owns the tier stores while a round runs
         emb = np.array(self.table.lookup_readonly(state, ids))  # writable copy
         if self.host is None and self.disk is None:  # nothing ever demoted
             return jnp.asarray(emb)
         D = self.table.cfg.dim
         flat_ids = np.asarray(ids).reshape(-1).astype(np.int64)
-        if self.host is not None:
-            h_vals, _, _, found = self.host.get(flat_ids)
-        else:
-            h_vals = np.zeros((len(flat_ids), self.disk.dim), np.float32)
-            found = np.zeros(len(flat_ids), bool)
-        if self.disk is not None and (~found).any():
-            miss = ~found
-            d_vals, _, _, d_found = self.disk.get(flat_ids[miss])
-            if d_found.any():
-                mix = np.nonzero(miss)[0][d_found]
-                h_vals[mix] = d_vals[d_found]
-                found[mix] = True
-        if found.any():
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        n = len(uniq)
+        u_vals = np.zeros((n, D), np.float32)
+        u_found = np.zeros(n, bool)
+        need = np.ones(n, bool)
+        cache = self.row_cache
+        if cache is not None:
+            for j in range(n):
+                hit = cache.get_current(
+                    int(uniq[j]).to_bytes(8, "little", signed=True)
+                )
+                if hit is not None:
+                    u_vals[j] = hit[0]
+                    u_found[j] = True
+                    need[j] = False
+        probe = uniq[need]
+        if len(probe):
+            with self._store_lock:
+                rev = self._tier_rev
+                if self.host is not None:
+                    h_vals, _, _, found = self.host.get(probe)
+                else:
+                    h_vals = np.zeros((len(probe), self.disk.dim), np.float32)
+                    found = np.zeros(len(probe), bool)
+                if self.disk is not None and (~found).any():
+                    miss = ~found
+                    d_vals, _, _, d_found = self.disk.get(probe[miss])
+                    if d_found.any():
+                        mix = np.nonzero(miss)[0][d_found]
+                        h_vals[mix] = d_vals[d_found]
+                        found[mix] = True
+            if found.any():
+                pix = np.nonzero(need)[0][found]
+                rows = h_vals[found][:, :D]  # packed rows: values first
+                u_vals[pix] = rows
+                u_found[pix] = True
+                if cache is not None:
+                    for j, v in zip(pix, rows):
+                        cache.put(
+                            int(uniq[j]).to_bytes(8, "little", signed=True),
+                            rev, np.array(v),
+                        )
+        if u_found.any():
             emb = emb.reshape(len(flat_ids), -1)
-            emb[found] = h_vals[found][:, :D]  # packed rows: values first
+            sel = u_found[inv]
+            emb[sel] = u_vals[inv[sel]]
             emb = emb.reshape(*np.asarray(ids).shape, -1)
         return jnp.asarray(emb)
 
@@ -876,10 +1355,11 @@ class MultiTierTable:
     def spill(self, path: Optional[str] = None) -> None:
         """Persist the host tier (and the disk tier's index)."""
         self._settle()  # never snapshot mid-round
-        if self.host is not None:
-            self.host.save(path or self.storage_path or "host_tier.bin")
-        if self.disk is not None:
-            self.disk.save()
+        with self._store_lock:
+            if self.host is not None:
+                self.host.save(path or self.storage_path or "host_tier.bin")
+            if self.disk is not None:
+                self.disk.save()
 
     def load(self, path: Optional[str] = None) -> None:
         """Restore spilled tiers into a fresh instance (the serving flow —
@@ -892,13 +1372,21 @@ class MultiTierTable:
             width = _spill_dim(p)
         except FileNotFoundError:
             width = None  # nothing was ever spilled: empty tier
-        if width is not None:
-            if self.host is None:
-                self.host = HostKV(
-                    dim=width, initial_capacity=self.table.cfg.capacity
-                )
-            self.host.load(p)
-        if self.disk is None and self.storage_path:
-            ssd = self.storage_path + ".ssd"
-            if os.path.exists(ssd) and os.path.getsize(ssd) >= 8:
-                self.disk = DiskKV(ssd)  # width from the log header
+        with self._store_lock:
+            if width is not None:
+                if self.host is None:
+                    self.host = HostKV(
+                        dim=width, initial_capacity=self.table.cfg.capacity
+                    )
+                self.host.load(p)
+            if self.disk is None and self.storage_path:
+                ssd = self.storage_path + ".ssd"
+                if os.path.exists(ssd) and os.path.getsize(ssd) >= 8:
+                    self.disk = DiskKV(ssd)  # width from the log header
+        # Fresh store contents: retire cached rows / in-flight gathers and
+        # force the next promote scan to run full (the touch history the
+        # diet relies on did not travel with the spill).
+        self._tier_rev += 1
+        self._gather_gen += 1
+        self._scan_watermark = None
+        self._retry_keys = set()

@@ -1021,9 +1021,11 @@ class Trainer:
             return source
         from deeprec_tpu.data.prefetch import Prefetcher
 
+        pager = getattr(self, "_tier_pager", None)
         return Prefetcher(iter(source), depth=depth,
                           transform=self.stage_batch,
-                          on_consume=on_consume)
+                          on_consume=on_consume,
+                          peek=pager.observe if pager is not None else None)
 
     # --------------------------------------------------------------- public
 
@@ -1475,6 +1477,13 @@ class Trainer:
                     ts = self._restack(members, lead)
             tables[bname] = ts
             report[bname] = rep
+        pager = getattr(self, "_tier_pager", None)
+        if pager is not None:
+            # The demotes above retired the pump's in-flight gathers and
+            # may have demoted rows the staged batches are about to look
+            # up — re-probe the pipeline window so the next folds still
+            # land before those lookups.
+            pager.requeue_recent()
         return (
             TrainState(step=state.step, tables=tables, dense=state.dense,
                        opt_state=state.opt_state),
@@ -1508,6 +1517,183 @@ class Trainer:
         return sum(
             mt.sync_stall_ms for mt in getattr(self, "_tiers", {}).values()
         )
+
+    # ------------------------------------------------ overlapped tier paging
+
+    def enable_tier_paging(self, *, depth: int = 4, chunk: int = 256,
+                           max_pending: int = 8192):
+        """Turn on demand-driven tier paging: a background `TierPrefetcher`
+        probes each staged batch's ids (Prefetcher `peek`, before
+        `device_put`) against every multi-tier member's host/disk key
+        indexes and gathers resident packed rows off the training thread;
+        `fold_tier_prefetch(state)` folds them back into the device tables
+        at dispatch boundaries through one fixed-chunk compiled promote
+        program. Call BEFORE `stage()` — the pager taps the pipeline there.
+        Returns the pager (close() it when the run ends; the thread is a
+        daemon either way). docs/multi-tier-storage.md#overlapped-tier-paging.
+
+        chunk: fold chunk size — rounded up to a power of two by
+        `fold_candidates`, one compile per (table, chunk) then 0
+        steady-state compiles."""
+        if hasattr(self, "num_shards"):
+            # Sharded multi-tier is pinned to uniform routing
+            # (docs/placement.md); paging the per-shard members from the
+            # base pump needs shard-aware id routing — not wired yet.
+            raise NotImplementedError(
+                "tier paging is wired for the base Trainer; sharded "
+                "multi-tier runs keep maintain(tier_async=True)"
+            )
+        from deeprec_tpu.embedding.tier_prefetch import TierPrefetcher
+
+        specs = []
+        for bname, b in self.bundles.items():
+            if b.table.cfg.ev.storage.storage_type.value not in (
+                "hbm_dram", "hbm_dram_ssd"
+            ):
+                continue
+            if b.stacked:
+                specs.extend(
+                    ((bname, (k,)), (f.name,))
+                    for k, f in enumerate(b.features)
+                )
+            else:
+                specs.append(
+                    ((bname, ()), tuple(f.name for f in b.features))
+                )
+        if not specs:
+            raise ValueError(
+                "no multi-tier bundle (storage_type hbm_dram / "
+                "hbm_dram_ssd) — nothing to page"
+            )
+
+        def extract(batch, specs=tuple(specs)):
+            import numpy as np
+
+            out = {}
+            for key, names in specs:
+                arrs = [
+                    np.asarray(batch[n]).reshape(-1)
+                    for n in names if n in batch
+                ]
+                if arrs:
+                    out[key] = (
+                        np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+                    )
+            return out
+
+        self._tier_chunk = int(chunk)
+        # resolve via _tiers.get, never _multi_tier_for: the pump must not
+        # CREATE tiers (a member that never demoted has nothing resident).
+        self._tier_pager = TierPrefetcher(
+            resolve=lambda key: getattr(self, "_tiers", {}).get(key),
+            extract=extract, depth=depth, max_pending=max_pending,
+        )
+        return self._tier_pager
+
+    def warm_tier_folds(self, state: TrainState) -> None:
+        """Pre-compile every multi-tier member's fixed-chunk fold program
+        (an all-sentinel no-op fold per member). Call at the end of a
+        warmup phase: the steady-state window then pays zero fold
+        compiles even when the first demote lands inside it."""
+        import numpy as np
+
+        chunk = getattr(self, "_tier_chunk", 256)
+        for bname, b in self.bundles.items():
+            if b.table.cfg.ev.storage.storage_type.value not in (
+                "hbm_dram", "hbm_dram_ssd"
+            ):
+                continue
+            ts = state.tables[bname]
+            lead = self._bundle_lead_dims(b)
+            idxs = list(np.ndindex(*lead)) if lead else [()]
+            for i in idxs:
+                member = jax.tree.map(lambda a, i=i: a[i] if i else a, ts)
+                self._multi_tier_for(b, i).warm_fold(member, chunk=chunk)
+
+    def fold_tier_prefetch(self, state: TrainState):
+        """Dispatch-boundary half of tier paging: fold every buffered
+        candidate package into its member table (revalidated against
+        current device freq — a row that trained past its tier copy is
+        dropped to the retry set, never clobbered). Host-side, call where
+        you'd call maintain() but at a finer cadence (every K-step
+        dispatch is fine: with nothing buffered it is two dict reads).
+        Returns (new_state, report) with per-bundle folded/dropped counts;
+        `state` comes back unchanged when nothing folds."""
+        import numpy as np
+
+        pager = getattr(self, "_tier_pager", None)
+        if pager is None:
+            return state, {}
+        keys = pager.pending_keys()
+        if not keys:
+            return state, {}
+        by_bundle: Dict[str, list] = {}
+        for key in keys:
+            by_bundle.setdefault(key[0], []).append(key)
+        tables = dict(state.tables)
+        report: Dict[str, Dict[str, int]] = {}
+        changed = False
+        for bname, bkeys in by_bundle.items():
+            b = self.bundles.get(bname)
+            if b is None:
+                continue
+            ts = tables[bname]
+            lead = self._bundle_lead_dims(b)
+            idxs = list(np.ndindex(*lead)) if lead else [()]
+            members = [
+                jax.tree.map(lambda a, i=i: a[i] if i else a, ts)
+                for i in idxs
+            ]
+            folded = dropped = 0
+            touched = False
+            for key in bkeys:
+                idx = key[1]
+                if idx not in idxs:
+                    continue
+                cand = pager.take(key)
+                if cand is None:
+                    continue
+                mt = self._multi_tier_for(b, idx)
+                k = idxs.index(idx)
+                members[k], f, d = mt.fold_candidates(
+                    members[k], cand,
+                    chunk=getattr(self, "_tier_chunk", 256),
+                )
+                folded += f
+                dropped += d
+                touched = touched or bool(f)
+            if touched:
+                tables[bname] = self._restack(members, lead)
+                changed = True
+            if folded or dropped:
+                report[bname] = {"folded": folded, "dropped": dropped}
+        if not changed:
+            return state, report
+        return (
+            TrainState(step=state.step, tables=tables, dense=state.dense,
+                       opt_state=state.opt_state),
+            report,
+        )
+
+    def tier_paging_stats(self) -> Dict[str, float]:
+        """Pager + fold accounting for bench/eval reports: pump drop/error
+        counters plus the per-tier fold totals (rows, bytes, training-
+        thread stall ms — `fold_stall_ms` is the paging analog of the
+        `sync_stall_ms` that `tier_stall_ms()` sums)."""
+        pager = getattr(self, "_tier_pager", None)
+        out: Dict[str, float] = dict(pager.stats()) if pager else {}
+        tiers = getattr(self, "_tiers", {}).values()
+        out["folded_rows"] = sum(mt.folded_rows for mt in tiers)
+        out["fold_bytes"] = sum(mt.fold_bytes for mt in tiers)
+        out["fold_stall_ms"] = sum(mt.fold_stall_ms for mt in tiers)
+        return out
+
+    def close_tier_paging(self) -> None:
+        """Stop the pager pump (safe mid-gather — probes are read-only)."""
+        pager = getattr(self, "_tier_pager", None)
+        if pager is not None:
+            pager.close()
+            self._tier_pager = None
 
     def _restack(self, members, lead):
         """Reassemble member states into the bundle's stacked layout."""

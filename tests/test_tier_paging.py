@@ -1,0 +1,621 @@
+"""Overlapped tier paging: background probe/gather of upcoming batch ids
+against the host/disk tiers, folded into the device table at dispatch
+boundaries through one fixed-chunk compiled promote program — plus the
+machinery that rides along (promote-scan diet, lookup_with_fallback dedup
++ row cache). docs/multi-tier-storage.md#overlapped-tier-paging."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeprec_tpu import (
+    EmbeddingTable,
+    EmbeddingVariableOption,
+    StorageOption,
+    TableConfig,
+)
+from deeprec_tpu.config import StorageType
+from deeprec_tpu.embedding.multi_tier import MultiTierTable
+from deeprec_tpu.embedding.tier_prefetch import TierPrefetcher
+from deeprec_tpu.ops.packed import scatter_rows_any, unpack_array
+
+
+def make(capacity=64, **kw):
+    cfg = TableConfig(
+        name="mt",
+        dim=4,
+        capacity=capacity,
+        ev=EmbeddingVariableOption(
+            storage=StorageOption(storage_type=StorageType.HBM_DRAM)
+        ),
+    )
+    t = EmbeddingTable(cfg)
+    return t, MultiTierTable(t, high_watermark=0.75, low_watermark=0.5, **kw)
+
+
+def demote_marked(t, mt, n=52, value=3.25):
+    """Insert n keys, write `value` everywhere, demote past the watermark.
+    Returns (state, demoted key list)."""
+    s = t.create()
+    s, res = t.lookup_unique(s, jnp.arange(n, dtype=jnp.int32), step=0)
+    s = t.scatter_update(
+        s, res.slot_ix, jnp.full_like(res.embeddings, value), mask=res.valid
+    )
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0
+    occ = np.asarray(t.occupied(s))
+    on_dev = set(np.asarray(s.keys)[occ].tolist())
+    return s, [k for k in range(n) if k not in on_dev]
+
+
+# ------------------------------------------------------ probe / fold core
+
+
+def test_probe_rows_dedups_and_stamps_revision():
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    dup_ids = np.array(demoted[:5] * 3 + [9999, 10000], np.int64)
+    cand = mt.probe_rows(dup_ids)
+    # one candidate per DISTINCT resident id, misses filtered
+    assert sorted(cand["keys"].tolist()) == sorted(demoted[:5])
+    assert cand["rev"] == mt._gather_gen
+    assert cand["rows"].shape[1] >= t.cfg.dim  # packed: values (+ slots)
+    # nothing resident -> no package
+    assert mt.probe_rows(np.array([9999], np.int64)) is None
+
+
+def test_fold_restores_values_and_optimizer_slots_bit_exact():
+    """A fold must be indistinguishable from a maintain-path promote:
+    values AND packed per-row optimizer slots restore bit-exact."""
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.optim.apply import ensure_slots
+
+    t, _ = make()
+    opt = Adagrad(lr=0.1, initial_accumulator_value=0.1)
+    fills = tuple(
+        (name, init) for name, (_, init) in opt.slot_specs(t.cfg.dim).items()
+    )
+    mt = MultiTierTable(t, high_watermark=0.75, low_watermark=0.5,
+                        slot_fills=fills)
+    s = ensure_slots(t, t.create(), opt)
+    s, res = t.lookup_unique(s, jnp.arange(52, dtype=jnp.int32), step=0)
+    keys = np.asarray(s.keys)
+    occ0 = np.asarray(t.occupied(s))
+    slot7 = int(np.nonzero(keys == 7)[0][0])
+    put = jnp.asarray([slot7], jnp.int32)
+    D = t.cfg.dim
+    s = s.replace(
+        values=scatter_rows_any(
+            s.values, put, jnp.full((1, D), 2.5), s.capacity
+        ),
+        slots={
+            **s.slots,
+            "accum": scatter_rows_any(
+                s.slots["accum"], put, jnp.full((1, D), 7.75), s.capacity
+            ),
+        },
+    ).replace_meta(
+        freq=jnp.where(jnp.asarray(occ0), 5, s.freq).at[slot7].set(1),
+    )
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 0
+    occ = np.asarray(t.occupied(s))
+    assert 7 not in set(np.asarray(s.keys)[occ].tolist())
+
+    cand = mt.probe_rows(np.array([7], np.int64))
+    assert cand is not None and cand["keys"].tolist() == [7]
+    # key reappears on device as a fresh insert (init values/slots)...
+    s, _ = t.lookup_unique(s, jnp.asarray([7], jnp.int32), step=2)
+    # ...and the fold restores the tier copy over it
+    s, folded, dropped = mt.fold_candidates(s, cand, chunk=16)
+    assert (folded, dropped) == (1, 0)
+    keys = np.asarray(s.keys)
+    occ = np.asarray(t.occupied(s))
+    slot7 = int(np.nonzero((keys == 7) & occ)[0][0])
+    np.testing.assert_array_equal(
+        unpack_array(np.asarray(s.values), s.capacity)[slot7],
+        np.full(D, 2.5, np.float32),
+    )
+    np.testing.assert_array_equal(
+        unpack_array(np.asarray(s.slots["accum"]), s.capacity)[slot7],
+        np.full(D, 7.75, np.float32),
+    )
+    # folded row's tier copy is consumed — same as a maintain promote
+    assert mt.probe_rows(np.array([7], np.int64)) is None
+
+
+def test_fold_loses_to_newer_device_row_bit_exact():
+    """The PR 4 ambiguous-key rule at fold time: a key whose device copy
+    trained PAST the tier copy mid-flight must not be clobbered — the
+    fold drops it (bit-exact no-op on the device row), keeps the tier
+    copy, and queues the key for the next promote scan's retry set."""
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    k = demoted[0]
+    cand = mt.probe_rows(np.array([k], np.int64))
+    host_freq = int(cand["freqs"][0])
+
+    # key reappears and TRAINS past the host copy: lookups drive freq
+    # beyond the gathered freq snapshot
+    kid = jnp.asarray([k], jnp.int32)
+    for step in range(2, 4 + host_freq):
+        s, res = t.lookup_unique(s, kid, step=step)
+    s = t.scatter_update(
+        s, res.slot_ix, jnp.full_like(res.embeddings, -8.5), mask=res.valid
+    )
+
+    before = np.asarray(t.lookup_readonly(s, kid)).copy()
+    stale0 = mt._m_pf_stale.value
+    s, folded, dropped = mt.fold_candidates(s, cand, chunk=16)
+    assert (folded, dropped) == (0, 1)
+    assert mt._m_pf_stale.value == stale0 + 1
+    np.testing.assert_array_equal(np.asarray(t.lookup_readonly(s, kid)), before)
+    # tier copy kept for the next scan; key rides the retry set
+    assert mt.probe_rows(np.array([k], np.int64)) is not None
+    assert k in mt._retry_keys
+    # ...and the next maintain scan resolves it (erases the stale host
+    # copy — the device copy is newer — instead of retrying forever)
+    s, _ = mt.sync(s, step=50)
+    assert k not in mt._retry_keys
+
+
+def test_fold_inserts_missing_keys_ahead_of_lookup():
+    """The point of paging: a prefetched row lands BEFORE the lookup that
+    would have fresh-initialized it. Keys not yet device-resident INSERT
+    with the tier copy's values, freq, version, and a raised dirty bit."""
+    from deeprec_tpu.embedding.table import META_DIRTY, META_VERSION
+
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    picks = demoted[:4]
+    cand = mt.probe_rows(np.asarray(picks, np.int64))
+    assert cand is not None and len(cand["keys"]) == 4
+    occ = np.asarray(t.occupied(s))
+    assert not (set(picks) & set(np.asarray(s.keys)[occ].tolist()))
+
+    s, folded, dropped = mt.fold_candidates(s, cand, chunk=16)
+    assert (folded, dropped) == (4, 0)
+    keys = np.asarray(s.keys)
+    occ = np.asarray(t.occupied(s))
+    meta = np.asarray(s.meta)
+    for i, k in enumerate(cand["keys"].tolist()):
+        slot = int(np.nonzero((keys == k) & occ)[0][0])
+        np.testing.assert_array_equal(
+            unpack_array(np.asarray(s.values), s.capacity)[slot],
+            np.full(t.cfg.dim, 3.25, np.float32),
+        )
+        # tier meta travels with the insert; dirty marks it for the next
+        # incremental checkpoint even before its first lookup
+        assert meta[0, slot] == int(cand["freqs"][i])  # META_FREQ
+        assert meta[META_VERSION, slot] == int(cand["vers"][i])
+        assert meta[META_DIRTY, slot] == 1
+        # tier copy consumed
+    assert mt.probe_rows(np.asarray(picks, np.int64)) is None
+
+
+def test_fold_erase_keeps_other_packages_valid():
+    """Pure erasures (another package's fold) must NOT retire in-flight
+    gathers — their content is bit-identical and fold revalidation guards
+    against anything the device trained past. Only row-WRITING boundaries
+    (demote, load) bump the gather generation."""
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    cand_b = mt.probe_rows(np.asarray(demoted[3:6], np.int64))
+    cand_a = mt.probe_rows(np.asarray(demoted[:3], np.int64))
+    s, folded, _ = mt.fold_candidates(s, cand_a, chunk=16)
+    assert folded == 3  # erased a's tier copies, bumped _tier_rev only
+    assert cand_b["rev"] == mt._gather_gen
+    s, folded, dropped = mt.fold_candidates(s, cand_b, chunk=16)
+    assert (folded, dropped) == (3, 0)
+
+
+def test_fold_drops_whole_package_on_revision_change():
+    """Version-keyed in-flight gathers: a row-WRITING boundary (demote at
+    sync, load) between gather and fold invalidates the package whole."""
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    cand = mt.probe_rows(np.asarray(demoted[:3], np.int64))
+    s, _ = t.lookup_unique(
+        s, jnp.asarray(demoted[:3], jnp.int32), step=2
+    )
+    s, _ = mt.sync(s, step=3)  # boundary: stores mutated, generation bumped
+    assert cand["rev"] != mt._gather_gen
+    stale0 = mt._m_pf_stale.value
+    s, folded, dropped = mt.fold_candidates(s, cand, chunk=16)
+    assert folded == 0 and dropped == 3
+    assert mt._m_pf_stale.value == stale0 + 3
+
+
+def test_fold_fixed_chunk_zero_steady_state_compiles():
+    """The fold program compiles once per (table, chunk) and never again —
+    candidate-count jitter pads into the same chunk shape."""
+    from deeprec_tpu.analysis import trace_guard
+
+    t, mt = make(capacity=128)
+    s = t.create()
+    s, res = t.lookup_unique(s, jnp.arange(100, dtype=jnp.int32), step=0)
+    s = t.scatter_update(
+        s, res.slot_ix, jnp.full_like(res.embeddings, 1.5), mask=res.valid
+    )
+    s, stats = mt.sync(s, step=1)
+    assert stats.demoted > 8
+
+    demoted = sorted(int(k) for k in mt.host.export()[0])
+    # bring every candidate key back on device OUTSIDE the guarded region
+    # (the test's own variable-width lookups would compile; the fold must
+    # not) and gather one package per fold round
+    groups = [demoted[:3], demoted[3:5], demoted[5:10], demoted[10:11]]
+    s, _ = t.lookup_unique(s, jnp.asarray(demoted, jnp.int32), step=2)
+
+    # probe right before each fold — probe_rows is numpy-only, so the
+    # guarded region sees exactly the fold programs and nothing else
+    cand = mt.probe_rows(np.asarray(groups[0], np.int64))
+    s, folded, _ = mt.fold_candidates(s, cand, chunk=8)  # warm chunk
+    assert folded == len(groups[0])
+    with trace_guard(max_compiles=0, note="tier fold steady state"):
+        for g in groups[1:]:
+            cand = mt.probe_rows(np.asarray(g, np.int64))  # numpy-only
+            s, folded, _ = mt.fold_candidates(s, cand, chunk=8)
+            assert folded == len(g)  # counts jitter, shape is the chunk
+
+
+# ------------------------------------------------------ promote-scan diet
+
+
+def _replay(scan_diet, steps=14, capacity=64, vocab=90, seed=3):
+    """Replay one deterministic rotated-id stream through sync boundaries;
+    return (final device state, sorted host keys, per-boundary promote
+    counts)."""
+    t, mt = make(capacity=capacity, scan_diet=scan_diet)
+    s = t.create()
+    rng = np.random.default_rng(seed)
+    promotes = []
+    for i in range(steps):
+        ids = rng.integers((i * 7) % 30, vocab, size=24)
+        s, _ = t.lookup_unique(
+            s, jnp.asarray(ids, jnp.int32), step=2 * i
+        )
+        if i % 3 == 2:
+            s, stats = mt.sync(s, step=2 * i + 1)
+            promotes.append((stats.promoted, stats.demoted))
+    host_keys = sorted(int(k) for k in mt.host.export()[0])
+    return s, host_keys, promotes
+
+
+def test_scan_diet_bit_identical_promote_outcomes():
+    """The diet (scan only window-touched + retry keys) must be invisible:
+    bit-identical device state, host store, and promote/demote counts on
+    a replayed stream vs the full scan."""
+    s_on, host_on, prom_on = _replay(scan_diet=True)
+    s_off, host_off, prom_off = _replay(scan_diet=False)
+    assert prom_on == prom_off
+    assert any(p > 0 for p, _ in prom_on)  # stream actually promotes
+    assert host_on == host_off
+    for a, b in zip(jax.tree.leaves(s_on), jax.tree.leaves(s_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------- serving: dedup + row cache
+
+
+def test_lookup_with_fallback_dedup_parity():
+    """Dedup-before-get serves bit-identical embeddings to the per-
+    position path on a repeat-heavy stream, paying one native probe per
+    DISTINCT id."""
+    t, mt = make()
+    s, demoted = demote_marked(t, mt, value=3.25)
+    rng = np.random.default_rng(0)
+    ids = rng.choice(np.arange(52), size=400, replace=True).astype(np.int32)
+
+    calls = []
+    orig_get = mt.host.get
+
+    def counting_get(keys):
+        calls.append(len(keys))
+        return orig_get(keys)
+
+    mt.host.get = counting_get
+    emb = np.asarray(mt.lookup_with_fallback(s, jnp.asarray(ids)))
+    mt.host.get = orig_get
+    # one probe over the uniques, not one per position
+    assert calls == [len(np.unique(ids))]
+
+    # reference: per-position fallback against the same stores
+    ref = np.array(t.lookup_readonly(s, jnp.asarray(ids)))
+    vals, _, _, found = mt.host.get(ids.astype(np.int64))
+    ref[found] = vals[found][:, : t.cfg.dim]
+    np.testing.assert_array_equal(emb, ref)
+
+
+def test_row_cache_serves_hits_without_store_probes():
+    t, mt = make(row_cache_bytes=1 << 20)
+    s, demoted = demote_marked(t, mt, value=3.25)
+    ids = jnp.asarray(demoted[:8], jnp.int32)
+    first = np.asarray(mt.lookup_with_fallback(s, ids))
+
+    calls = []
+    orig_get = mt.host.get
+    mt.host.get = lambda keys: (calls.append(len(keys)), orig_get(keys))[1]
+    second = np.asarray(mt.lookup_with_fallback(s, ids))
+    mt.host.get = orig_get
+    assert calls == []  # all rows served from the cache
+    np.testing.assert_array_equal(first, second)
+
+
+def test_row_cache_never_crosses_a_sync_boundary_that_changed_the_row():
+    """The PR 17 version-keyed discipline applied to rows: a sync that
+    re-demotes a retrained row invalidates the cached copy."""
+    t, mt = make(row_cache_bytes=1 << 20)
+    s, demoted = demote_marked(t, mt, value=3.25)
+    k = demoted[0]
+    kid = jnp.asarray([k], jnp.int32)
+    cached = np.asarray(mt.lookup_with_fallback(s, kid))
+    np.testing.assert_allclose(cached[0], 3.25)
+
+    # key reappears, trains to a NEW value, and a boundary demotes it again
+    s, _ = t.lookup_unique(s, kid, step=2)
+    s, _ = mt.sync(s, step=3)  # promotes the host copy back
+    s, res = t.lookup_unique(s, kid, step=4)
+    s = t.scatter_update(
+        s, res.slot_ix, jnp.full_like(res.embeddings, 6.5), mask=res.valid
+    )
+    occ = np.asarray(t.occupied(s))
+    s = s.replace_meta(
+        freq=jnp.where(
+            jnp.asarray(occ), 5, s.freq
+        ).at[int(np.nonzero(np.asarray(s.keys) == k)[0][0])].set(1),
+    )
+    # force: occupancy sits under the high watermark after the first
+    # demotion — the boundary must still demote the coldest row (k)
+    s, stats = mt.sync(s, step=5, force=True)
+    vals, _, _, found = mt.host.get(np.asarray([k], np.int64))
+    assert found[0] and vals[0, 0] == 6.5
+    served = np.asarray(mt.lookup_with_fallback(s, kid))
+    np.testing.assert_allclose(served[0], 6.5)  # not the cached 3.25
+
+
+# ------------------------------------------------- prefetcher pump races
+
+
+def _pump_fixture():
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    tiers = {("b", ()): mt}
+    pager = TierPrefetcher(
+        resolve=tiers.get,
+        extract=lambda batch: {("b", ()): batch["ids"]},
+        depth=4,
+    )
+    return t, mt, s, demoted, pager
+
+
+def test_pump_gathers_and_training_thread_folds():
+    t, mt, s, demoted, pager = _pump_fixture()
+    try:
+        pager.observe({"ids": np.asarray(demoted[:4], np.int64)})
+        pager.observe({"ids": np.asarray(demoted[2:6], np.int64)})
+        assert pager.drain(5.0)
+        assert pager.pending_keys() == [("b", ())]
+        cand = pager.take(("b", ()))
+        # merged across batches, deduped
+        assert sorted(cand["keys"].tolist()) == sorted(demoted[:6])
+        s, _ = t.lookup_unique(
+            s, jnp.asarray(demoted[:6], jnp.int32), step=2
+        )
+        s, folded, dropped = mt.fold_candidates(s, cand, chunk=16)
+        assert (folded, dropped) == (6, 0)
+        assert pager.take(("b", ())) is None  # consumed
+    finally:
+        pager.close()
+
+
+def test_pump_killed_mid_gather_leaves_stores_consistent():
+    """Gathers are read-only: a pump killed (or erroring) mid-gather must
+    leave the tier stores consistent and the next maintain converge."""
+    t, mt, s, demoted, pager = _pump_fixture()
+    host_before = sorted(int(k) for k in mt.host.export()[0])
+
+    import threading
+
+    entered = threading.Event()
+
+    def die_mid_gather(batch):
+        entered.set()
+        raise RuntimeError("killed mid-gather")
+
+    pager.on_gather = die_mid_gather
+    pager.observe({"ids": np.asarray(demoted, np.int64)})
+    assert entered.wait(5.0)
+    assert pager.drain(5.0)
+    pager.close()  # and the thread itself dies cleanly
+    assert pager.stats()["gather_errors"] == 1
+    assert pager.pending_keys() == []
+
+    # stores untouched by the aborted gather
+    assert sorted(int(k) for k in mt.host.export()[0]) == host_before
+    # the keys it never delivered still promote through the normal scan
+    s, _ = t.lookup_unique(s, jnp.asarray(demoted[:4], jnp.int32), step=2)
+    s, stats = mt.sync(s, step=3)
+    assert stats.promoted >= 4
+    emb = np.asarray(
+        t.lookup_readonly(s, jnp.asarray(demoted[:4], jnp.int32))
+    )
+    np.testing.assert_allclose(emb, 3.25)
+
+
+def test_pump_close_mid_gather_unblocks():
+    """close() while a gather is in flight returns promptly and the
+    observe() path becomes a no-op."""
+    import threading
+
+    t, mt, s, demoted, pager = _pump_fixture()
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def block(batch):
+        entered.set()
+        hold.wait(5.0)
+
+    pager.on_gather = block
+    pager.observe({"ids": np.asarray(demoted, np.int64)})
+    assert entered.wait(5.0)
+    closer = threading.Thread(target=pager.close)
+    closer.start()
+    time.sleep(0.05)
+    hold.set()  # release the in-flight gather; close() must now finish
+    closer.join(timeout=5.0)
+    assert not closer.is_alive()
+    pager.observe({"ids": np.asarray(demoted, np.int64)})  # no-op, no raise
+    assert pager.stats()["dropped_batches"] == 0
+
+
+# --------------------------------------------------- trainer integration
+
+
+def _trainer(pipeline_mode="off", capacity=256, seed=0):
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+    from deeprec_tpu.training import Trainer
+
+    ev = EmbeddingVariableOption(
+        storage=StorageOption(storage_type=StorageType.HBM_DRAM)
+    )
+    model = WDL(emb_dim=4, capacity=capacity, hidden=(16,), num_cat=2,
+                num_dense=2, ev=ev)
+    tr = Trainer(model, Adagrad(lr=0.2), optax.adam(5e-3),
+                 pipeline_mode=pipeline_mode)
+    return tr, tr.init(seed)
+
+
+def _stream(n, vocab=280, seed=0, B=256):
+    from deeprec_tpu.data import SyntheticCriteo
+
+    gen = SyntheticCriteo(batch_size=B, num_cat=2, num_dense=2,
+                          vocab=vocab, seed=seed)
+    return [{k: np.asarray(v) for k, v in gen.batch().items()}
+            for _ in range(n)]
+
+
+def test_trainer_paging_end_to_end_through_staged_pipeline():
+    """Full wire: enable_tier_paging -> stage() taps the Prefetcher peek
+    -> pump gathers demoted rows -> fold_tier_prefetch restores them at
+    dispatch boundaries, off the maintain() cadence."""
+    tr, st = _trainer()
+    pager = tr.enable_tier_paging(depth=8, chunk=64)
+    try:
+        folds = 0
+        for i, b in enumerate(tr.stage(iter(_stream(24)), depth=2)):
+            st, mets = tr.train_step(st, b)
+            if (i + 1) % 8 == 0:
+                st, _ = tr.maintain(st)
+            pager.drain(5.0)
+            st, frep = tr.fold_tier_prefetch(st)
+            folds += sum(r["folded"] for r in frep.values())
+        assert folds > 0, "stream never exercised a fold"
+        assert np.isfinite(float(mets["loss"]))
+        stats = tr.tier_paging_stats()
+        assert stats["folded_rows"] == folds
+        assert stats["fold_bytes"] > 0
+        assert stats["gather_errors"] == 0
+    finally:
+        tr.close_tier_paging()
+
+
+def test_kstep_lookahead_parity_with_paging_on():
+    """pipeline_mode='lookahead' K-step scan with paging on stays bit-
+    identical to pipeline_mode='off' — folds land at dispatch boundaries
+    only, so the pipelined schedule sees the same tables."""
+    from deeprec_tpu.training.trainer import stack_batches
+
+    K = 4
+    stream = _stream(16, seed=7)
+    finals = {}
+    for mode in ("off", "lookahead"):
+        tr, st = _trainer(pipeline_mode=mode)
+        pager = tr.enable_tier_paging(depth=16, chunk=64)
+        try:
+            losses = []
+            for i in range(0, len(stream), K):
+                chunk = stream[i:i + K]
+                for b in chunk:
+                    pager.observe(b)
+                st, mets = tr.train_steps(st, stack_batches(chunk))
+                losses.append(np.asarray(mets["loss"]))
+                if (i // K) % 2 == 1:
+                    st, _ = tr.maintain(st)
+                pager.drain(5.0)
+                st, _ = tr.fold_tier_prefetch(st)
+            finals[mode] = (st, losses,
+                            tr.tier_paging_stats()["folded_rows"])
+        finally:
+            tr.close_tier_paging()
+    st_off, losses_off, folds_off = finals["off"]
+    st_la, losses_la, folds_la = finals["lookahead"]
+    assert folds_off > 0 and folds_off == folds_la
+    np.testing.assert_array_equal(
+        np.stack(losses_off), np.stack(losses_la)
+    )
+    for a, b in zip(jax.tree.leaves(st_off.tables),
+                    jax.tree.leaves(st_la.tables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_maintain_with_paging_converges():
+    """tier_async=True rounds + the pump + folds interleave without
+    deadlock or store corruption (the _store_lock protocol)."""
+    tr, st = _trainer()
+    pager = tr.enable_tier_paging(depth=8, chunk=64)
+    try:
+        for i, b in enumerate(tr.stage(iter(_stream(20)), depth=2)):
+            st, mets = tr.train_step(st, b)
+            if (i + 1) % 5 == 0:
+                st, _ = tr.maintain(st, tier_async=True)
+            st, _ = tr.fold_tier_prefetch(st)
+        st, rep = tr.maintain(st)  # final settle (drains pending rounds)
+        assert np.isfinite(float(mets["loss"]))
+        assert pager.stats()["gather_errors"] == 0
+    finally:
+        tr.close_tier_paging()
+
+
+def test_sharded_trainer_refuses_paging():
+    from deeprec_tpu.parallel import ShardedTrainer, make_mesh
+    from deeprec_tpu.models import WDL
+    from deeprec_tpu.optim import Adagrad
+
+    ev = EmbeddingVariableOption(
+        storage=StorageOption(storage_type=StorageType.HBM_DRAM)
+    )
+    model = WDL(emb_dim=4, capacity=512, hidden=(16,), num_cat=2,
+                num_dense=2, ev=ev)
+    tr = ShardedTrainer(model, Adagrad(lr=0.2), optax.adam(5e-3),
+                        mesh=make_mesh(8))
+    with pytest.raises(NotImplementedError):
+        tr.enable_tier_paging()
+
+
+def test_prefetch_counters_registered_and_rendered():
+    """Obs satellites: the tier-paging counters/gauge land on the process
+    registry with the catalog names (docs/observability.md)."""
+    from deeprec_tpu.obs import metrics as obs_metrics
+
+    if not obs_metrics.metrics_enabled():
+        pytest.skip("obs disabled")
+    t, mt = make()
+    s, demoted = demote_marked(t, mt)
+    cand = mt.probe_rows(np.asarray(demoted[:3] * 2, np.int64))
+    s, _ = t.lookup_unique(s, jnp.asarray(demoted[:3], jnp.int32), step=2)
+    s, folded, _ = mt.fold_candidates(s, cand, chunk=16)
+    assert folded == 3
+    text = obs_metrics.default_registry().render_prometheus()
+    for name in (
+        "deeprec_tier_prefetch_probed_total",
+        "deeprec_tier_prefetch_hits_total",
+        "deeprec_tier_prefetch_folds_total",
+        "deeprec_tier_prefetch_stale_dropped_total",
+        "deeprec_tier_prefetch_fold_lag_ms",
+    ):
+        assert name in text, name

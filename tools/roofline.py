@@ -1062,6 +1062,101 @@ def assert_guard(json_path: str, detect_budget: int,
     return rc
 
 
+def assert_tier(json_path: str, loss_factor: float, step_tol: float) -> int:
+    """CI gate for overlapped tier paging (bench.py --tier-paging
+    'tier_paging' section; embedding/tier_prefetch.py +
+    MultiTierTable.fold_candidates):
+
+      * optimizer-state-loss diet — the fresh-init rate (batch positions
+        hitting a tier-resident row, i.e. training from a re-initialized
+        row that lost its optimizer state) with paging ON must be at
+        least `loss_factor`× lower than the paging-OFF arm on the same
+        recorded rotated-zipf stream. An ON rate of exactly 0 passes
+        (recorded loss_factor is null — infinite suppression).
+      * compile discipline — the fold path recorded 0 steady-state XLA
+        compiles (the fixed-chunk sentinel-padded `import_rows`
+        discipline applied to folds).
+      * stall budget — the training-thread fold stall must not exceed
+        the same arm's pinned sync_async boundary stall: paging may not
+        cost the training thread more than the maintain machinery it
+        relieves.
+      * step time — ON step time within `step_tol` of OFF (same
+        discipline as --assert-overlap: single-core CI boxes need a
+        loose tolerance; accelerator hosts should pin --tier-step-tol
+        back down to 0.03).
+      * health — zero pump gather errors and a nonzero fold count (a
+        bench where nothing folded measured nothing).
+    """
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    tp = rec.get("tier_paging")
+    if not tp:
+        print(f"roofline: {json_path} has no 'tier_paging' record "
+              "(run bench.py --tier-paging --out onto this JSON)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    off, on = tp.get("off", {}), tp.get("on", {})
+    lf = tp.get("loss_factor")
+    if lf is not None and lf < loss_factor:
+        print(
+            f"roofline: tier paging gate FAILED — fresh-init suppression "
+            f"{lf}× under the {loss_factor:.0f}× floor (on rate "
+            f"{on.get('fresh_init_rate')} vs off "
+            f"{off.get('fresh_init_rate')}): folds are not landing before "
+            "the lookups", file=sys.stderr,
+        )
+        rc = 1
+    if on.get("steady_compiles") != 0:
+        print(
+            f"roofline: tier paging gate FAILED — "
+            f"{on.get('steady_compiles')} steady-state compile(s) in the "
+            "fold path (contract: fixed-chunk folds compile once per "
+            "table during warmup, then never)", file=sys.stderr,
+        )
+        rc = 1
+    fold_stall = on.get("fold_stall_ms")
+    sync_stall = on.get("sync_stall_ms")
+    if fold_stall is None or sync_stall is None or fold_stall > sync_stall:
+        print(
+            f"roofline: tier paging gate FAILED — training-thread fold "
+            f"stall {fold_stall} ms exceeds the arm's sync_async boundary "
+            f"stall {sync_stall} ms: paging costs more than the "
+            "maintain machinery it relieves", file=sys.stderr,
+        )
+        rc = 1
+    ratio = tp.get("step_time_ratio")
+    if ratio is None or ratio > 1.0 + step_tol:
+        print(
+            f"roofline: tier paging gate FAILED — ON step time "
+            f"{ratio}× OFF exceeds the 1+{step_tol:.2f} bound "
+            f"(on {on.get('step_ms')} ms vs off {off.get('step_ms')} ms)",
+            file=sys.stderr,
+        )
+        rc = 1
+    if on.get("gather_errors", 1) != 0 or not on.get("folded_rows"):
+        print(
+            f"roofline: tier paging gate FAILED — pump health: "
+            f"{on.get('gather_errors')} gather error(s), "
+            f"{on.get('folded_rows')} folded row(s) (a run that folded "
+            "nothing measured nothing)", file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"roofline: tier paging gate ok — fresh-init suppression "
+            f"{'∞' if lf is None else lf}× (floor {loss_factor:.0f}×; "
+            f"on {on.get('fresh_init_rate')} vs off "
+            f"{off.get('fresh_init_rate')}), {on.get('folded_rows')} rows "
+            f"folded ({on.get('fold_bytes')} B), 0 steady compiles, fold "
+            f"stall {fold_stall} ms ≤ sync stall {sync_stall} ms, step "
+            f"{ratio}× off (bound 1+{step_tol:.2f})"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -1216,6 +1311,22 @@ def main(argv=None):
                    help="bound on the recorded rollback+replay wall time "
                         "(default 120 s — generous for single-core CI; "
                         "capable hosts should pin it down)")
+    p.add_argument("--assert-tier", metavar="BENCH_JSON", default=None,
+                   help="don't run the step: validate the overlapped "
+                        "tier-paging record written by bench.py "
+                        "--tier-paging (fresh-init rate with paging on "
+                        "≥ --tier-loss-factor× lower than off, 0 "
+                        "steady-state fold compiles, fold stall ≤ the "
+                        "arm's sync_async stall, step time within "
+                        "--tier-step-tol of paging-off; CI smoke gate)")
+    p.add_argument("--tier-loss-factor", type=float, default=10.0,
+                   help="required fresh-init (optimizer-state-loss) "
+                        "suppression factor, paging on vs off "
+                        "(default 10)")
+    p.add_argument("--tier-step-tol", type=float, default=0.03,
+                   help="allowed ON/OFF step-time ratio slack (default "
+                        "0.03; CPU CI boxes pass a looser value, same "
+                        "precedent as --overlap-tol)")
     p.add_argument("--serving-quant-ratio", type=float, default=0.55,
                    help="int8 residency bytes bound as a fraction of fp32 "
                         "(default 0.55 — int8 + per-row scale must at "
@@ -1255,6 +1366,9 @@ def main(argv=None):
     if args.assert_guard:
         sys.exit(assert_guard(args.assert_guard, args.guard_detect_budget,
                               args.guard_recovery_ms))
+    if args.assert_tier:
+        sys.exit(assert_tier(args.assert_tier, args.tier_loss_factor,
+                             args.tier_step_tol))
 
     import jax
     import jax.numpy as jnp
