@@ -45,6 +45,14 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_lookup.py --fused-step \
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     python tools/roofline.py --assert-fused /tmp/deeprec_fused_smoke.json
 
+echo "== host input pipeline bench (CPU smoke: vectorized block parse vs serial line parser, N-worker stream parity, training-thread pop cost) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_input.py --smoke \
+    --out /tmp/deeprec_input_smoke.json
+
+echo "== input pipeline gate (block parse ≥2× serial, bit-identical batch stream at every worker count, zero training-thread regression) =="
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python tools/roofline.py --assert-input /tmp/deeprec_input_smoke.json
+
 echo "== checkpoint choreography microbench (CPU smoke: sync + async paths) =="
 env PYTHONPATH= JAX_PLATFORMS=cpu python tools/bench_ckpt.py --smoke
 

@@ -41,6 +41,10 @@ exec python -m pytest -q -p no:cacheprovider \
   tests/test_guard.py::test_canary_gate_rejects_nan_delta_serving_continues \
   tests/test_guard_stream.py::test_tcp_reader_skips_oversized_frame_and_counts \
   tests/test_guard_stream.py::test_line_parser_garbage_matrix \
+  tests/test_input_pipeline.py::test_block_parse_garbage_matrix_parity \
+  tests/test_input_pipeline.py::test_pipeline_bit_identical_to_serial_any_worker_count \
+  tests/test_input_pipeline.py::test_pipeline_deterministic_under_slow_worker \
+  tests/test_input_pipeline.py::test_pipeline_staged_ring_exactly_once_resume \
   tests/test_retrieval.py::test_tie_determinism_block_size_independent \
   tests/test_retrieval.py::test_delta_fold_targets_changed_items_and_zero_compiles \
   tests/test_retrieval_fleet.py::test_two_shard_merge_parity_and_kill_partial \

@@ -5,7 +5,13 @@ from deeprec_tpu.data.synthetic import (
     SyntheticMultiTask,
     SyntheticTwoTower,
 )
-from deeprec_tpu.data.readers import CriteoCSVReader, ParquetReader
+from deeprec_tpu.data.readers import (
+    CriteoCSVReader,
+    ParquetReader,
+    criteo_block_parse,
+    criteo_hash_salts,
+)
+from deeprec_tpu.data.pipeline import ParallelInputPipeline, plan_shards
 from deeprec_tpu.data.prefetch import Prefetcher, staged
 from deeprec_tpu.data.work_queue import WorkQueue, parse_slice
 from deeprec_tpu.data.stream import FileStreamServer, FileTailReader, TCPStreamReader

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional
 
 import jax
@@ -70,6 +71,7 @@ class Prefetcher:
         self.on_consume = on_consume
         self.peek = peek
         self.q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self.stall_seconds = 0.0  # consumer wait on an empty ring (total)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -110,7 +112,19 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        try:
+            item = self.q.get_nowait()
+        except queue.Empty:
+            # Empty ring = the producer (reader/parse/device_put) is the
+            # bottleneck right now; the wait is the input stall the obs
+            # plane reports per dispatch (docs/data.md).
+            t0 = time.perf_counter()
+            item = self.q.get()
+            wait = time.perf_counter() - t0
+            self.stall_seconds += wait
+            from deeprec_tpu.data.pipeline import record_stall
+
+            record_stall("staged", wait)
         if item is None:
             raise StopIteration
         if isinstance(item, Exception):

@@ -8,6 +8,7 @@ by the embedding engine, so readers and tables agree on id semantics.
 """
 from __future__ import annotations
 
+import threading
 import zlib
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -16,6 +17,15 @@ import numpy as np
 CRITEO_COLUMNS = (
     ["label"] + [f"I{i}" for i in range(1, 14)] + [f"C{i}" for i in range(1, 27)]
 )
+
+
+def criteo_hash_salts(num_cat: int = 26) -> Dict[str, int]:
+    """The per-column id salts of the CSV/stream readers, keyed by column
+    name. Pass to ``ParquetReader(hash_salts=...)`` so parquet-stored
+    categorical strings hash to the SAME ids as the TSV path (the format
+    parity gate, tests/test_input_pipeline.py)."""
+    return {f"C{i}": i * 0x9E3779B9 & 0x7FFFFFFF
+            for i in range(1, num_cat + 1)}
 
 
 class RecordErrors:
@@ -38,11 +48,15 @@ class RecordErrors:
     def __init__(self, metrics: bool = True):
         self.counts: Dict[str, int] = {}
         self._metrics = metrics
+        # Parallel pipeline workers (data/pipeline.py) share one instance;
+        # the read-modify-write below needs the lock to stay exact.
+        self._lock = threading.Lock()
 
     def count(self, kind: str, n: int = 1) -> None:
         if n <= 0:
             return
-        self.counts[kind] = self.counts.get(kind, 0) + int(n)  # noqa: DRT002 — host error counter on host parse results
+        with self._lock:
+            self.counts[kind] = self.counts.get(kind, 0) + int(n)  # noqa: DRT002 — host error counter on host parse results
         if self._metrics:
             from deeprec_tpu.obs import metrics as obs_metrics
 
@@ -72,12 +86,12 @@ def sanitize_batch(batch: Dict[str, np.ndarray],
     too. Returns the batch (arrays copied only when dirty)."""
     out = {}
     for k, v in batch.items():
-        a = np.asarray(v)
+        a = np.asarray(v)  # noqa: DRT002 — host batch sanitize (numpy reader output), never a device array
         if np.issubdtype(a.dtype, np.floating):
             bad = ~np.isfinite(a)
             if bad.any():
                 if errors is not None:
-                    errors.count("nonfinite_float", int(bad.sum()))
+                    errors.count("nonfinite_float", int(bad.sum()))  # noqa: DRT002 — host error counter on a numpy batch
                 a = np.where(bad, np.zeros((), a.dtype), a)
         elif np.issubdtype(a.dtype, np.integer) and not k.startswith("label"):
             bad = (a < 0) & (a != pad_value)
@@ -85,20 +99,260 @@ def sanitize_batch(batch: Dict[str, np.ndarray],
                 bad = bad | (a > max_id)
             if bad.any():
                 if errors is not None:
-                    errors.count("bad_id", int(bad.sum()))
-                a = np.where(bad, np.asarray(pad_value, a.dtype), a)
+                    errors.count("bad_id", int(bad.sum()))  # noqa: DRT002 — host error counter on a numpy batch
+                a = np.where(bad, np.asarray(pad_value, a.dtype), a)  # noqa: DRT002 — host batch sanitize, never a device array
         out[k] = a
     return out
 
 
 def _hash_strings(col: "np.ndarray", salt: int) -> np.ndarray:
-    """Vectorized string -> int32 id (crc32-based; stable across runs)."""
+    """String -> int32 id (crc32-based; stable across runs). Memoized per
+    call: real id columns are heavily repeated (zipf), so the crc is paid
+    once per DISTINCT value. The block parser goes further (np.unique over
+    an S-dtype column); this path keeps exact semantics for object arrays
+    with None/NaN holes."""
     out = np.empty(len(col), np.int32)
+    cache: Dict[str, int] = {}
     for i, v in enumerate(col):
         if v is None or v == "" or (isinstance(v, float) and np.isnan(v)):
             out[i] = -1
         else:
-            out[i] = (zlib.crc32(str(v).encode()) ^ salt) & 0x7FFFFFFF
+            s = str(v)
+            h = cache.get(s)
+            if h is None:
+                cache[s] = h = (zlib.crc32(s.encode()) ^ salt) & 0x7FFFFFFF
+            out[i] = h
+    return out
+
+
+def _parse_float_col(col: np.ndarray, errors: Optional[RecordErrors],
+                     kind: str) -> np.ndarray:
+    """One S-dtype text column -> float32, with `criteo_line_parser` float
+    semantics: empty -> 0.0 silently, unparseable -> 0.0 counted under
+    `kind`. Non-finite values pass through (the caller clamps + counts
+    them block-wide, same as the line parser's post-loop sweep)."""
+    filled = np.where(col == b"", b"0", col)
+    try:
+        vals = filled.astype(np.float64)  # numpy's parser == float() here
+    except ValueError:
+        vals = np.empty(len(filled), np.float64)
+        nbad = 0
+        for i, v in enumerate(filled):
+            try:
+                vals[i] = float(v)  # noqa: DRT002 — host text parse, pre-device
+            except (TypeError, ValueError):
+                vals[i] = 0.0
+                nbad += 1
+        if errors is not None:
+            errors.count(kind, nbad)
+    return vals.astype(np.float32)
+
+
+def _crc_table() -> np.ndarray:
+    t = np.zeros(256, np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (0xEDB88320 if c & 1 else 0)
+        t[i] = np.uint32(c)
+    return t
+
+
+_CRC_T = _crc_table()  # the zlib crc32 polynomial table, vectorizable
+
+
+def _hash_bytes_col(col: np.ndarray, salt: int) -> np.ndarray:
+    """S-dtype column -> int32 ids via np.unique: crc paid once per
+    DISTINCT value, scatter back through the inverse index. Matches
+    `_hash_strings` bit-for-bit on utf-8-clean, NUL-free bytes (the block
+    parser falls back to the per-line path otherwise)."""
+    u, inv = np.unique(col, return_inverse=True)
+    hu = np.empty(len(u), np.int32)
+    for k, v in enumerate(u):
+        hu[k] = -1 if v == b"" else (zlib.crc32(v) ^ salt) & 0x7FFFFFFF
+    return hu[inv.reshape(col.shape)]
+
+
+def _cube_parse_into(arr: np.ndarray, n: int, F: int, num_dense: int,
+                     num_cat: int, labels, dense, cats,
+                     errors: Optional[RecordErrors]) -> bool:
+    """The no-Python-objects fast lane of `criteo_block_parse`: field
+    boundaries from one separator scan, every field gathered into a
+    fixed-width [n, F, w] byte cube, float columns bulk-astype'd through
+    an S-dtype view, id columns hashed by a table-driven crc32 that
+    iterates over BYTE POSITIONS (w of them) instead of rows. Requires
+    uniform arity (the caller checked tabs == F-1 per line). Declines
+    (returns False) when the widest field would make the cube silly —
+    the caller then takes the S-matrix route."""
+    sep = np.flatnonzero((arr == 9) | (arr == 10))
+    ends = sep.reshape(n, F)
+    starts = np.empty_like(ends)
+    starts[:, 1:] = ends[:, :-1] + 1
+    starts[0, 0] = 0
+    starts[1:, 0] = ends[:-1, -1] + 1
+    lens = ends - starts
+    w = int(lens.max()) if n else 0  # noqa: DRT002 — host field-width scan over file bytes, never a device value
+    if w == 0 or w > 128:
+        return w == 0  # all-empty parses trivially; huge fields decline
+    idx = starts[..., None] + np.arange(w)
+    cube = arr[np.minimum(idx, len(arr) - 1)]
+    cube[~(np.arange(w)[None, None, :] < lens[..., None])] = 0
+    nf = 1 + num_dense
+    fcols = np.ascontiguousarray(cube[:, :nf, :]).reshape(
+        n * nf, w).view(f"|S{w}").reshape(n, nf)
+    labels[:] = _parse_float_col(fcols[:, 0], errors, "bad_label")
+    try:  # one astype for the whole dense block; per-column on garbage
+        filled = np.where(fcols[:, 1:] == b"", b"0", fcols[:, 1:])
+        dense[:] = filled.astype(np.float64).astype(np.float32)
+    except ValueError:
+        for i in range(num_dense):
+            dense[:, i] = _parse_float_col(fcols[:, 1 + i], errors,
+                                           "bad_float")
+    cc = cube[:, nf:, :].reshape(n * num_cat, w)
+    clens = lens[:, nf:].reshape(-1)
+    crc = np.full(n * num_cat, 0xFFFFFFFF, np.uint32)
+    for j in range(w):
+        nxt = (crc >> np.uint32(8)) ^ _CRC_T[(crc ^ cc[:, j])
+                                             & np.uint32(0xFF)]
+        crc = np.where(clens > j, nxt, crc)
+    crc = (crc ^ np.uint32(0xFFFFFFFF)).reshape(n, num_cat)
+    salts = (np.arange(1, num_cat + 1, dtype=np.uint64) * 0x9E3779B9
+             & 0x7FFFFFFF).astype(np.uint32)
+    ids = ((crc ^ salts[None, :]) & np.uint32(0x7FFFFFFF)).astype(np.int32)
+    ids[lens[:, nf:] == 0] = -1
+    for c in range(num_cat):
+        cats[c][:] = ids[:, c]
+    return True
+
+
+def criteo_block_parse(data: bytes, num_dense: int = 13, num_cat: int = 26,
+                       errors: Optional[RecordErrors] = None
+                       ) -> Dict[str, np.ndarray]:
+    """Vectorized Criteo block parser — the parallel pipeline's hot loop.
+
+    Takes a buffer of '\\n'-terminated TSV lines and produces the column
+    dict (label [n] f32, I* [n,1] f32, C* [n] i32) in a handful of numpy
+    ops: one split into an [n, F] S-dtype field matrix, bulk astype for
+    the float columns, np.unique + crc32-of-distinct for the id columns.
+    Bit-identical to `criteo_line_parser` applied to the decoded lines —
+    including the RecordErrors clamp accounting, now counted per block —
+    pinned by tests/test_input_pipeline.py. Lines that can't take the
+    fast path (wrong field count, NUL bytes, non-utf8) are parsed
+    per-line with the exact line-parser semantics and scattered back by
+    row index, so one garbage record never slows the block around it."""
+    if data and not data.endswith(b"\n"):
+        data = data + b"\n"
+    n = data.count(b"\n")
+    F = 1 + num_dense + num_cat
+    labels = np.zeros(n, np.float32)
+    dense = np.zeros((n, num_dense), np.float32)
+    cats = [np.full(n, -1, np.int32) for _ in range(num_cat)]
+    if n == 0:
+        return _criteo_assemble(labels, dense, cats, num_dense, num_cat)
+
+    clean = b"\x00" not in data
+    if clean:
+        try:  # raw-bytes crc == crc of str.encode() only for valid utf-8
+            data.decode("utf-8")
+        except UnicodeDecodeError:
+            clean = False
+
+    arr = np.frombuffer(data, np.uint8)
+    nl = np.flatnonzero(arr == 10)
+    ctab = np.cumsum(arr == 9, dtype=np.int64)
+    tabs_at_end = ctab[nl]
+    tabs = np.diff(tabs_at_end, prepend=0)
+    good = (tabs == F - 1) if clean else np.zeros(n, bool)
+
+    if good.all() and _cube_parse_into(arr, n, F, num_dense, num_cat,
+                                       labels, dense, cats, errors):
+        m = None
+        good_rows = np.empty(0, np.intp)
+        good = np.ones(n, bool)
+    elif good.all():
+        fields = data[:-1].replace(b"\n", b"\t").split(b"\t")
+        m = np.array(fields, dtype="S").reshape(n, F)  # noqa: DRT002 — host parse of file bytes, never a device array
+        good_rows = None
+    elif good.any():
+        lines = data.split(b"\n")[:-1]
+        good_rows = np.flatnonzero(good)
+        gdata = b"\n".join([lines[i] for i in good_rows])
+        fields = gdata.replace(b"\n", b"\t").split(b"\t")
+        m = np.array(fields, dtype="S").reshape(len(good_rows), F)  # noqa: DRT002 — host parse of file bytes, never a device array
+    else:
+        m = None
+        good_rows = np.empty(0, np.intp)
+
+    if m is not None:
+        rows = slice(None) if good_rows is None else good_rows
+        labels[rows] = _parse_float_col(m[:, 0], errors, "bad_label")
+        for i in range(num_dense):
+            dense[rows, i] = _parse_float_col(m[:, 1 + i], errors,
+                                              "bad_float")
+        for c in range(num_cat):
+            cats[c][rows] = _hash_bytes_col(
+                m[:, 1 + num_dense + c],
+                salt=(c + 1) * 0x9E3779B9 & 0x7FFFFFFF)
+
+    if not good.all():
+        lines = data.split(b"\n")[:-1]
+        for r in np.flatnonzero(~good):
+            _criteo_parse_line_into(
+                lines[r].decode("utf-8", errors="replace"), r,
+                labels, dense, cats, num_dense, num_cat, errors)
+
+    # Non-finite sweep, block-wide — same ordering/kinds as the line
+    # parser's post-loop clamp ("1e999" parses to inf, then clamps here).
+    bad_label = ~np.isfinite(labels)
+    if bad_label.any():
+        labels[bad_label] = 0.0
+        if errors is not None:
+            errors.count("nonfinite_float", int(bad_label.sum()))  # noqa: DRT002 — host numpy count, pre-device
+    bad = ~np.isfinite(dense)
+    if bad.any():
+        dense[bad] = 0.0
+        if errors is not None:
+            errors.count("nonfinite_float", int(bad.sum()))  # noqa: DRT002 — host numpy count, pre-device
+    return _criteo_assemble(labels, dense, cats, num_dense, num_cat)
+
+
+def _criteo_parse_line_into(line: str, r: int, labels, dense, cats,
+                            num_dense: int, num_cat: int,
+                            errors: Optional[RecordErrors]) -> None:
+    """Exact `criteo_line_parser` semantics for ONE line (the block
+    parser's slow lane): missing fields read as "", unparseable text
+    clamps to 0 and counts, extra fields are ignored."""
+    parts = line.split("\t")
+    try:
+        labels[r] = float(parts[0] or 0)  # noqa: DRT002 — host text parse, pre-device
+    except (TypeError, ValueError):
+        labels[r] = 0.0
+        if errors is not None:
+            errors.count("bad_label")
+    for i in range(num_dense):
+        v = parts[1 + i] if len(parts) > 1 + i else ""
+        try:
+            dense[r, i] = float(v) if v else 0.0  # noqa: DRT002 — host text parse, pre-device
+        except (TypeError, ValueError):
+            dense[r, i] = 0.0
+            if errors is not None:
+                errors.count("bad_float")
+    for c in range(num_cat):
+        j = 1 + num_dense + c
+        v = parts[j] if len(parts) > j else ""
+        salt = (c + 1) * 0x9E3779B9 & 0x7FFFFFFF
+        cats[c][r] = (
+            -1 if v == "" else (zlib.crc32(v.encode()) ^ salt) & 0x7FFFFFFF
+        )
+
+
+def _criteo_assemble(labels, dense, cats, num_dense, num_cat
+                     ) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {"label": labels}
+    for i in range(num_dense):
+        out[f"I{i+1}"] = dense[:, i:i + 1]
+    for c in range(num_cat):
+        out[f"C{c+1}"] = cats[c]
     return out
 
 
@@ -298,12 +552,20 @@ class ParquetReader:
         columns: Optional[Sequence[str]] = None,
         hash_columns: Sequence[str] = (),
         drop_remainder: bool = True,
+        hash_salts: Optional[Dict[str, int]] = None,
     ):
+        """hash_salts: per-column salt override for the id hashing. The
+        default (crc32 of the column NAME) is self-describing but does
+        not match the positional salts of the CSV/stream readers — pass
+        `criteo_hash_salts()` when the parquet files hold the same
+        records as a TSV path and the id streams must be bit-identical
+        (the pipeline's format parity gate)."""
         self.paths = list(paths)
         self.B = batch_size
         self.columns = list(columns) if columns else None
         self.hash_columns = set(hash_columns)
         self.drop_remainder = drop_remainder
+        self.hash_salts = dict(hash_salts or {})
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         import pyarrow.parquet as pq
@@ -317,7 +579,9 @@ class ParquetReader:
                 for name, col in zip(rb.schema.names, rb.columns):
                     arr = col.to_numpy(zero_copy_only=False)
                     if name in self.hash_columns or arr.dtype == object:
-                        arr = _hash_strings(arr, salt=zlib.crc32(name.encode()))
+                        salt = self.hash_salts.get(
+                            name, zlib.crc32(name.encode()))
+                        arr = _hash_strings(arr, salt=salt)
                     cols[name] = arr
                 for name, arr in cols.items():
                     buf.setdefault(name, []).append(arr)

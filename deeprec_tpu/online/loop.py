@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Iterable, Optional
 
+from deeprec_tpu.data.pipeline import record_stall
 from deeprec_tpu.obs import metrics as obs_metrics
 from deeprec_tpu.obs import trace as obs_trace
 from deeprec_tpu.online.supervisor import Heartbeat
@@ -103,6 +104,12 @@ class TrainLoop:
         self.rollbacks = 0
         self.batches_skipped = 0
         self.replay_gaps = 0
+        # Input-stall ledger: how long the training thread waited for a
+        # batch (total + last dispatch). With a staged source this is a
+        # queue pop — nonzero values mean the HOST pipeline is the
+        # bottleneck (docs/data.md; deeprec_input_stall_seconds).
+        self.input_stall_s = 0.0
+        self.last_input_stall_s = 0.0
         # [(bad_step, detect_step, flags, kinds, fingerprint)] — the
         # detection ledger tools/bench_guard.py matches injections
         # against (detect_step - bad_step is the latency in dispatches;
@@ -195,6 +202,7 @@ class TrainLoop:
                 self.reader, "consecutive_connect_failures", 0
             )
             extra["stream_reconnects"] = getattr(self.reader, "reconnects", 0)
+        extra["input_stall_s"] = round(self.input_stall_s, 6)
         self.heartbeat.beat(step=step, status=status, **extra)
 
     def restore_or_init(self):
@@ -472,7 +480,21 @@ class TrainLoop:
         step = int(state.step)
         self._beat(step, status="running")
         guard_on = self.guard is not None
-        for batch in self.batches:
+        batches = iter(self.batches)
+        while True:
+            # Batch acquisition is timed: with a staged source this is a
+            # queue pop, so the wait IS the host-input stall — exported
+            # per dispatch as deeprec_input_stall_seconds{site=train_loop}
+            # and totalled into the heartbeat (input_stall_s).
+            t0_in = time.perf_counter()
+            try:
+                batch = next(batches)
+            except StopIteration:
+                break
+            wait = time.perf_counter() - t0_in
+            self.input_stall_s += wait
+            self.last_input_stall_s = wait
+            record_stall("train_loop", wait)
             if self.max_steps is not None and step >= self.max_steps:
                 break  # a resumed worker may already be at the target
             fp = None

@@ -24,7 +24,6 @@ import os
 import queue
 import threading
 import time
-from itertools import chain
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -51,24 +50,10 @@ class BadRequest(ValueError):
         self.details = {"error": message, **details}
 
 
-def pad_ragged(rows: List, L: int, pad_value, dtype) -> np.ndarray:
-    """Bulk pad/trim a ragged list-of-bags to [B, L]: one flatten, one
-    index grid, one scatter — no per-row Python list building (the old
-    `[(r + [pad] * (L - len(r)))[:L] for r in v]` walked every bag in
-    the interpreter, which dominated parse time for long histories)."""
-    B = len(rows)
-    lens = np.fromiter(map(len, rows), np.intp, count=B)
-    total = int(lens.sum())
-    out = np.full((B, L), pad_value, dtype)
-    if total == 0:
-        return out
-    flat = np.fromiter(chain.from_iterable(rows), dtype, count=total)
-    starts = np.cumsum(lens) - lens
-    col = np.arange(total) - np.repeat(starts, lens)
-    keep = col < L
-    row = np.repeat(np.arange(B, dtype=np.intp), lens)
-    out[row[keep], col[keep]] = flat[keep]
-    return out
+# Re-export: the vectorized pad lives in utils/ragged.py now (shared with
+# retrieval ingest and the reader-side packers); this name is the serving
+# API surface and stays importable from here.
+from deeprec_tpu.utils.ragged import pad_ragged  # noqa: E402,F401
 
 
 def parse_features(predictor: "Predictor", feats: Dict) -> Dict[str, np.ndarray]:

@@ -427,20 +427,14 @@ class RetrievalEngine:
         """Item feature column -> the stored [N, L] shape (the pad/trim
         rules of `parse_features`, minus the ragged-list path — ingest is
         a bulk array interface)."""
+        from deeprec_tpu.utils.ragged import pad_rect
+
         want = self._pred.feature_dtypes[name]
         arr = np.asarray(v)
         if want.kind in "iu":
             f = next(f for f in self._trainer.sparse_specs
                      if f.name == name)
-            arr = arr.astype(want)
-            L = f.max_len or 1
-            if arr.ndim == 1:
-                arr = arr[:, None]
-            if arr.shape[1] < L:
-                pad = np.full((arr.shape[0], L - arr.shape[1]),
-                              f.pad_value, want)
-                arr = np.concatenate([arr, pad], axis=1)
-            return arr[:, :L]
+            return pad_rect(arr, f.max_len or 1, f.pad_value, want)
         arr = arr.astype(np.float32)
         return arr[:, None] if arr.ndim == 1 else arr
 

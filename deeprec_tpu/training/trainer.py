@@ -1016,11 +1016,23 @@ class Trainer:
         `on_consume`: called once per batch DELIVERED to the train loop
         (not per batch produced) — stream-position carriers
         (CriteoStats.mark_consumed) checkpoint the consumed index through
-        this so a restore never skips the ring's in-flight batches."""
+        this so a restore never skips the ring's in-flight batches.
+        When omitted and `source` itself carries the contract
+        (mark_consumed/attach_consumer — CriteoStats, the
+        ParallelInputPipeline), it is wired automatically: forgetting the
+        hookup silently broke exactly-once resume, the worst kind of
+        correct-looking bug."""
         if self.stage_mode != "auto":
             return source
         from deeprec_tpu.data.prefetch import Prefetcher
 
+        if on_consume is None:
+            mark = getattr(source, "mark_consumed", None)
+            if callable(mark):
+                attach = getattr(source, "attach_consumer", None)
+                if callable(attach):
+                    attach()
+                on_consume = mark
         pager = getattr(self, "_tier_pager", None)
         return Prefetcher(iter(source), depth=depth,
                           transform=self.stage_batch,

@@ -1,0 +1,490 @@
+"""The parallel host input pipeline (PR 20, data/pipeline.py):
+
+  * `criteo_block_parse` is bit-identical to the per-line
+    `criteo_line_parser` — values, dtypes AND error counters — on clean
+    blocks (the vectorized cube fast path) and on the garbage matrix
+    (bad labels, unparseable floats, nonfinite values, short/long/empty
+    rows) that falls back to the per-line lane.
+  * the N-worker pipeline emits the SAME batch stream as the serial
+    single-reader assembly for ANY worker count — including under an
+    artificially slow worker (the reorder buffer, not thread luck,
+    owns ordering) and with k_stack'ed emission.
+  * kill-and-resume is exactly-once: consumed-position save/restore
+    through the staged ring, through a ParquetReader shard, and through
+    a real SIGKILL with 3 workers mid-file at different offsets.
+  * the hoisted `pad_ragged`/`pad_rect` (utils/ragged.py) match the
+    legacy per-row padding rules serving depended on.
+"""
+import glob
+import hashlib
+import json
+import os
+import signal
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeprec_tpu.data.pipeline import ParallelInputPipeline, plan_shards
+from deeprec_tpu.data.readers import (
+    RecordErrors,
+    criteo_block_parse,
+    criteo_hash_salts,
+    sanitize_batch,
+)
+from deeprec_tpu.data.stream import criteo_line_parser
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+NUM_DENSE, NUM_CAT = 13, 26
+
+
+def _write_criteo(dirname, rows_per_file, seed=0):
+    """Deterministic Criteo TSV files; I1 carries the global record index
+    so every record in every emitted batch is identity-checkable."""
+    rng = np.random.default_rng(seed)
+    paths, gid = [], 0
+    for fi, n in enumerate(rows_per_file):
+        p = os.path.join(str(dirname), f"day{fi}.tsv")
+        with open(p, "w") as f:
+            for _ in range(n):
+                cols = [str(rng.integers(0, 2)), str(gid)]
+                cols += ["" if rng.random() < 0.1 else
+                         str(rng.integers(0, 100))
+                         for _ in range(NUM_DENSE - 1)]
+                cols += [f"{rng.integers(0, 1 << 20):x}"
+                         if rng.random() > 0.05 else ""
+                         for _ in range(NUM_CAT)]
+                f.write("\t".join(cols) + "\n")
+                gid += 1
+        paths.append(p)
+    return paths
+
+
+def _serial_stream(paths, B):
+    """The baseline the pipeline must be bit-identical to: per-file
+    `criteo_line_parser` batches, per-file remainder dropped."""
+    err = RecordErrors(metrics=False)
+    parse = criteo_line_parser(errors=err)
+    for p in paths:
+        with open(p) as f:
+            lines = f.read().split("\n")[:-1]
+        for i in range(len(lines) // B):
+            yield sanitize_batch(parse(lines[i * B:(i + 1) * B]), err)
+
+
+def _assert_batches_equal(got, want, msg=""):
+    assert len(got) == len(want), f"{msg}: {len(got)} vs {len(want)} batches"
+    for bi, (a, b) in enumerate(zip(got, want)):
+        assert set(a) == set(b)
+        for k in b:
+            assert a[k].dtype == b[k].dtype, (msg, bi, k)
+            np.testing.assert_array_equal(a[k], b[k],
+                                          err_msg=f"{msg}: batch {bi} {k}")
+
+
+# ------------------------------------------------------------ block parse
+
+
+def test_block_parse_clean_parity_uses_cube_path(tmp_path, monkeypatch):
+    import deeprec_tpu.data.readers as readers
+
+    paths = _write_criteo(tmp_path, [300])
+    data = open(paths[0], "rb").read()
+
+    calls = {"n": 0}
+    real = readers._cube_parse_into
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        out = real(*a, **kw)
+        assert out  # clean uniform-arity block must take the fast lane
+        return out
+
+    monkeypatch.setattr(readers, "_cube_parse_into", spy)
+    e1, e2 = RecordErrors(metrics=False), RecordErrors(metrics=False)
+    got = criteo_block_parse(data, errors=e1)
+    want = criteo_line_parser(errors=e2)(data.decode().split("\n")[:-1])
+    assert calls["n"] == 1
+    _assert_batches_equal([got], [want], "clean block")
+    assert e1.counts == e2.counts == {}
+
+
+def test_block_parse_garbage_matrix_parity():
+    rng = np.random.default_rng(7)
+    rows = []
+    for _ in range(200):  # clean filler the garbage hides between
+        cols = [str(rng.integers(0, 2))]
+        cols += ["" if rng.random() < 0.1 else str(rng.integers(0, 100))
+                 for _ in range(NUM_DENSE)]
+        cols += [f"{rng.integers(0, 1 << 20):x}" for _ in range(NUM_CAT)]
+        rows.append("\t".join(cols))
+    rows += [
+        "x\t" + "\t".join(["1"] * 13 + ["aa"] * 26),        # bad label
+        "1\tzz\t" + "\t".join(["2"] * 12 + ["bb"] * 26),    # bad float
+        "1\t" + "\t".join(["1e999"] * 13 + ["cc"] * 26),    # inf -> clamp
+        "0\t" + "\t".join(["nan"] * 13 + [""] * 26),        # nan + no cats
+        "1\t1\t2",                                          # short row
+        "\t".join(["5"] * 45),                              # long row
+        "",                                                 # empty line
+        "1\t  3  \t" + "\t".join(["4"] * 12 + ["dd"] * 26),  # ws float
+    ]
+    rng.shuffle(rows)
+    data = ("\n".join(rows) + "\n").encode()
+
+    e1, e2 = RecordErrors(metrics=False), RecordErrors(metrics=False)
+    got = criteo_block_parse(data, errors=e1)
+    want = criteo_line_parser(errors=e2)(data.decode().split("\n")[:-1])
+    _assert_batches_equal([got], [want], "garbage matrix")
+    assert e1.counts == e2.counts
+    assert e1.counts["bad_label"] >= 1 and e1.counts["bad_float"] >= 1
+    assert e1.counts["nonfinite_float"] >= 1
+
+
+def test_block_parse_non_utf8_and_unterminated_tail():
+    clean = b"1\t" + b"\t".join([b"2"] * 13 + [b"ad"] * 26) + b"\n"
+    dirty = b"0\t" + b"\t".join([b"3"] * 13 + [b"\xff\xfe"] * 26)
+    data = clean + dirty  # no trailing newline: tail still a record
+    e1, e2 = RecordErrors(metrics=False), RecordErrors(metrics=False)
+    got = criteo_block_parse(data, errors=e1)
+    want = criteo_line_parser(errors=e2)(
+        data.decode("utf-8", errors="replace").split("\n"))
+    _assert_batches_equal([got], [want], "non-utf8")
+    assert e1.counts == e2.counts
+
+
+# ------------------------------------------------------- shard plan
+
+
+def test_plan_shards_record_aligned_and_deterministic(tmp_path):
+    paths = _write_criteo(tmp_path, [700, 450, 96])
+    shards = plan_shards(paths, batch_size=64, shard_batches=2)
+    assert shards == plan_shards(paths, batch_size=64, shard_batches=2)
+    for s in shards:
+        # every shard starts at a record boundary and units are whole
+        # batches: batches can never span a shard (or a file)
+        blob = open(s.path, "rb").read()
+        assert s.lo == 0 or blob[s.lo - 1:s.lo] == b"\n"
+        assert s.records == s.units * 64
+        assert blob[s.lo:s.hi].count(b"\n") >= s.records - 1
+    # unit sequence is gapless and totals the per-file floor sum
+    assert [s.first_unit for s in shards] == \
+        list(np.cumsum([0] + [s.units for s in shards[:-1]]))
+    assert sum(s.units for s in shards) == 700 // 64 + 450 // 64 + 96 // 64
+
+
+# ------------------------------------------------------------ pipeline
+
+
+@pytest.mark.parametrize("workers", [1, 2, 5])
+def test_pipeline_bit_identical_to_serial_any_worker_count(tmp_path, workers):
+    paths = _write_criteo(tmp_path, [700, 450, 96])
+    want = list(_serial_stream(paths, 64))
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=workers,
+                               shard_batches=2, metrics=False)
+    got = list(pl)
+    pl.close()
+    _assert_batches_equal(got, want, f"workers={workers}")
+
+
+def test_pipeline_deterministic_under_slow_worker(tmp_path, monkeypatch):
+    """Order must come from the reorder buffer, not thread timing: stall
+    the worker that claimed shard 0 and the stream must not change."""
+    import deeprec_tpu.data.pipeline as pl_mod
+
+    paths = _write_criteo(tmp_path, [700, 450, 96])
+    want = list(_serial_stream(paths, 64))
+
+    real = pl_mod.criteo_block_parse
+    hit = {"first": True}
+
+    def slow(data, *a, **kw):
+        import time
+        if hit["first"]:
+            hit["first"] = False
+            time.sleep(0.25)
+        return real(data, *a, **kw)
+
+    monkeypatch.setattr(pl_mod, "criteo_block_parse", slow)
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=4,
+                               shard_batches=2, metrics=False)
+    got = list(pl)
+    pl.close()
+    assert not hit["first"]
+    _assert_batches_equal(got, want, "slow worker")
+
+
+def test_pipeline_k_stack_matches_stacked_batches(tmp_path):
+    paths = _write_criteo(tmp_path, [700, 450])
+    want = list(_serial_stream(paths, 64))
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=3,
+                               shard_batches=2, k_stack=2, metrics=False)
+    got = list(pl)
+    pl.close()
+    # each emitted item is K serial batches stacked on a leading axis —
+    # exactly what trainer.stack_batches hands train_steps — and the
+    # remainder contract drops per-plan-unit (a multiple of K batches)
+    flat = []
+    for item in got:
+        assert item["label"].shape[0] == 2
+        for j in range(2):
+            flat.append({k: v[j] for k, v in item.items()})
+    _assert_batches_equal(flat, want[:len(flat)], "k_stack")
+    assert len(flat) >= len(want) - 2 * len(paths)
+
+
+def test_pipeline_staged_ring_exactly_once_resume(tmp_path):
+    """The training-loop shape: pipeline -> staged() ring with the
+    consumed-position hookup (Trainer.stage wires the same). Save after 5
+    DELIVERED batches (ring depth 4 means producers ran ahead), restore a
+    fresh pipeline: the union replays every record exactly once."""
+    from deeprec_tpu.data.prefetch import staged
+
+    paths = _write_criteo(tmp_path, [700, 450, 96])
+    want = list(_serial_stream(paths, 64))
+
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=3,
+                               shard_batches=2, metrics=False)
+    pl.attach_consumer()
+    ring = staged(pl, depth=4, transform=lambda b: b,
+                  on_consume=pl.mark_consumed)
+    head = [next(ring) for _ in range(5)]
+    state = pl.save()
+    assert state["consumed"] == 5
+    ring.close()
+    pl.close()
+
+    pl2 = ParallelInputPipeline(paths, batch_size=64, num_workers=3,
+                                shard_batches=2, metrics=False)
+    pl2.restore(json.loads(json.dumps(state)))  # state is JSON-clean
+    tail = list(pl2)
+    pl2.close()
+    _assert_batches_equal(head + tail, want, "staged resume")
+
+
+# ------------------------------------------------------------- parquet
+
+
+def _to_parquet(paths, dirname):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    out = []
+    for p in paths:
+        cols = {"label": [], **{f"I{i}": [] for i in range(1, NUM_DENSE + 1)},
+                **{f"C{i}": [] for i in range(1, NUM_CAT + 1)}}
+        with open(p) as f:
+            for line in f.read().split("\n")[:-1]:
+                parts = line.split("\t")
+                cols["label"].append(float(parts[0]))
+                for i in range(NUM_DENSE):
+                    v = parts[1 + i]
+                    cols[f"I{i + 1}"].append(float(v) if v else 0.0)
+                for c in range(NUM_CAT):
+                    v = parts[1 + NUM_DENSE + c]
+                    cols[f"C{c + 1}"].append(v if v else None)
+        dst = os.path.join(str(dirname), os.path.basename(p) + ".parquet")
+        pq.write_table(pa.table(cols), dst, row_group_size=50)
+        out.append(dst)
+    return out
+
+
+def test_parquet_pipeline_bit_identical_to_csv(tmp_path):
+    paths = _write_criteo(tmp_path, [300, 170])
+    pq_paths = _to_parquet(paths, tmp_path)
+    a = ParallelInputPipeline(paths, batch_size=64, num_workers=2,
+                              shard_batches=2, metrics=False)
+    want = list(a)
+    a.close()
+    b = ParallelInputPipeline(pq_paths, batch_size=64, num_workers=2,
+                              fmt="parquet",
+                              hash_salts=criteo_hash_salts(),
+                              metrics=False)
+    got = list(b)
+    b.close()
+    _assert_batches_equal(got, want, "parquet vs csv")
+
+
+def test_parquet_resume_exactly_once(tmp_path):
+    paths = _write_criteo(tmp_path, [300, 170])
+    pq_paths = _to_parquet(paths, tmp_path)
+    mk = lambda: ParallelInputPipeline(  # noqa: E731
+        pq_paths, batch_size=64, num_workers=2, fmt="parquet",
+        hash_salts=criteo_hash_salts(), metrics=False)
+    full = mk()
+    want = list(full)
+    full.close()
+
+    pl = mk()
+    pl.attach_consumer()
+    it = iter(pl)
+    head = []
+    for _ in range(3):
+        head.append(next(it))
+        pl.mark_consumed()
+    state = pl.save()
+    pl.close()
+
+    pl2 = mk()
+    pl2.restore(state)
+    tail = list(pl2)
+    pl2.close()
+    _assert_batches_equal(head + tail, want, "parquet resume")
+
+
+# ------------------------------------------------------------- SIGKILL
+
+
+SIGKILL_WORKER = textwrap.dedent(
+    """
+    import glob, hashlib, json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    from deeprec_tpu.data.pipeline import ParallelInputPipeline
+
+    paths = sorted(glob.glob(os.path.join({data!r}, "*.tsv")))
+    state_path = {state!r}
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=3,
+                               shard_batches=2, metrics=False)
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            pl.restore(json.load(f))
+        print("RESUMED", flush=True)
+    pl.attach_consumer()
+    for batch in pl:
+        digest = hashlib.md5(
+            b"".join(batch[k].tobytes() for k in sorted(batch))
+        ).hexdigest()
+        pl.mark_consumed()
+        st = pl.save()
+        print(f"BATCH {{st['consumed'] - 1}} {{digest}}", flush=True)
+        with open(state_path + ".tmp", "w") as f:
+            json.dump(st, f)
+        os.replace(state_path + ".tmp", state_path)
+        time.sleep(0.02)
+    print("DONE", flush=True)
+    """
+)
+
+
+def test_sigkill_midstream_resumes_exactly_once(tmp_path):
+    """kill -9 the consumer process while 3 workers sit at different
+    offsets in different files; the restarted process restores per-shard
+    consumed offsets and the union of both runs is the full serial stream
+    with every record exactly once (replay only past the last durable
+    save, never a gap)."""
+    from deeprec_tpu.online import faults
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    paths = _write_criteo(data_dir, [700, 450, 263], seed=3)
+    want = list(_serial_stream(paths, 64))
+    oracle = [hashlib.md5(b"".join(b[k].tobytes() for k in sorted(b))
+                          ).hexdigest() for b in want]
+    state = str(tmp_path / "stream_state.json")
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(SIGKILL_WORKER.format(repo=REPO, data=str(data_dir),
+                                      state=state))
+
+    p = faults.spawn_worker([sys.executable, script])
+    hit, lines1 = faults.wait_for_line(
+        p, lambda l: l.startswith("BATCH") and int(l.split()[1]) >= 4,
+        timeout=120)
+    assert hit is not None, lines1[-10:]
+    assert faults.sigkill(p) == -signal.SIGKILL
+
+    p = faults.spawn_worker([sys.executable, script])
+    done, lines2 = faults.wait_for_line(
+        p, lambda l: l.startswith("DONE"), timeout=120)
+    assert done is not None, lines2[-10:]
+    assert p.wait(timeout=30) == 0
+    assert any(l == "RESUMED" for l in lines2), lines2[:3]
+
+    run1 = {int(l.split()[1]): l.split()[2]
+            for l in lines1 if l.startswith("BATCH")}
+    run2 = {int(l.split()[1]): l.split()[2]
+            for l in lines2 if l.startswith("BATCH")}
+    first2 = min(run2)
+    # no gap: everything before the resume point was delivered in run 1;
+    # replay (kill between deliver and durable save) only ever re-emits
+    # the tail at/after the resume point, bit-identically
+    combined = {i: d for i, d in run1.items() if i < first2}
+    combined.update(run2)
+    assert sorted(combined) == list(range(len(oracle)))
+    assert [combined[i] for i in range(len(oracle))] == oracle
+    for i, d in run1.items():
+        assert d == oracle[i]  # replayed tail is bit-identical too
+
+
+# ------------------------------------------------------- ragged padding
+
+
+def _legacy_ragged_pad(v, L, pad_value, want):
+    rows = [(list(r) + [pad_value] * (L - len(r)))[:L] for r in v]
+    return np.asarray(rows, want)
+
+
+def test_pad_ragged_hoisted_single_implementation():
+    from deeprec_tpu.serving import predictor
+    from deeprec_tpu.utils import ragged
+
+    assert predictor.pad_ragged is ragged.pad_ragged  # delegation, no fork
+
+
+def test_pad_ragged_and_pad_rect_parity():
+    from deeprec_tpu.utils.ragged import pad_rect, pad_ragged
+
+    rng = np.random.default_rng(0)
+    L, pad = 6, -1
+    cases = {
+        "ragged": [[7, 8, 9], [10], [], [1, 2, 3, 4, 5]],
+        "over_long": [list(range(12)), list(range(9)), [3]],
+        "exact": [[1, 2, 3, 4, 5, 6], [9] * 6],
+        "random": [list(map(int, rng.integers(0, 99, rng.integers(0, 11))))
+                   for _ in range(64)],
+    }
+    for name, v in cases.items():
+        for want in (np.dtype(np.int64), np.dtype(np.int32)):
+            got = pad_ragged(v, L, pad, want)
+            np.testing.assert_array_equal(
+                got, _legacy_ragged_pad(v, L, pad, want), err_msg=name)
+            assert got.dtype == want
+
+    # pad_rect: already-rectangular fast path — scalar bags widen to
+    # [n, 1] then pad, over-long truncates, exact passes through
+    for name, v in {
+        "scalar_bag": [1, 2, 3],
+        "rect_short": [[1, 2], [3, 4]],
+        "rect_long": [list(range(12)), list(range(12, 24))],
+        "rect_exact": [[1, 2, 3, 4, 5, 6]],
+    }.items():
+        want = np.dtype(np.int32)
+        ref_rows = [[r] if np.isscalar(r) else r for r in v]
+        got = pad_rect(np.asarray(v), L, pad, want)
+        np.testing.assert_array_equal(
+            got, _legacy_ragged_pad(ref_rows, L, pad, want), err_msg=name)
+        assert got.dtype == want
+
+
+# ---------------------------------------------------------- observability
+
+
+def test_pipeline_exports_input_metrics(tmp_path):
+    from deeprec_tpu.obs import metrics as obs_metrics
+
+    if not obs_metrics.metrics_enabled():
+        pytest.skip("metrics plane off")
+    paths = _write_criteo(tmp_path, [300])
+    pl = ParallelInputPipeline(paths, batch_size=64, num_workers=2,
+                               shard_batches=2, metrics=True)
+    n = sum(b["label"].shape[0] for b in pl)
+    pl.close()
+    text = obs_metrics.default_registry().render_prometheus()
+    assert "deeprec_input_batches" in text
+    assert "deeprec_input_records" in text
+    assert "deeprec_input_bytes" in text
+    assert 'deeprec_input_stall_seconds{site="pipeline"}' in text
+    assert n == (300 // 64) * 64
+    st = pl.stats()
+    assert st["records"] == n and st["bytes"] > 0
+    assert st["parse_s"] >= 0 and st["pack_s"] >= 0

@@ -1,0 +1,246 @@
+"""Microbenchmark: the parallel host input pipeline vs the serial reader.
+
+Measures what data/pipeline.py moved off the training thread (PR 20):
+
+  * parse — ex/s and MB/s of the vectorized `criteo_block_parse` vs the
+    serial `criteo_line_parser` hot loop on the SAME bytes (the
+    `block_parse_speedup` the --assert-input gate pins at >=2x).
+  * stages — the pipeline's own per-stage accounting (read/parse/pack
+    worker-seconds + consumer stall) and end-to-end pipeline ex/s at 1
+    and N workers.
+  * parity — bit-identity of the batch stream: N workers vs 1 worker vs
+    a serial `criteo_line_parser` assembly of the same files
+    (`parity_ok`; any mismatch fails the gate).
+  * train thread — host time per dispatch on the training thread: a
+    queue pop from the pre-filled pipeline vs parsing the batch inline
+    (`train_thread_ratio`; the gate pins no regression).
+
+Prints ONE JSON line with an "input" section (the bench.py convention).
+`--smoke` shrinks the row count so CI merely proves the gates hold
+(cibuild/run_tests.sh); real numbers come from a full run
+(INPUT_BENCH.json).
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def write_criteo(dirname, files, rows_per_file, seed=0):
+    """Realistic-shape Criteo TSV: zipf-repeated categorical values (the
+    measured regime for the unique-based id hashing — matches the skew of
+    SyntheticCriteo), ~10% missing fields."""
+    rng = np.random.default_rng(seed)
+    vocabs = [[f"{v:x}" for v in rng.integers(0, 1 << 20, size=2000)]
+              for _ in range(26)]
+    paths = []
+    for fi in range(files):
+        p = os.path.join(dirname, f"day{fi}.tsv")
+        with open(p, "w") as f:
+            zipf = (rng.zipf(1.3, size=(rows_per_file, 26)) - 1) % 2000
+            miss = rng.random((rows_per_file, 13)) < 0.1
+            labels = rng.integers(0, 2, rows_per_file)
+            dense = rng.integers(0, 100, (rows_per_file, 13))
+            for r in range(rows_per_file):
+                cols = [str(labels[r])]
+                cols += ["" if miss[r, i] else str(dense[r, i])
+                         for i in range(13)]
+                cols += [vocabs[c][zipf[r, c]] for c in range(26)]
+                f.write("\t".join(cols) + "\n")
+        paths.append(p)
+    return paths
+
+
+def bench_parse(paths, reps, B=512, shard_batches=16):
+    """Block parse vs serial line parse on identical bytes, each at its
+    real operating grain: the pipeline hands `criteo_block_parse` a
+    shard (shard_batches * B records) at a time; the serial readers hand
+    `criteo_line_parser` one batch (B lines) at a time."""
+    from deeprec_tpu.data.readers import RecordErrors, criteo_block_parse
+    from deeprec_tpu.data.stream import criteo_line_parser
+
+    data = b"".join(open(p, "rb").read() for p in paths)
+    lines = data.decode().split("\n")[:-1]
+    n = len(lines)
+    mb = len(data) / 1e6
+    ends = np.flatnonzero(np.frombuffer(data, np.uint8) == 10) + 1
+    shard = shard_batches * B
+    bounds = [0] + [int(ends[min(i + shard, n) - 1])
+                    for i in range(0, n, shard)]
+
+    def cat(chunks):
+        return {k: np.concatenate([c[k] for c in chunks]) for k in
+                chunks[0]}
+
+    tb = 1e30
+    for _ in range(reps):
+        err = RecordErrors(metrics=False)
+        t0 = time.perf_counter()
+        got = [criteo_block_parse(data[lo:hi], errors=err)
+               for lo, hi in zip(bounds[:-1], bounds[1:])]
+        tb = min(tb, time.perf_counter() - t0)
+    got = cat(got)
+    ts = 1e30
+    for _ in range(reps):
+        parse = criteo_line_parser(errors=RecordErrors(metrics=False))
+        t0 = time.perf_counter()
+        want = [parse(lines[i:i + B]) for i in range(0, n, B)]
+        ts = min(ts, time.perf_counter() - t0)
+    want = cat(want)
+    parse_parity = all(
+        (got[k] == want[k]).all() and got[k].dtype == want[k].dtype
+        for k in want
+    )
+    return {
+        "records": n,
+        "mb": round(mb, 3),
+        "block_exps": round(n / tb, 1),
+        "block_mbps": round(mb / tb, 2),
+        "serial_exps": round(n / ts, 1),
+        "serial_mbps": round(mb / ts, 2),
+        "block_parse_speedup": round(ts / tb, 3),
+        "parse_parity": bool(parse_parity),
+    }
+
+
+def serial_stream(paths, B):
+    """The serial baseline stream: per-file `criteo_line_parser` batches,
+    remainder dropped per file (the reader contract)."""
+    from deeprec_tpu.data.readers import RecordErrors, sanitize_batch
+    from deeprec_tpu.data.stream import criteo_line_parser
+
+    err = RecordErrors(metrics=False)
+    parse = criteo_line_parser(errors=err)
+    for p in paths:
+        with open(p) as f:
+            lines = f.read().split("\n")[:-1]
+        for i in range(len(lines) // B):
+            yield sanitize_batch(parse(lines[i * B:(i + 1) * B]), err)
+
+
+def bench_pipeline(paths, B, workers):
+    from deeprec_tpu.data.pipeline import ParallelInputPipeline
+
+    pl = ParallelInputPipeline(paths, batch_size=B, num_workers=workers,
+                               metrics=False)
+    t0 = time.perf_counter()
+    batches = list(pl)
+    wall = time.perf_counter() - t0
+    stats = pl.stats()
+    pl.close()
+    n = sum(b["label"].shape[0] for b in batches)
+    return batches, {
+        "workers": workers,
+        "batches": len(batches),
+        "exps": round(n / wall, 1),
+        "wall_s": round(wall, 4),
+        "read_s": round(stats["read_s"], 4),
+        "parse_s": round(stats["parse_s"], 4),
+        "pack_s": round(stats["pack_s"], 4),
+        "stall_s": round(stats["stall_s"], 4),
+        "mbps": round(stats["bytes"] / 1e6 / wall, 2),
+    }
+
+
+def bench_train_thread(paths, B, workers, reps):
+    """Host time per dispatch ON THE TRAINING THREAD: a pop from the
+    pre-filled pipeline buffer vs parsing the batch inline (what the
+    training thread did before PR 20). The pipeline is given a window
+    covering the whole (bench-sized) stream and drained only after the
+    workers finish, so the pop numbers measure the pop, not the worker."""
+    from deeprec_tpu.data.pipeline import ParallelInputPipeline
+
+    pop_us = 1e30
+    nb = 0
+    for _ in range(reps):
+        pl = ParallelInputPipeline(paths, batch_size=B,
+                                   num_workers=workers,
+                                   reorder_window=1 << 30, metrics=False)
+        first = next(pl)  # starts the workers
+        deadline = time.time() + 60
+        while len(pl._buf) < pl.total_units - 1 and time.time() < deadline:
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        rest = list(pl)
+        dt = time.perf_counter() - t0
+        nb = 1 + len(rest)
+        pop_us = min(pop_us, dt / max(1, len(rest)) * 1e6)
+        pl.close()
+        del first, rest
+
+    t0 = time.perf_counter()
+    serial_n = sum(1 for _ in serial_stream(paths, B))
+    serial_us = (time.perf_counter() - t0) / max(1, serial_n) * 1e6
+    return {
+        "batches": nb,
+        "pop_us": round(pop_us, 2),
+        "serial_inline_us": round(serial_us, 2),
+        "train_thread_ratio": round(pop_us / serial_us, 5),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small row count: CI proves the gates, not perf")
+    p.add_argument("--rows", type=int, default=None,
+                   help="rows per file (default 40000, smoke 4000)")
+    p.add_argument("--files", type=int, default=3)
+    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--reps", type=int, default=None)
+    p.add_argument("--out", type=str, default=None,
+                   help="also write the JSON here (for roofline gates)")
+    args = p.parse_args()
+    rows = args.rows or (4000 if args.smoke else 40000)
+    reps = args.reps or (2 if args.smoke else 4)
+
+    tmp = tempfile.mkdtemp(prefix="deeprec_bench_input_")
+    try:
+        paths = write_criteo(tmp, args.files, rows)
+        parse = bench_parse(paths, reps)
+
+        want = list(serial_stream(paths, args.batch))
+        runs = []
+        stream_parity = True
+        for w in sorted({1, 2, args.workers}):
+            got, stats = bench_pipeline(paths, args.batch, w)
+            runs.append(stats)
+            ok = len(got) == len(want) and all(
+                (a[k] == b[k]).all() and a[k].dtype == b[k].dtype
+                for a, b in zip(got, want) for k in b
+            )
+            stream_parity = stream_parity and ok
+
+        train = bench_train_thread(paths, args.batch, args.workers, reps)
+
+        out = {
+            "input": {
+                "rows": rows * args.files,
+                "batch": args.batch,
+                "block_parse_speedup": parse["block_parse_speedup"],
+                "parity_ok": bool(parse["parse_parity"] and stream_parity),
+                "parse": parse,
+                "pipeline": runs,
+                "train_thread": train,
+                "train_thread_ratio": train["train_thread_ratio"],
+            }
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1157,6 +1157,78 @@ def assert_tier(json_path: str, loss_factor: float, step_tol: float) -> int:
     return rc
 
 
+def assert_input(json_path: str, speedup_min: float, train_tol: float) -> int:
+    """CI gate for the parallel host input pipeline (tools/bench_input.py
+    'input' section; data/pipeline.py + criteo_block_parse):
+
+      * parse throughput — the vectorized block parse must beat the
+        serial per-line `criteo_line_parser` by at least `speedup_min`×
+        on the same bytes, each at its real operating grain (blocks of
+        shard_batches*B records vs B-line calls).
+      * parity — the batch stream must be BIT-identical: block parse vs
+        line parse on the same records, and the N-worker pipeline vs the
+        serial single-reader assembly (any worker count). One mismatched
+        element or dtype fails the gate.
+      * training thread — host time per dispatch (a pop from the filled
+        pipeline buffer) must not exceed `train_tol`× the serial inline
+        parse it replaced: the pipeline may not cost the training thread
+        more than the work it moved off of it.
+    """
+    import json
+
+    with open(json_path) as f:
+        rec = json.load(f)
+    inp = rec.get("input")
+    if not inp:
+        print(f"roofline: {json_path} has no 'input' record "
+              "(run tools/bench_input.py --out onto this JSON)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    speedup = inp.get("block_parse_speedup")
+    if speedup is None or speedup < speedup_min:
+        parse = inp.get("parse", {})
+        print(
+            f"roofline: input gate FAILED — block parse "
+            f"{speedup}× the serial line parser, under the "
+            f"{speedup_min:.1f}× floor ({parse.get('block_exps')} vs "
+            f"{parse.get('serial_exps')} ex/s): the vectorized parse "
+            "is not paying for the pipeline", file=sys.stderr,
+        )
+        rc = 1
+    if not inp.get("parity_ok"):
+        parse_ok = inp.get("parse", {}).get("parse_parity")
+        print(
+            f"roofline: input gate FAILED — batch-stream parity broken "
+            f"(block-vs-line parse parity={parse_ok}; stream parity "
+            "covers every benched worker count vs the serial reader): "
+            "the pipeline is not bit-identical to the serial path",
+            file=sys.stderr,
+        )
+        rc = 1
+    ratio = inp.get("train_thread_ratio")
+    if ratio is None or ratio > train_tol:
+        tt = inp.get("train_thread", {})
+        print(
+            f"roofline: input gate FAILED — training-thread dispatch "
+            f"cost {ratio}× the serial inline parse exceeds the "
+            f"{train_tol:.2f}× bound (pop {tt.get('pop_us')} µs vs "
+            f"inline {tt.get('serial_inline_us')} µs): the pipeline "
+            "regressed the thread it exists to relieve", file=sys.stderr,
+        )
+        rc = 1
+    if rc == 0:
+        tt = inp.get("train_thread", {})
+        print(
+            f"roofline: input gate ok — block parse {speedup}× serial "
+            f"(floor {speedup_min:.1f}×), batch stream bit-identical "
+            f"across worker counts, training-thread dispatch "
+            f"{tt.get('pop_us')} µs vs {tt.get('serial_inline_us')} µs "
+            f"inline ({ratio}× ≤ {train_tol:.2f}×)"
+        )
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=2048)
@@ -1327,6 +1399,22 @@ def main(argv=None):
                    help="allowed ON/OFF step-time ratio slack (default "
                         "0.03; CPU CI boxes pass a looser value, same "
                         "precedent as --overlap-tol)")
+    p.add_argument("--assert-input", metavar="INPUT_JSON", default=None,
+                   help="don't run the step: validate the host input "
+                        "pipeline record written by tools/bench_input.py "
+                        "(block parse ≥ --input-speedup-min× the serial "
+                        "line parser, bit-identical batch stream at every "
+                        "benched worker count, training-thread dispatch "
+                        "≤ --input-train-tol× the inline parse it "
+                        "replaced; CI smoke gate)")
+    p.add_argument("--input-speedup-min", type=float, default=2.0,
+                   help="required block-parse throughput multiple over "
+                        "the serial criteo_line_parser (default 2)")
+    p.add_argument("--input-train-tol", type=float, default=1.0,
+                   help="allowed training-thread dispatch cost as a "
+                        "multiple of the serial inline parse (default 1 "
+                        "— the pipeline must never cost the training "
+                        "thread more than the work it moved off of it)")
     p.add_argument("--serving-quant-ratio", type=float, default=0.55,
                    help="int8 residency bytes bound as a fraction of fp32 "
                         "(default 0.55 — int8 + per-row scale must at "
@@ -1369,6 +1457,9 @@ def main(argv=None):
     if args.assert_tier:
         sys.exit(assert_tier(args.assert_tier, args.tier_loss_factor,
                              args.tier_step_tol))
+    if args.assert_input:
+        sys.exit(assert_input(args.assert_input, args.input_speedup_min,
+                              args.input_train_tol))
 
     import jax
     import jax.numpy as jnp
